@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Headline benchmark: flagship Llama-class model training throughput.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Measures tokens/sec/chip and MFU for a bf16 ZeRO training step on the
+available hardware (one real TPU chip under the driver; CPU fallback
+produces numbers but they are meaningless for MFU). vs_baseline compares
+achieved MFU against the north-star target in BASELINE.json
+(Llama-2-70B ZeRO-3 ≥45% MFU on v5p-256 — scaled here to the single-chip
+model that fits).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import transformer as T
+    from deepspeed_tpu.platform.accelerator import get_accelerator
+
+    acc = get_accelerator()
+    on_tpu = acc.is_tpu()
+
+    if on_tpu:
+        # ~350M-param Llama-style model: large matmuls that tile the MXU,
+        # bf16, remat to keep activations in HBM budget.
+        mcfg = T.TransformerConfig(
+            vocab_size=32000, n_layers=24, n_heads=16, d_model=1024,
+            max_seq=2048, variant="llama", remat="dots", use_flash=True,
+        )
+        micro_bs, steps, warmup = 8, 10, 3
+    else:
+        mcfg = T.TransformerConfig(
+            vocab_size=512, n_layers=2, n_heads=4, d_model=128,
+            max_seq=256, variant="llama", use_flash=False,
+        )
+        micro_bs, steps, warmup = 2, 3, 1
+
+    engine = ds.initialize(
+        {
+            "train_micro_batch_size_per_gpu": micro_bs,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-4, "weight_decay": 0.1}},
+            "zero_optimization": {"stage": 1},
+            "bf16": {"enabled": True},
+            "gradient_clipping": 1.0,
+            "steps_per_print": 10**9,
+        },
+        loss_fn=T.make_loss_fn(mcfg),
+        param_init_fn=lambda k: T.init(mcfg, k),
+        param_logical_specs=T.logical_specs(mcfg),
+    )
+
+    seq = mcfg.max_seq
+    rng = np.random.default_rng(0)
+    batch = {"tokens": rng.integers(0, mcfg.vocab_size, (engine.config.train_batch_size, seq + 1)).astype(np.int32)}
+
+    for _ in range(warmup):
+        engine.train_batch(batch)
+    jax.effects_barrier()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        m = engine.train_batch(batch)
+    jax.effects_barrier()
+    dt = (time.perf_counter() - t0) / steps
+
+    n_chips = jax.device_count()
+    tokens_per_step = engine.config.train_batch_size * seq
+    tok_s_chip = tokens_per_step / dt / n_chips
+    flops_tok = mcfg.flops_per_token(seq)
+    achieved = tok_s_chip * flops_tok
+    peak = acc.peak_flops()
+    mfu = achieved / peak
+
+    target_mfu = 0.45  # BASELINE.json north star
+    print(
+        json.dumps(
+            {
+                "metric": "llama_350m_bf16_zero1_tokens_per_sec_per_chip",
+                "value": round(tok_s_chip, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(mfu / target_mfu, 4),
+                "mfu": round(mfu, 4),
+                "achieved_tflops_per_chip": round(achieved / 1e12, 2),
+                "step_time_s": round(dt, 4),
+                "loss": round(m["loss"], 4),
+                "platform": acc.platform,
+                "device": acc.device_name(),
+                "n_chips": n_chips,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

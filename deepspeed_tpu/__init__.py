@@ -1,0 +1,70 @@
+"""deepspeed_tpu — a TPU-native distributed training & inference framework.
+
+A ground-up JAX/XLA/Pallas framework with the capabilities of the
+reference DeepSpeed (mounted at /root/reference; see SURVEY.md):
+config-driven engine, ZeRO-style sharding expressed as NamedShardings,
+pipeline/tensor/expert/sequence parallelism on one device mesh, mixed
+precision, checkpointing, profiling, and a ragged-batch inference engine.
+
+Top-level API mirrors the reference contract
+(ref: deepspeed/__init__.py — initialize():69, init_inference():268).
+"""
+
+from typing import Any, Callable, Dict, Optional
+
+from .version import __version__
+from .config.config import DeepSpeedTPUConfig, parse_config
+from .platform.accelerator import get_accelerator
+from .platform.mesh import build_mesh, MESH_AXES
+from .runtime.engine import DeepSpeedTPUEngine, TrainState
+from . import comm
+
+
+def initialize(
+    config: Any = None,
+    *,
+    loss_fn: Callable,
+    params: Any = None,
+    param_init_fn: Optional[Callable] = None,
+    param_logical_specs: Any = None,
+    mesh=None,
+    rules: Optional[Dict[str, Any]] = None,
+    has_aux: bool = False,
+    init_rng=None,
+) -> DeepSpeedTPUEngine:
+    """Build a training engine (ref: deepspeed/__init__.py:69 initialize).
+
+    The reference takes an nn.Module and wraps it; TPU-first, the engine
+    takes a pure `loss_fn(params, batch, rng) -> loss` plus either a
+    concrete params pytree or (`param_init_fn`, abstract shapes) so
+    parameters can be materialized directly sharded.
+
+    Returns the engine; optimizer and lr scheduler are owned by the
+    engine and built from the config's optimizer/scheduler blocks.
+    """
+    cfg = parse_config(config)
+    comm.init_distributed()
+    if params is None:
+        if param_init_fn is None:
+            raise ValueError("initialize() needs `params` or `param_init_fn`")
+        import jax
+
+        rng = init_rng if init_rng is not None else jax.random.PRNGKey(cfg.seed)
+        params = jax.eval_shape(param_init_fn, rng)
+    return DeepSpeedTPUEngine(
+        cfg,
+        loss_fn,
+        params,
+        param_logical_specs=param_logical_specs,
+        mesh=mesh,
+        rules=rules,
+        has_aux=has_aux,
+        param_init_fn=param_init_fn,
+        init_rng=init_rng,
+    )
+
+
+def init_inference(*args, **kwargs):
+    from .inference.engine import init_inference as _init_inference
+
+    return _init_inference(*args, **kwargs)

@@ -1,0 +1,21 @@
+"""Communication facade (ref: deepspeed/comm — see comm.py module docs)."""
+
+from .comm import (
+    all_gather,
+    all_reduce,
+    all_to_all,
+    axis_index,
+    barrier,
+    broadcast,
+    broadcast_host,
+    get_local_device_count,
+    get_process_count,
+    get_rank,
+    get_world_size,
+    init_distributed,
+    is_initialized,
+    log_summary,
+    ppermute,
+    reduce_scatter,
+)
+from .logger import comms_logger

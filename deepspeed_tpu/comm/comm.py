@@ -1,0 +1,192 @@
+"""Communication facade.
+
+TPU-native analog of the reference comm layer (ref: deepspeed/comm/comm.py
+module-level collectives :222-512, init_distributed :604, TorchBackend
+comm/torch.py:100). Design translation per SURVEY §2.4: process bootstrap
+is `jax.distributed.initialize`; device collectives are XLA ops taken
+inside jit over mesh axis names (psum/all_gather/reduce_scatter/
+all_to_all/ppermute on ICI/DCN); "process groups" are mesh axes. The
+host-side control plane (barrier, metadata broadcast) uses
+jax.experimental.multihost_utils. The profiling decorator/`log_summary`
+layer carries over nearly unchanged (see logger.py).
+"""
+
+import os
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..utils.logging import logger
+from .logger import comms_logger
+
+_initialized = False
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    timeout_seconds: int = 300,
+) -> None:
+    """Bootstrap multi-controller JAX (ref: comm.py:604 init_distributed).
+
+    On TPU pods the runtime env provides discovery, so all args may be
+    None; single-process runs are a no-op. Mirrors the reference's
+    env-var fallback (MASTER_ADDR/RANK/WORLD_SIZE) for generic clusters.
+    """
+    global _initialized
+    if _initialized:
+        logger.debug("init_distributed called twice; ignoring")
+        return
+    # env:// style discovery first (honoring torchrun-era variable names) —
+    # this must run BEFORE any backend-initializing call like
+    # jax.process_count(), or jax.distributed.initialize would fail.
+    if coordinator_address is None and "MASTER_ADDR" in os.environ:
+        port = os.environ.get("MASTER_PORT", "29500")
+        coordinator_address = f"{os.environ['MASTER_ADDR']}:{port}"
+        num_processes = num_processes or int(os.environ.get("WORLD_SIZE", "1"))
+        process_id = process_id if process_id is not None else int(os.environ.get("RANK", "0"))
+    if coordinator_address is not None:
+        if num_processes is None or process_id is None:
+            raise ValueError(
+                "init_distributed: explicit coordinator_address requires "
+                "num_processes and process_id (or set WORLD_SIZE/RANK env vars)"
+            )
+        if num_processes > 1:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                initialization_timeout=timeout_seconds,
+            )
+    # else: TPU-pod runtime env (or single process) — jax bootstraps itself.
+    _initialized = True
+
+
+def is_initialized() -> bool:
+    """True once init_distributed has run (or the runtime pre-bootstrapped
+    a multi-process world)."""
+    if _initialized:
+        return True
+    try:
+        from jax._src import distributed as _jd
+
+        return _jd.global_state.client is not None
+    except Exception:
+        return False
+
+
+def get_rank() -> int:
+    """Process (host) index — NOT per-device rank; JAX is multi-controller."""
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    """Global device count (the analog of the reference's world size,
+    which is one rank per accelerator)."""
+    return jax.device_count()
+
+
+def get_process_count() -> int:
+    return jax.process_count()
+
+
+def get_local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def barrier(name: str = "barrier") -> None:
+    """Cross-host sync (ref: comm.py barrier)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
+def broadcast_host(value, src: int = 0):
+    """Host-side metadata broadcast (ref: comm.py broadcast for small CPU
+    tensors). Single-host: identity."""
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(value, is_source=get_rank() == src)
+
+
+# ---------------------------------------------------------------------------
+# In-jit device collectives over mesh axis names.
+#
+# These are the XLA analogs of the reference module-level ops
+# (comm.py:222-512). They are functional, must be called inside jit /
+# shard_map with the named axis bound, and record volume in the comms
+# logger at trace time.
+# ---------------------------------------------------------------------------
+
+AxisName = Union[str, Sequence[str]]
+
+
+def _log(op: str, x, axis_name: AxisName):
+    try:
+        vol = int(np.prod(x.shape)) * x.dtype.itemsize
+    except Exception:
+        vol = 0
+    comms_logger.record(op, vol, axis_name)
+
+
+def all_reduce(x, axis_name: AxisName, op: str = "sum"):
+    """ref: comm.py all_reduce:480 → lax.psum/pmax/pmin/pmean on ICI."""
+    _log("all_reduce", x, axis_name)
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "mean":
+        return lax.pmean(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+def all_gather(x, axis_name: AxisName, axis: int = 0, tiled: bool = True):
+    """ref: comm.py all_gather_into_tensor:320."""
+    _log("all_gather", x, axis_name)
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: AxisName, scatter_axis: int = 0):
+    """ref: comm.py reduce_scatter_tensor:257."""
+    _log("reduce_scatter", x, axis_name)
+    return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_axis, tiled=True)
+
+
+def all_to_all(x, axis_name: AxisName, split_axis: int, concat_axis: int):
+    """ref: comm.py all_to_all_single:344 — the Ulysses/MoE primitive."""
+    _log("all_to_all", x, axis_name)
+    return lax.all_to_all(x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
+
+
+def ppermute(x, axis_name: AxisName, perm):
+    """ref: comm.py send/recv:420-470 — point-to-point becomes a
+    collective-permute ring step on TPU."""
+    _log("ppermute", x, axis_name)
+    return lax.ppermute(x, axis_name, perm)
+
+
+def broadcast(x, axis_name: AxisName, src: int = 0):
+    """ref: comm.py broadcast:222 — implemented as select+psum inside jit."""
+    _log("broadcast", x, axis_name)
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def axis_index(axis_name: AxisName):
+    return lax.axis_index(axis_name)
+
+
+def log_summary():
+    """ref: comm.py:422 log_summary."""
+    comms_logger.log_summary()

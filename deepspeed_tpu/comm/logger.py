@@ -1,0 +1,66 @@
+"""Comms volume/bandwidth logger.
+
+TPU-native analog of the reference comms logging
+(ref: deepspeed/utils/comms_logging.py CommsLogger:67 + calc_bw_log:34
+and the timed_op decorator comm/comm.py:101-141). Under XLA, individual
+collectives cannot be host-timed inside a compiled step, so this logger
+records *trace-time* op counts and message volumes (exact, from shapes)
+per (op, axis) bucket; bandwidth figures come from dividing recorded
+volume by measured step time at the engine level.
+"""
+
+from collections import defaultdict
+from typing import Dict, Tuple
+
+from ..utils.logging import logger
+
+
+class CommsLogger:
+    def __init__(self):
+        self.enabled = False
+        self.verbose = False
+        self._records: Dict[Tuple[str, str], Dict[str, float]] = defaultdict(
+            lambda: {"count": 0, "volume": 0}
+        )
+
+    def configure(self, enabled: bool = False, verbose: bool = False):
+        self.enabled = enabled
+        self.verbose = verbose
+
+    def record(self, op_name: str, volume_bytes: int, axis_name):
+        if not self.enabled:
+            return
+        key = (op_name, str(axis_name))
+        rec = self._records[key]
+        rec["count"] += 1
+        rec["volume"] += volume_bytes
+        if self.verbose:
+            logger.info(
+                f"comm: {op_name} over axis={axis_name} "
+                f"msg={volume_bytes / 2**20:.2f}MiB (trace-time)"
+            )
+
+    def reset(self):
+        self._records.clear()
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        return {f"{op}@{ax}": dict(rec) for (op, ax), rec in self._records.items()}
+
+    def total_volume(self) -> int:
+        return int(sum(rec["volume"] for rec in self._records.values()))
+
+    def log_summary(self):
+        """ref: comms_logging.py log_summary — per-op table."""
+        if not self._records:
+            logger.info("comms summary: no collectives recorded")
+            return
+        lines = ["comms summary (trace-time counts per compiled step):"]
+        lines.append(f"{'op':<16}{'axis':<18}{'count':>8}{'volume':>14}")
+        for (op, ax), rec in sorted(self._records.items()):
+            lines.append(
+                f"{op:<16}{ax:<18}{int(rec['count']):>8}{rec['volume'] / 2**20:>11.2f}MiB"
+            )
+        logger.info("\n".join(lines))
+
+
+comms_logger = CommsLogger()

@@ -1,0 +1,8 @@
+from .config import (
+    DeepSpeedTPUConfig,
+    MeshConfig,
+    OffloadConfig,
+    ZeroConfig,
+    ZeroStage,
+    parse_config,
+)

@@ -1,0 +1,281 @@
+"""Config system: one JSON/dict → typed config tree.
+
+TPU-native analog of the reference config plumbing
+(ref: runtime/config.py DeepSpeedConfig, runtime/config_utils.py
+DeepSpeedConfigModel). Uses pydantic v2. Field names intentionally match
+the reference JSON schema (train_micro_batch_size_per_gpu, zero_optimization,
+bf16/fp16 blocks, optimizer/scheduler type+params) so configs written for
+the reference parse here; batch-triangle resolution reproduces
+runtime/config.py's train/micro/GAS coupling with the data-parallel world
+size coming from the mesh rather than torch.distributed.
+"""
+
+import json
+from enum import IntEnum
+from typing import Any, Dict, Optional, Union
+
+from pydantic import BaseModel, ConfigDict, Field, model_validator
+
+
+class ConfigModel(BaseModel):
+    """Base for all config blocks (ref: config_utils.py DeepSpeedConfigModel)."""
+
+    model_config = ConfigDict(extra="forbid", validate_assignment=True, populate_by_name=True)
+
+
+class ZeroStage(IntEnum):
+    disabled = 0
+    optimizer_states = 1  # shard optimizer state over 'data'
+    gradients = 2  # + reduce-scatter grads
+    weights = 3  # + shard parameters
+
+
+class OffloadDevice:
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class OffloadConfig(ConfigModel):
+    """ref: runtime/zero/offload_config.py"""
+
+    device: str = OffloadDevice.none
+    nvme_path: Optional[str] = None
+    buffer_count: int = 5
+    pin_memory: bool = False
+
+
+class ZeroConfig(ConfigModel):
+    """ref: runtime/zero/config.py DeepSpeedZeroConfig:83"""
+
+    stage: int = 0
+    # ZeRO-3 persistence threshold: params smaller than this stay replicated
+    # (ref: stage3 param_persistence_threshold / parameter_offload.py:242).
+    param_persistence_threshold: int = 10_000
+    # Sub-mesh ("MiCS"/hpZ-style) sharding: shard params over groups of this
+    # size and replicate across groups (ref: runtime/zero/mics.py:64,
+    # zero_hpz_partition_size config.py:264).
+    zero_hpz_partition_size: int = 0  # 0 = full data-axis sharding
+    # ZeRO++ quantized collectives (ref: zero/config.py:268/:280).
+    zero_quantized_weights: bool = False
+    zero_quantized_gradients: bool = False
+    offload_optimizer: OffloadConfig = Field(default_factory=OffloadConfig)
+    offload_param: OffloadConfig = Field(default_factory=OffloadConfig)
+    # Reduce-scatter grads in the accumulation loop (stage>=2 semantics knob).
+    overlap_comm: bool = True
+    contiguous_gradients: bool = True
+
+
+class BF16Config(ConfigModel):
+    """ref: runtime/config.py bf16 block"""
+
+    enabled: bool = False
+    # Keep a fp32 master copy partitioned ZeRO-1 style (ref: bf16_optimizer.py:30).
+    master_weights: bool = True
+
+
+class FP16Config(ConfigModel):
+    """ref: runtime/fp16/loss_scaler.py DynamicLossScaler + config keys"""
+
+    enabled: bool = False
+    loss_scale: float = 0.0  # 0 = dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+
+
+class OptimizerConfig(ConfigModel):
+    """ref: runtime/config.py optimizer block → ops/adam etc."""
+
+    type: str = "adamw"
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class SchedulerConfig(ConfigModel):
+    """ref: runtime/lr_schedules.py"""
+
+    type: Optional[str] = None
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class MeshConfig(ConfigModel):
+    """Parallel topology — the analog of PipeModelDataParallelTopology
+    (ref: runtime/pipe/topology.py:244) expressed as mesh axis sizes.
+    -1 on exactly one axis means "all remaining devices"."""
+
+    pipe: int = 1
+    data: int = -1
+    expert: int = 1
+    seq: int = 1
+    model: int = 1
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {"pipe": self.pipe, "data": self.data, "expert": self.expert,
+                "seq": self.seq, "model": self.model}
+
+
+class ActivationCheckpointingConfig(ConfigModel):
+    """ref: runtime/activation_checkpointing/config.py:94"""
+
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    number_checkpoints: Optional[int] = None
+    # jax.checkpoint policy name: 'nothing' | 'dots' | 'dots_no_batch' | 'everything'
+    policy: str = "nothing"
+
+
+class CommsLoggerConfig(ConfigModel):
+    """ref: deepspeed/utils/comms_logging.py + comm config"""
+
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+
+
+class FlopsProfilerConfig(ConfigModel):
+    """ref: deepspeed/profiling/config.py"""
+
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class MonitorConfig(ConfigModel):
+    """ref: deepspeed/monitor/config.py"""
+
+    enabled: bool = False
+    tensorboard: Dict[str, Any] = Field(default_factory=dict)
+    csv_monitor: Dict[str, Any] = Field(default_factory=dict)
+    wandb: Dict[str, Any] = Field(default_factory=dict)
+
+
+class CheckpointConfig(ConfigModel):
+    """ref: runtime/checkpoint_engine + engine save/load knobs"""
+
+    use_node_local_storage: bool = False
+    load_universal: bool = False
+    async_save: bool = False
+
+
+class DeepSpeedTPUConfig(ConfigModel):
+    """The full config tree (ref: runtime/config.py DeepSpeedConfig)."""
+
+    train_batch_size: Optional[int] = None
+    train_micro_batch_size_per_gpu: Optional[int] = None
+    gradient_accumulation_steps: Optional[int] = None
+
+    steps_per_print: int = 10
+    wall_clock_breakdown: bool = False
+    gradient_clipping: float = 0.0
+    prescale_gradients: bool = False
+    seed: int = 1234
+
+    optimizer: OptimizerConfig = Field(default_factory=OptimizerConfig)
+    scheduler: SchedulerConfig = Field(default_factory=SchedulerConfig)
+    zero_optimization: ZeroConfig = Field(default_factory=ZeroConfig)
+    bf16: BF16Config = Field(default_factory=BF16Config)
+    fp16: FP16Config = Field(default_factory=FP16Config)
+    mesh: MeshConfig = Field(default_factory=MeshConfig)
+    activation_checkpointing: ActivationCheckpointingConfig = Field(
+        default_factory=ActivationCheckpointingConfig
+    )
+    comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
+    flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
+    monitor: MonitorConfig = Field(default_factory=MonitorConfig)
+    checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
+
+    @model_validator(mode="after")
+    def _check_precision(self):
+        if self.bf16.enabled and self.fp16.enabled:
+            raise ValueError("bf16 and fp16 cannot both be enabled")
+        return self
+
+    # --- batch triangle (ref: runtime/config.py batch assertions) --------
+    def resolve_batch_sizes(self, dp_world_size: int) -> None:
+        """Solve train = micro × GAS × dp_world, filling in missing values.
+
+        Reproduces the reference's resolution order: given any two of
+        (train, micro, GAS) derive the third; given one, assume the others.
+        """
+        train, micro, gas = (
+            self.train_batch_size,
+            self.train_micro_batch_size_per_gpu,
+            self.gradient_accumulation_steps,
+        )
+        if train is not None and micro is not None and gas is None:
+            if train % (micro * dp_world_size) != 0:
+                raise ValueError(
+                    f"train_batch_size {train} not divisible by micro*dp = "
+                    f"{micro}*{dp_world_size}"
+                )
+            gas = train // (micro * dp_world_size)
+        elif train is not None and gas is not None and micro is None:
+            if train % (gas * dp_world_size) != 0:
+                raise ValueError(
+                    f"train_batch_size {train} not divisible by gas*dp = "
+                    f"{gas}*{dp_world_size}"
+                )
+            micro = train // (gas * dp_world_size)
+        elif micro is not None:
+            gas = gas or 1
+            train = train or micro * gas * dp_world_size
+        elif train is not None:
+            gas = gas or 1
+            if train % (gas * dp_world_size) != 0:
+                raise ValueError(
+                    f"train_batch_size {train} not divisible by gas*dp = "
+                    f"{gas}*{dp_world_size}"
+                )
+            micro = train // (gas * dp_world_size)
+        else:
+            raise ValueError(
+                "config must set at least one of train_batch_size / "
+                "train_micro_batch_size_per_gpu"
+            )
+        if train != micro * gas * dp_world_size:
+            raise ValueError(
+                f"batch triangle inconsistent: train={train} != micro={micro} "
+                f"× gas={gas} × dp={dp_world_size}"
+            )
+        self.train_batch_size = train
+        self.train_micro_batch_size_per_gpu = micro
+        self.gradient_accumulation_steps = gas
+
+    # --- convenience ----------------------------------------------------
+    @property
+    def zero_stage(self) -> int:
+        return self.zero_optimization.stage
+
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+
+        if self.bf16.enabled:
+            return jnp.bfloat16
+        if self.fp16.enabled:
+            return jnp.float16
+        return jnp.float32
+
+
+def parse_config(config: Union[str, Dict[str, Any], DeepSpeedTPUConfig, None]) -> DeepSpeedTPUConfig:
+    """Accept a path to a JSON file, a dict, or an already-built config."""
+    if config is None:
+        return DeepSpeedTPUConfig()
+    if isinstance(config, DeepSpeedTPUConfig):
+        return config
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    if not isinstance(config, dict):
+        raise TypeError(f"config must be path/dict/DeepSpeedTPUConfig, got {type(config)}")
+    # Tolerate a few reference-era keys that have no TPU meaning.
+    config = dict(config)
+    for legacy in ("zero_allow_untested_optimizer", "communication_data_type",
+                   "sparse_gradients", "amp", "dump_state", "memory_breakdown"):
+        config.pop(legacy, None)
+    return DeepSpeedTPUConfig(**config)

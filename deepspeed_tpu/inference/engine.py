@@ -1,0 +1,14 @@
+"""Inference engine (ref: deepspeed/inference/engine.py InferenceEngine:39,
+deepspeed/__init__.py init_inference:268).
+
+The TP-sharded decode engine with paged KV cache lands in a later
+milestone of this build (SURVEY §7 step 7); until then init_inference
+fails loudly rather than pretending.
+"""
+
+
+def init_inference(*args, **kwargs):
+    raise NotImplementedError(
+        "deepspeed_tpu.init_inference: the inference engine is not built yet "
+        "in this snapshot — training API (deepspeed_tpu.initialize) is live."
+    )

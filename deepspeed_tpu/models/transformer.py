@@ -1,0 +1,377 @@
+"""Decoder-only transformer model family (GPT-2-class and Llama-class).
+
+The in-tree reference models for the framework, playing the role of the
+reference's test/bench models (ref: tests/unit/simple_model.py and the
+model_implementations zoo). TPU-first design decisions:
+
+- pure-functional params dict (no module system) with *logical axis
+  names* per leaf — the sharding-rules table (parallel/sharding.py) maps
+  these to mesh axes, which is this framework's AutoTP
+  (ref: module_inject/auto_tp.py).
+- layers stacked on a leading 'layers' dim and executed with `lax.scan`
+  → O(1) compile time in depth, XLA-friendly.
+- Ulysses sequence parallelism is two sharding constraints around
+  attention (seq-sharded ↔ head-sharded); XLA inserts the all-to-all
+  pair that the reference does by hand (ref: deepspeed/sequence/layer.py
+  _SeqAllToAll:44, DistributedAttention:60).
+- activation checkpointing = jax.checkpoint policy on the scanned layer
+  body (ref: runtime/activation_checkpointing/checkpointing.py:989).
+- GQA (n_kv_heads < n_heads), rotary embeddings, RMSNorm, SwiGLU for the
+  Llama variant; learned positions, LayerNorm, gelu for GPT-2.
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import causal_attention
+
+DP = ("data", "expert")
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: Optional[int] = None  # GQA; None = MHA
+    d_model: int = 512
+    d_ff: Optional[int] = None  # default: 4x (gpt2) or llama 8/3 rounding
+    max_seq: int = 2048
+    variant: str = "llama"  # "llama" | "gpt2"
+    dropout: float = 0.0
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = True
+    remat: str = "none"  # none | full | dots (jax.checkpoint policy)
+    use_flash: bool = True  # pallas flash attention on TPU, XLA fallback elsewhere
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    @property
+    def ff_dim(self) -> int:
+        if self.d_ff is not None:
+            return self.d_ff
+        if self.variant == "llama":
+            d = int(self.d_model * 8 / 3)
+            return ((d + 127) // 128) * 128
+        return 4 * self.d_model
+
+    def flops_per_token(self, seq_len: Optional[int] = None) -> float:
+        """Train-step matmul FLOPs per token for MFU accounting:
+        6*N (fwd+bwd over all params) + causal attention term
+        6*L*S*E (QK^T and AV each contribute ~S*E fwd flops/token under
+        the causal mask; backward doubles it)."""
+        S = seq_len or self.max_seq
+        n = param_count(self)
+        return 6.0 * n + 6.0 * self.n_layers * S * self.d_model
+
+
+def param_count(cfg: TransformerConfig) -> int:
+    shapes = jax.tree.leaves(jax.eval_shape(lambda k: init(cfg, k), jax.random.PRNGKey(0)))
+    return sum(int(jnp.prod(jnp.array(s.shape))) for s in shapes)
+
+
+# ---------------------------------------------------------------------------
+# params + logical specs
+# ---------------------------------------------------------------------------
+
+def _layer_shapes(cfg: TransformerConfig) -> Dict[str, Tuple[Tuple[int, ...], Tuple]]:
+    """name -> (shape-without-layer-dim, logical axes-without-layer-dim)"""
+    E, H, KV, D, F = cfg.d_model, cfg.n_heads, cfg.kv_heads, cfg.head_dim, cfg.ff_dim
+    shapes = {
+        "ln1_scale": ((E,), ("embed",)),
+        "ln2_scale": ((E,), ("embed",)),
+        "wq": ((E, H, D), ("embed", "heads", "head_dim")),
+        "wk": ((E, KV, D), ("embed", "heads", "head_dim")),
+        "wv": ((E, KV, D), ("embed", "heads", "head_dim")),
+        "wo": ((H, D, E), ("heads", "head_dim", "embed")),
+        "w_in": ((E, F), ("embed", "mlp")),
+        "w_out": ((F, E), ("mlp", "embed")),
+    }
+    if cfg.variant == "llama":
+        shapes["w_gate"] = ((E, F), ("embed", "mlp"))
+    else:
+        shapes.update({
+            "ln1_bias": ((E,), ("embed",)),
+            "ln2_bias": ((E,), ("embed",)),
+            "b_in": ((F,), ("mlp",)),
+            "b_out": ((E,), ("embed",)),
+            "bq": ((H, D), ("heads", "head_dim")),
+            "bk": ((KV, D), ("heads", "head_dim")),
+            "bv": ((KV, D), ("heads", "head_dim")),
+            "bo": ((E,), ("embed",)),
+        })
+    return shapes
+
+
+def init(cfg: TransformerConfig, rng) -> Dict[str, Any]:
+    E, V, L = cfg.d_model, cfg.vocab_size, cfg.n_layers
+    keys = jax.random.split(rng, 16)
+    std = 0.02
+
+    def norm_init(shape, scale_name):
+        return jnp.ones(shape, jnp.float32) if "scale" in scale_name else jnp.zeros(shape, jnp.float32)
+
+    params: Dict[str, Any] = {
+        "embed": jax.random.normal(keys[0], (V, E), jnp.float32) * std,
+        "ln_f_scale": jnp.ones((E,), jnp.float32),
+    }
+    if cfg.variant == "gpt2":
+        params["pos_embed"] = jax.random.normal(keys[1], (cfg.max_seq, E), jnp.float32) * std
+        params["ln_f_bias"] = jnp.zeros((E,), jnp.float32)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = jax.random.normal(keys[2], (E, V), jnp.float32) * std
+
+    layers = {}
+    lkeys = jax.random.split(keys[3], len(_layer_shapes(cfg)))
+    for i, (name, (shape, _)) in enumerate(sorted(_layer_shapes(cfg).items())):
+        full = (L,) + shape
+        if "ln" in name:
+            layers[name] = jnp.broadcast_to(norm_init(shape, name), full).copy()
+        elif name.startswith("b"):
+            layers[name] = jnp.zeros(full, jnp.float32)
+        else:
+            scale = std / (2 * L) ** 0.5 if name in ("wo", "w_out") else std
+            layers[name] = jax.random.normal(lkeys[i], full, jnp.float32) * scale
+    params["layers"] = layers
+    return params
+
+
+def logical_specs(cfg: TransformerConfig) -> Dict[str, Any]:
+    specs: Dict[str, Any] = {
+        "embed": ("vocab", "embed"),
+        "ln_f_scale": ("embed",),
+    }
+    if cfg.variant == "gpt2":
+        specs["pos_embed"] = (None, "embed")
+        specs["ln_f_bias"] = ("embed",)
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = ("embed", "vocab")
+    specs["layers"] = {
+        name: ("layers",) + logical for name, (_, logical) in _layer_shapes(cfg).items()
+    }
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _norm(x, scale, bias, cfg: TransformerConfig):
+    x32 = x.astype(jnp.float32)
+    if cfg.variant == "llama":
+        rms = jax.lax.rsqrt(jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + cfg.norm_eps)
+        out = x32 * rms * scale
+    else:
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        out = (x32 - mean) * jax.lax.rsqrt(var + cfg.norm_eps) * scale + bias
+    return out.astype(x.dtype)
+
+
+def _rope(q, k, cfg: TransformerConfig, offset: int = 0):
+    """Rotary embeddings (ref kernel: csrc/transformer/inference/csrc/
+    apply_rotary_pos_emb.cu — on TPU this is pure VPU code XLA fuses)."""
+    D = cfg.head_dim
+    S = q.shape[1]
+    pos = jnp.arange(offset, offset + S, dtype=jnp.float32)
+    freqs = cfg.rope_theta ** (-jnp.arange(0, D // 2, dtype=jnp.float32) / (D // 2))
+    angles = pos[:, None] * freqs[None, :]  # [S, D/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+
+    def rot(x):
+        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        c = cos[None, :, None, :]
+        s = sin[None, :, None, :]
+        return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+def _shard(x, *spec):
+    """Sharding constraint against the ambient mesh (set by the engine via
+    jax.sharding.set_mesh). Outside any mesh context — e.g. a plain
+    single-device forward — constraints are skipped explicitly; inside a
+    mesh context a bad spec raises rather than silently degrading."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return x
+    return jax.lax.with_sharding_constraint(x, P(*spec))
+
+
+def _dropout(x, rate: float, rng):
+    """Inverted dropout (ref kernel: csrc/transformer/dropout_kernels.cu —
+    on TPU this fuses into the surrounding elementwise ops)."""
+    if rate <= 0.0 or rng is None:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+
+def _attention_block(x, lp, cfg: TransformerConfig, rng=None):
+    B, S, E = x.shape
+    h = _norm(x, lp["ln1_scale"], lp.get("ln1_bias"), cfg)
+    q = jnp.einsum("bse,ehd->bshd", h, lp["wq"].astype(x.dtype))
+    k = jnp.einsum("bse,ehd->bshd", h, lp["wk"].astype(x.dtype))
+    v = jnp.einsum("bse,ehd->bshd", h, lp["wv"].astype(x.dtype))
+    if cfg.variant == "gpt2":
+        q = q + lp["bq"].astype(x.dtype)
+        k = k + lp["bk"].astype(x.dtype)
+        v = v + lp["bv"].astype(x.dtype)
+    else:
+        q, k = _rope(q, k, cfg)
+
+    # Ulysses: re-shard seq→heads around attention; XLA emits the
+    # all-to-all pair (ref: sequence/layer.py single_all_to_all:15).
+    q = _shard(q, DP, None, ("model", "seq"), None)
+    k = _shard(k, DP, None, ("model", "seq"), None)
+    v = _shard(v, DP, None, ("model", "seq"), None)
+
+    out = causal_attention(q, k, v, use_flash=cfg.use_flash)  # [B,S,H,D]
+
+    out = _shard(out, DP, "seq", "model", None)
+    out = jnp.einsum("bshd,hde->bse", out, lp["wo"].astype(x.dtype))
+    if cfg.variant == "gpt2":
+        out = out + lp["bo"].astype(x.dtype)
+    out = _dropout(out, cfg.dropout, rng)
+    return x + out
+
+
+def _mlp_block(x, lp, cfg: TransformerConfig, rng=None):
+    h = _norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg)
+    if cfg.variant == "llama":
+        gate = jnp.einsum("bse,ef->bsf", h, lp["w_gate"].astype(x.dtype))
+        up = jnp.einsum("bse,ef->bsf", h, lp["w_in"].astype(x.dtype))
+        inner = jax.nn.silu(gate) * up
+    else:
+        inner = jax.nn.gelu(
+            jnp.einsum("bse,ef->bsf", h, lp["w_in"].astype(x.dtype)) + lp["b_in"].astype(x.dtype)
+        )
+    inner = _shard(inner, DP, "seq", "model")
+    out = jnp.einsum("bsf,fe->bse", inner, lp["w_out"].astype(x.dtype))
+    if cfg.variant == "gpt2":
+        out = out + lp["b_out"].astype(x.dtype)
+    out = _dropout(out, cfg.dropout, rng)
+    return x + out
+
+
+_REMAT_POLICIES = {
+    "none": None,
+    "full": None,  # full remat = jax.checkpoint with default policy
+    "dots": "dots_with_no_batch_dims_saveable",
+}
+
+
+def forward_hidden(params: Dict[str, Any], tokens, cfg: TransformerConfig, rng=None):
+    """tokens [B, S] int32 → final hidden states [B, S, E] (post ln_f)."""
+    x = params["embed"][tokens]
+    x = _shard(x, DP, "seq", None)
+    if cfg.variant == "gpt2":
+        x = x + params["pos_embed"][: tokens.shape[1]].astype(x.dtype)
+
+    use_dropout = cfg.dropout > 0.0 and rng is not None
+
+    def layer_body(carry, xs):
+        if use_dropout:
+            h0, (lp, layer_rng) = carry, xs
+            r1, r2 = jax.random.split(layer_rng)
+        else:
+            h0, lp = carry, xs
+            r1 = r2 = None
+        h = _attention_block(h0, lp, cfg, r1)
+        h = _mlp_block(h, lp, cfg, r2)
+        h = _shard(h, DP, "seq", None)
+        return h, None
+
+    if cfg.remat == "full":
+        layer_body = jax.checkpoint(layer_body)
+    elif cfg.remat == "dots":
+        layer_body = jax.checkpoint(
+            layer_body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+
+    if use_dropout:
+        layer_rngs = jax.random.split(rng, cfg.n_layers)
+        x, _ = jax.lax.scan(layer_body, x, (params["layers"], layer_rngs))
+    else:
+        x, _ = jax.lax.scan(layer_body, x, params["layers"])
+    return _norm(x, params["ln_f_scale"], params.get("ln_f_bias"), cfg)
+
+
+def forward(params: Dict[str, Any], tokens, cfg: TransformerConfig, rng=None):
+    """tokens [B, S] int32 → logits [B, S, V]."""
+    x = forward_hidden(params, tokens, cfg, rng)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bse,ev->bsv", x, head.astype(x.dtype))
+    return _shard(logits, DP, "seq", "model")
+
+
+def _chunked_ce(x, head, targets, mask, n_chunks: int):
+    """Cross-entropy without materializing [B,S,V] through backward.
+
+    The per-chunk logits+logsumexp are rematerialized in bwd
+    (jax.checkpoint), so peak memory is [B, S/n_chunks, V] — the TPU
+    analog of the reference's fused softmax-xent kernels
+    (ref: csrc/transformer softmax_kernels.cu), achieved with remat
+    instead of a handwritten kernel.
+    Returns (sum_nll, sum_mask)."""
+    B, S, E = x.shape
+    C = S // n_chunks
+
+    @jax.checkpoint
+    def chunk(x_c, t_c, m_c):
+        logits = jnp.einsum("bce,ev->bcv", x_c, head.astype(x_c.dtype))
+        logits = _shard(logits, DP, None, "model").astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, t_c[..., None], axis=-1)[..., 0]
+        nll = (lse - tgt) * m_c
+        return jnp.sum(nll), jnp.sum(m_c)
+
+    def body(carry, xs):
+        tot, cnt = carry
+        x_c, t_c, m_c = xs
+        s, c = chunk(x_c, t_c, m_c)
+        return (tot + s, cnt + c), None
+
+    xs = (
+        x.reshape(B, n_chunks, C, E).swapaxes(0, 1),
+        targets.reshape(B, n_chunks, C).swapaxes(0, 1),
+        mask.reshape(B, n_chunks, C).swapaxes(0, 1),
+    )
+    (tot, cnt), _ = jax.lax.scan(body, (jnp.float32(0.0), jnp.float32(0.0)), xs)
+    return tot, cnt
+
+
+def make_loss_fn(cfg: TransformerConfig, loss_chunks: int = 8):
+    """Next-token cross-entropy over batch {"tokens": [B, S(+1)]}.
+
+    loss_chunks: sequence-chunked CE (memory: [B, S/chunks, V] instead of
+    [B, S, V]); 1 disables chunking."""
+
+    def loss_fn(params, batch, rng):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        x = forward_hidden(params, inputs, cfg, rng)
+        head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+        mask = (
+            batch["mask"][:, 1:].astype(jnp.float32)
+            if "mask" in batch
+            else jnp.ones(targets.shape, jnp.float32)
+        )
+        n = loss_chunks if inputs.shape[1] % max(loss_chunks, 1) == 0 else 1
+        tot, cnt = _chunked_ce(x, head, targets, mask, max(n, 1))
+        return tot / jnp.maximum(cnt, 1.0)
+
+    return loss_fn

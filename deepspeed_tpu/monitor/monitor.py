@@ -1,0 +1,120 @@
+"""Metric event sinks.
+
+TPU-native analog of the reference monitor subsystem
+(ref: deepspeed/monitor/monitor.py Monitor ABC:13 + MonitorMaster:29
+fanning out to tensorboard.py / wandb.py / csv_monitor.py). The event
+contract is identical: a list of (name, value, step) tuples; only
+process 0 writes.
+"""
+
+import csv
+import os
+from typing import List, Optional, Tuple
+
+import jax
+
+from ..config.config import MonitorConfig
+from ..utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+    enabled = False
+
+    def write_events(self, events: List[Event]) -> None:
+        raise NotImplementedError
+
+
+class CsvMonitor(Monitor):
+    """ref: monitor/csv_monitor.py — one csv per metric name."""
+
+    def __init__(self, output_path: str, job_name: str = "DeepSpeedTPUJob"):
+        self.enabled = True
+        self.dir = os.path.join(output_path, job_name)
+        os.makedirs(self.dir, exist_ok=True)
+        self._files = {}
+
+    def write_events(self, events: List[Event]) -> None:
+        for name, value, step in events:
+            fname = os.path.join(self.dir, name.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", name])
+                w.writerow([step, float(value)])
+
+
+class TensorBoardMonitor(Monitor):
+    """ref: monitor/tensorboard.py — gated on the library being present."""
+
+    def __init__(self, output_path: str, job_name: str = "DeepSpeedTPUJob"):
+        try:
+            from torch.utils.tensorboard import SummaryWriter  # torch-cpu is in the image
+
+            self.writer = SummaryWriter(log_dir=os.path.join(output_path, job_name))
+            self.enabled = True
+        except Exception as e:
+            logger.warning(f"tensorboard unavailable ({e}); monitor disabled")
+            self.enabled = False
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in events:
+            self.writer.add_scalar(name, float(value), step)
+        self.writer.flush()
+
+
+class WandbMonitor(Monitor):
+    """ref: monitor/wandb.py — stubbed unless wandb is importable."""
+
+    def __init__(self, **kwargs):
+        try:
+            import wandb
+
+            wandb.init(**{k: v for k, v in kwargs.items() if k in ("project", "group", "team")})
+            self._wandb = wandb
+            self.enabled = True
+        except Exception:
+            logger.warning("wandb unavailable; monitor disabled")
+            self.enabled = False
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in events:
+            self._wandb.log({name: value}, step=step)
+
+
+class MonitorMaster(Monitor):
+    """Fan-out to all configured sinks (ref: monitor/monitor.py:29)."""
+
+    def __init__(self, config: Optional[MonitorConfig]):
+        self.sinks: List[Monitor] = []
+        self.enabled = False
+        if config is None or not config.enabled or jax.process_index() != 0:
+            return
+        if config.csv_monitor.get("enabled"):
+            self.sinks.append(
+                CsvMonitor(
+                    config.csv_monitor.get("output_path", "./ds_tpu_logs"),
+                    config.csv_monitor.get("job_name", "DeepSpeedTPUJob"),
+                )
+            )
+        if config.tensorboard.get("enabled"):
+            self.sinks.append(
+                TensorBoardMonitor(
+                    config.tensorboard.get("output_path", "./ds_tpu_tb"),
+                    config.tensorboard.get("job_name", "DeepSpeedTPUJob"),
+                )
+            )
+        if config.wandb.get("enabled"):
+            self.sinks.append(WandbMonitor(**config.wandb))
+        self.enabled = any(s.enabled for s in self.sinks)
+
+    def write_events(self, events: List[Event]) -> None:
+        for s in self.sinks:
+            if s.enabled:
+                s.write_events(events)

@@ -1,0 +1,1 @@
+from .optimizers import adagrad, adam, build_optimizer, lamb, lion, sgd

@@ -1,0 +1,85 @@
+"""Attention ops.
+
+TPU-native analog of the reference's fused attention kernels
+(ref: csrc/transformer/ softmax/transform kernels for training,
+csrc/transformer/inference/csrc/softmax.cu for decode). Two paths:
+
+- `_xla_attention`: pure-jnp reference, used on CPU (the fake-mesh test
+  platform) and as the numerics oracle in tests — the analog of the
+  reference's torch-reference checks in tests/unit/ops.
+- Pallas flash attention (ops/pallas/flash_attention.py): the TPU hot
+  path, flash-style tiling in VMEM; selected when running on TPU and
+  `use_flash=True`.
+
+Layout is [batch, seq, heads, head_dim]; GQA is handled by repeating KV
+heads (XLA turns the repeat into an indexing pattern, not a copy).
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _repeat_kv(k, n_rep: int):
+    if n_rep == 1:
+        return k
+    B, S, KV, D = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (B, S, KV, n_rep, D)).reshape(B, S, KV * n_rep, D)
+
+
+def _xla_attention(q, k, v, causal: bool = True):
+    B, S, H, D = q.shape
+    scale = 1.0 / (D**0.5)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    if causal:
+        Sk = k.shape[1]
+        mask = jnp.tril(jnp.ones((S, Sk), bool), k=Sk - S)
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _load_flash():
+    """Resolve the Pallas flash kernel once; returns None (with a visible
+    warning) when unavailable so fallback is explicit, never silent."""
+    global _flash_fn, _flash_resolved
+    if _flash_resolved:
+        return _flash_fn
+    _flash_resolved = True
+    try:
+        from .pallas.flash_attention import flash_attention
+
+        _flash_fn = flash_attention
+    except ImportError as e:
+        from ..utils.logging import warning_once
+
+        warning_once(f"Pallas flash attention unavailable ({e}); using XLA attention")
+        _flash_fn = None
+    return _flash_fn
+
+
+_flash_fn = None
+_flash_resolved = False
+
+
+def causal_attention(q, k, v, use_flash: bool = True):
+    """Causal self-attention, [B,S,H,D] x [B,S,KV,D] -> [B,S,H,D]."""
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    if use_flash and q.shape[1] >= 256 and _on_tpu():
+        flash = _load_flash()
+        if flash is not None:
+            return flash(q, k, v, causal=True)
+    return _xla_attention(q, k, v, causal=True)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu",)
+    except Exception:
+        return False

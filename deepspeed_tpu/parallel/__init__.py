@@ -1,0 +1,9 @@
+from .sharding import (
+    DEFAULT_LOGICAL_RULES,
+    batch_spec,
+    constraint,
+    logical_to_mesh_spec,
+    make_rules,
+    tree_logical_to_mesh,
+    tree_shardings,
+)

@@ -1,0 +1,141 @@
+"""Logical-axis sharding rules — the AutoTP analog.
+
+The reference shards HF models by graph-walking Linear layers and slicing
+rows/cols (ref: deepspeed/module_inject/auto_tp.py:188 AutoTP,
+ReplaceWithTensorSlicing:30) or by per-model policy classes. TPU-first,
+the same capability is a *rules table*: model parameters carry logical
+axis names ("embed", "heads", "mlp", "vocab", ...) and one table maps
+logical names → mesh axes. Changing the parallelism layout = changing
+the table, no model surgery.
+"""
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MeshAxes = Union[None, str, Tuple[str, ...]]
+
+# Default rules table. Megatron-style TP: attention heads and the MLP
+# hidden dim are sharded over 'model' (column-parallel first matmul /
+# row-parallel second is what XLA derives from these specs); the vocab /
+# embedding table is sharded over 'model' like the reference's
+# VocabParallelEmbedding contract; batch rides the data axes; sequence
+# rides 'seq' (Ulysses).
+DEFAULT_LOGICAL_RULES: List[Tuple[str, MeshAxes]] = [
+    ("batch", ("data", "expert")),
+    ("seq", "seq"),
+    ("embed", None),
+    ("heads", "model"),
+    ("head_dim", None),
+    ("mlp", "model"),
+    ("vocab", "model"),
+    ("expert", "expert"),
+    ("expert_mlp", "model"),
+    ("kv_length", None),
+    ("layers", None),  # stacked-layer leading dim (scan-over-layers)
+]
+
+
+def make_rules(overrides: Optional[Dict[str, MeshAxes]] = None) -> Dict[str, MeshAxes]:
+    rules = dict(DEFAULT_LOGICAL_RULES)
+    if overrides:
+        rules.update(overrides)
+    return rules
+
+
+def logical_to_mesh_spec(
+    logical_spec: Sequence[Optional[str]],
+    rules: Dict[str, MeshAxes],
+    mesh: Mesh,
+    shape: Optional[Sequence[int]] = None,
+) -> P:
+    """Map one logical PartitionSpec to a mesh PartitionSpec.
+
+    A logical axis maps to None if the rules say so, if its mesh axis has
+    size 1, or (when `shape` is given) if the dim isn't divisible by the
+    mesh-axis size — e.g. 2 GQA kv-heads under model=4 fall back to
+    replicated instead of failing at jit time.
+    """
+    out = []
+    used = set()
+    for i, name in enumerate(logical_spec):
+        if name is None:
+            out.append(None)
+            continue
+        mapped = rules.get(name, None)
+        if mapped is None:
+            out.append(None)
+            continue
+        if isinstance(mapped, str):
+            mapped = (mapped,)
+        live = tuple(ax for ax in mapped if mesh.shape.get(ax, 1) > 1 and ax not in used)
+        if shape is not None and live:
+            import numpy as np
+
+            total = int(np.prod([mesh.shape[ax] for ax in live]))
+            if shape[i] % total != 0:
+                live = ()
+        used.update(live)
+        if not live:
+            out.append(None)
+        elif len(live) == 1:
+            out.append(live[0])
+        else:
+            out.append(live)
+    # Trim trailing Nones for canonical form.
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def tree_logical_to_mesh(
+    logical_specs,  # pytree of tuple-of-logical-names (or PartitionSpec of names)
+    rules: Dict[str, MeshAxes],
+    mesh: Mesh,
+    shapes=None,  # matching pytree of shape tuples (enables divisibility guard)
+):
+    """Map a whole pytree of logical specs to mesh PartitionSpecs."""
+    is_spec = lambda x: isinstance(x, (tuple, P)) and all(
+        s is None or isinstance(s, str) for s in x
+    )
+    if shapes is None:
+        return jax.tree.map(
+            lambda spec: logical_to_mesh_spec(tuple(spec), rules, mesh),
+            logical_specs,
+            is_leaf=is_spec,
+        )
+    return jax.tree.map(
+        lambda spec, shp: logical_to_mesh_spec(tuple(spec), rules, mesh, shape=shp),
+        logical_specs,
+        shapes,
+        is_leaf=is_spec,
+    )
+
+
+def tree_shardings(specs, mesh: Mesh):
+    """PartitionSpec pytree → NamedSharding pytree."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+def constraint(x, spec: P, mesh: Mesh):
+    """with_sharding_constraint under an explicit mesh."""
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def batch_spec(batch_leaf_ndim: int, *, leading_accum_dim: bool = False) -> P:
+    """Canonical spec for an input-batch leaf: [(gas,) batch, seq, ...].
+
+    Batch dim shards over data+expert; sequence dim over 'seq'.
+    """
+    dims: List[MeshAxes] = []
+    if leading_accum_dim:
+        dims.append(None)
+    dims.append(("data", "expert"))
+    if batch_leaf_ndim > len(dims):
+        dims.append("seq")
+    while len(dims) < batch_leaf_ndim:
+        dims.append(None)
+    return P(*dims[:batch_leaf_ndim])

@@ -1,0 +1,2 @@
+from .accelerator import Accelerator, get_accelerator
+from .mesh import MESH_AXES, build_mesh, data_parallel_size, resolve_axis_sizes, single_device_mesh

@@ -1,0 +1,87 @@
+"""Data loading.
+
+TPU-native analog of the reference dataloader layer
+(ref: runtime/dataloader.py DeepSpeedDataLoader + RepeatingLoader).
+The engine consumes *global* host batches (it shards them onto the mesh
+itself), so the loader's job is batching/iteration, not device placement.
+Works with any indexable dataset of pytrees (numpy arrays / dicts).
+"""
+
+from typing import Any, Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+def default_collate(items: Sequence[Any]):
+    """Stack a list of pytree samples into one batched pytree."""
+    import jax
+
+    return jax.tree.map(lambda *xs: np.stack(xs), *items)
+
+
+class DeepSpeedTPUDataLoader:
+    """Batching iterator over an indexable dataset.
+
+    ref contract: runtime/dataloader.py DeepSpeedDataLoader — batch size
+    comes from the engine config (train_batch_size for the global loop),
+    optional shuffling with a deterministic seed per epoch, drop_last
+    semantics matching the reference.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        shuffle: bool = False,
+        seed: int = 0,
+        drop_last: bool = True,
+        collate_fn: Optional[Callable] = None,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or default_collate
+        self.epoch = 0
+        if len(dataset) < batch_size:
+            raise ValueError(
+                f"dataset ({len(dataset)}) smaller than one global batch ({batch_size})"
+            )
+
+    def __len__(self) -> int:
+        n = len(self.dataset) // self.batch_size
+        if not self.drop_last and len(self.dataset) % self.batch_size:
+            n += 1
+        return n
+
+    def __iter__(self) -> Iterator[Any]:
+        idx = np.arange(len(self.dataset))
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(idx)
+        self.epoch += 1
+        for start in range(0, len(idx), self.batch_size):
+            chunk = idx[start : start + self.batch_size]
+            if len(chunk) < self.batch_size and self.drop_last:
+                return
+            yield self.collate_fn([self.dataset[int(i)] for i in chunk])
+
+
+class RepeatingLoader:
+    """Wrap any iterable to restart on StopIteration
+    (ref: runtime/dataloader.py RepeatingLoader)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self._iter = iter(loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._iter)
+        except StopIteration:
+            self._iter = iter(self.loader)
+            return next(self._iter)

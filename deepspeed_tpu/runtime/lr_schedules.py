@@ -1,0 +1,159 @@
+"""LR schedules.
+
+TPU-native analog of the reference schedules (ref: runtime/lr_schedules.py
+— LRRangeTest:267, OneCycle:370, WarmupLR:634, WarmupDecayLR:723,
+WarmupCosineLR:774). Implemented as pure `step -> lr` functions so they
+trace into the compiled train step (no host-side `.step()` object); the
+same names and param keys as the reference JSON schema.
+"""
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+
+Schedule = Callable[[Any], Any]  # step (traced int) -> lr (traced float)
+
+
+def constant(lr: float) -> Schedule:
+    return lambda step: jnp.asarray(lr, jnp.float32)
+
+
+def warmup_lr(
+    warmup_min_lr: float = 0.0,
+    warmup_max_lr: float = 1e-3,
+    warmup_num_steps: int = 1000,
+    warmup_type: str = "log",
+) -> Schedule:
+    """ref: lr_schedules.py:634 WarmupLR (log or linear warmup, then flat)."""
+
+    def f(step):
+        step = jnp.asarray(step, jnp.float32)
+        frac = jnp.clip(step / max(warmup_num_steps, 1), 0.0, 1.0)
+        if warmup_type == "log":
+            # log-spaced interpolation as in the reference
+            frac = jnp.where(step > 0, jnp.log1p(step) / math.log1p(max(warmup_num_steps, 1)), 0.0)
+            frac = jnp.clip(frac, 0.0, 1.0)
+        return warmup_min_lr + (warmup_max_lr - warmup_min_lr) * frac
+
+    return f
+
+
+def warmup_decay_lr(
+    total_num_steps: int,
+    warmup_min_lr: float = 0.0,
+    warmup_max_lr: float = 1e-3,
+    warmup_num_steps: int = 1000,
+    warmup_type: str = "log",
+) -> Schedule:
+    """ref: lr_schedules.py:723 WarmupDecayLR (warmup then linear decay to 0)."""
+    warm = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+
+    def f(step):
+        step_f = jnp.asarray(step, jnp.float32)
+        decay = jnp.clip(
+            (total_num_steps - step_f) / max(total_num_steps - warmup_num_steps, 1), 0.0, 1.0
+        )
+        return jnp.where(step_f < warmup_num_steps, warm(step), warmup_max_lr * decay)
+
+    return f
+
+
+def warmup_cosine_lr(
+    total_num_steps: int,
+    warmup_min_ratio: float = 0.0,
+    warmup_num_steps: int = 1000,
+    cos_min_ratio: float = 1e-4,
+    lr: float = 1e-3,
+) -> Schedule:
+    """ref: lr_schedules.py:774 WarmupCosineLR."""
+
+    def f(step):
+        step_f = jnp.asarray(step, jnp.float32)
+        warm_frac = warmup_min_ratio + (1 - warmup_min_ratio) * jnp.clip(
+            step_f / max(warmup_num_steps, 1), 0.0, 1.0
+        )
+        progress = jnp.clip(
+            (step_f - warmup_num_steps) / max(total_num_steps - warmup_num_steps, 1), 0.0, 1.0
+        )
+        cos_frac = cos_min_ratio + (1 - cos_min_ratio) * 0.5 * (1 + jnp.cos(jnp.pi * progress))
+        return lr * jnp.where(step_f < warmup_num_steps, warm_frac, cos_frac)
+
+    return f
+
+
+def one_cycle(
+    cycle_min_lr: float,
+    cycle_max_lr: float,
+    cycle_first_step_size: int = 2000,
+    cycle_second_step_size: Optional[int] = None,
+    decay_step_size: int = 0,
+    decay_lr_rate: float = 0.0,
+    **_ignored,
+) -> Schedule:
+    """ref: lr_schedules.py:370 OneCycle (triangular up/down then decay)."""
+    second = cycle_second_step_size if cycle_second_step_size is not None else cycle_first_step_size
+    cycle_len = cycle_first_step_size + second
+
+    def f(step):
+        step_f = jnp.asarray(step, jnp.float32)
+        up = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * (step_f / max(cycle_first_step_size, 1))
+        down = cycle_max_lr - (cycle_max_lr - cycle_min_lr) * (
+            (step_f - cycle_first_step_size) / max(second, 1)
+        )
+        post = step_f - cycle_len
+        decayed = cycle_min_lr
+        if decay_step_size > 0:
+            decayed = cycle_min_lr / (1.0 + decay_lr_rate * jnp.floor(post / decay_step_size))
+        in_up = step_f < cycle_first_step_size
+        in_down = step_f < cycle_len
+        return jnp.where(in_up, up, jnp.where(in_down, down, decayed))
+
+    return f
+
+
+def lr_range_test(
+    lr_range_test_min_lr: float = 1e-3,
+    lr_range_test_step_size: int = 2000,
+    lr_range_test_step_rate: float = 1.0,
+    lr_range_test_staircase: bool = False,
+) -> Schedule:
+    """ref: lr_schedules.py:267 LRRangeTest."""
+
+    def f(step):
+        step_f = jnp.asarray(step, jnp.float32)
+        interval = step_f / max(lr_range_test_step_size, 1)
+        if lr_range_test_staircase:
+            interval = jnp.floor(interval)
+        return lr_range_test_min_lr * (1.0 + interval * lr_range_test_step_rate)
+
+    return f
+
+
+_REGISTRY: Dict[str, Callable[..., Schedule]] = {
+    "warmuplr": warmup_lr,
+    "warmupdecaylr": warmup_decay_lr,
+    "warmupcosinelr": warmup_cosine_lr,
+    "onecycle": one_cycle,
+    "lrrangetest": lr_range_test,
+    "constant": lambda lr=1e-3, **_: constant(lr),
+}
+
+
+def build_schedule(
+    type_name: Optional[str], params: Optional[Dict[str, Any]] = None, base_lr: float = 1e-3
+) -> Schedule:
+    """Build from config (ref: runtime/config.py scheduler block). With no
+    scheduler configured, a constant schedule at the optimizer lr."""
+    if type_name is None:
+        return constant(base_lr)
+    key = type_name.lower().replace("_", "")
+    if key not in _REGISTRY:
+        raise ValueError(f"unknown scheduler '{type_name}'; available: {sorted(_REGISTRY)}")
+    params = dict(params or {})
+    if key in ("warmupcosinelr", "constant"):
+        # The reference WarmupCosineLR scales the *optimizer's* lr
+        # (lr_schedules.py get_lr → org_lr * ratio); honor optimizer.params.lr
+        # unless the scheduler block overrides it.
+        params.setdefault("lr", base_lr)
+    return _REGISTRY[key](**params)

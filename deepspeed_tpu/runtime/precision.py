@@ -1,0 +1,94 @@
+"""Mixed precision: dynamic loss scaling for fp16, master-weight policy.
+
+TPU-native analog of the reference precision machinery
+(ref: runtime/fp16/loss_scaler.py DynamicLossScaler, runtime/
+fp16/fused_optimizer.py FP16_Optimizer overflow handling,
+runtime/bf16_optimizer.py BF16_Optimizer master-weight linkage).
+On TPU the recommended low-precision dtype is bf16 (no scaler needed);
+fp16 + dynamic scaling is provided for numerics parity. The scaler is a
+pure-array state machine so it lives inside the compiled train step —
+overflow check, skip-update, and scale adjustment are all traced
+(no host round-trip per step, unlike the reference's `.item()` checks).
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config.config import FP16Config
+
+
+class LossScaleState(NamedTuple):
+    scale: jnp.ndarray  # f32 scalar
+    good_steps: jnp.ndarray  # i32 — consecutive overflow-free steps
+    hysteresis_left: jnp.ndarray  # i32
+
+
+def init_loss_scale(cfg: FP16Config) -> LossScaleState:
+    if cfg.loss_scale and cfg.loss_scale > 0:
+        scale = float(cfg.loss_scale)  # static scale
+    else:
+        scale = float(2.0**cfg.initial_scale_power)
+    return LossScaleState(
+        scale=jnp.asarray(scale, jnp.float32),
+        good_steps=jnp.asarray(0, jnp.int32),
+        hysteresis_left=jnp.asarray(cfg.hysteresis, jnp.int32),
+    )
+
+
+def found_inf_in_grads(grads) -> jnp.ndarray:
+    """Global overflow flag (ref: fused_optimizer.py overflow check via
+    _check_overflow). All-finite reduction fuses into the grad epilogue."""
+    leaves = jax.tree.leaves(grads)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(g))) for g in leaves]
+    out = flags[0]
+    for f in flags[1:]:
+        out = jnp.logical_or(out, f)
+    return out
+
+
+def update_loss_scale(
+    state: LossScaleState, found_inf: jnp.ndarray, cfg: FP16Config
+) -> LossScaleState:
+    """ref: loss_scaler.py DynamicLossScaler.update_scale — halve on
+    overflow (after hysteresis), double after `loss_scale_window` good steps."""
+    if cfg.loss_scale and cfg.loss_scale > 0:
+        return state  # static scale never moves
+    hyst = jnp.where(found_inf, state.hysteresis_left - 1, jnp.asarray(cfg.hysteresis, jnp.int32))
+    do_backoff = jnp.logical_and(found_inf, hyst <= 0)
+    new_scale = jnp.where(
+        do_backoff,
+        jnp.maximum(state.scale / 2.0, cfg.min_loss_scale),
+        state.scale,
+    )
+    good = jnp.where(found_inf, 0, state.good_steps + 1)
+    do_grow = good >= cfg.loss_scale_window
+    new_scale = jnp.where(do_grow, new_scale * 2.0, new_scale)
+    good = jnp.where(do_grow, 0, good)
+    hyst = jnp.where(do_backoff, jnp.asarray(cfg.hysteresis, jnp.int32), hyst)
+    return LossScaleState(scale=new_scale, good_steps=good, hysteresis_left=hyst)
+
+
+def cast_params(params, dtype):
+    """Cast float leaves only (embedding tables of ints etc. untouched)."""
+    return jax.tree.map(
+        lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p, params
+    )
+
+
+def global_grad_norm(grads) -> jnp.ndarray:
+    """L2 norm over the whole grad pytree (ref: engine/stage3 global-norm
+    computation). Under jit+SPMD the per-shard partial sums are combined
+    by XLA automatically."""
+    leaves = jax.tree.leaves(grads)
+    total = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+    return jnp.sqrt(total)
+
+
+def clip_grads_by_global_norm(grads, max_norm: float, grad_norm: jnp.ndarray):
+    """ref: runtime/utils clip_grad_norm_ equivalent; no-op when max_norm<=0."""
+    if max_norm <= 0:
+        return grads
+    factor = jnp.minimum(1.0, max_norm / (grad_norm + 1e-6))
+    return jax.tree.map(lambda g: g * factor.astype(g.dtype), grads)

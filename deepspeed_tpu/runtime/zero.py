@@ -1,0 +1,160 @@
+"""ZeRO stages as sharding derivation.
+
+TPU-native redesign of the reference ZeRO machinery
+(ref: runtime/zero/stage_1_and_2.py DeepSpeedZeroOptimizer:97,
+runtime/zero/stage3.py DeepSpeedZeroOptimizer_Stage3:75,
+runtime/zero/partition_parameters.py zero.Init:780). Per SURVEY §7, the
+~6k LoC of hook/bucket/coordinator machinery collapses on TPU into
+*where each array lives on the mesh*:
+
+  stage 1 — optimizer state (fp32 master + moments) carries an extra
+            'data'-axis sharding; params stay replicated over 'data'.
+            XLA emits the reduce-scatter/all-gather pair around the
+            sharded update that the reference does by hand
+            (stage_1_and_2.py:1811 step / all_gather_into_tensor).
+  stage 2 — gradients are additionally *constrained* to the sharded
+            layout at the accumulation boundary, so XLA reduce-scatters
+            grads instead of all-reducing them
+            (ref: stage_1_and_2.py:923 IPG bucketing → one annotation).
+  stage 3 — parameters themselves are *stored* sharded over 'data';
+            XLA's SPMD partitioner inserts the per-use all-gathers that
+            the reference's prefetch coordinator
+            (partitioned_param_coordinator.py:261 fetch_sub_module)
+            schedules manually. Small params stay replicated below
+            `param_persistence_threshold`
+            (ref: parameter_offload.py:242 persistent params).
+
+MiCS / ZeRO++ hpZ sub-grouping (ref: zero/mics.py:64, config.py:264)
+maps to sharding over a *sub-axis* of 'data'; offload tiering and
+quantized collectives live in their own modules.
+"""
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..config.config import ZeroConfig
+
+# ZeRO shards over the data axis. The expert axis already shards expert
+# params; MoE expert leaves get 'data' added on top of their 'expert' dim.
+ZERO_AXIS = "data"
+
+
+def _spec_dims(spec: P, rank: int):
+    dims = list(spec) + [None] * (rank - len(spec))
+    return dims[:rank]
+
+
+def _axes_of(entry):
+    if entry is None:
+        return ()
+    if isinstance(entry, str):
+        return (entry,)
+    return tuple(entry)
+
+
+def zero_shard_spec(
+    spec: P,
+    shape,
+    mesh: Mesh,
+    min_size: int = 0,
+    axis: str = ZERO_AXIS,
+) -> P:
+    """Add `axis` to the best dimension of one leaf's PartitionSpec.
+
+    Picks the largest dim that (a) is not already sharded, (b) is
+    divisible by the axis size after accounting for existing sharding.
+    Leaves smaller than `min_size` elements stay untouched (the
+    persistence-threshold analog). Returns the original spec when no dim
+    qualifies — those leaves stay replicated over 'data', which is
+    exactly the reference's persistent-param behavior.
+    """
+    axis_n = mesh.shape.get(axis, 1)
+    if axis_n <= 1:
+        return spec
+    size = int(np.prod(shape)) if len(shape) else 1
+    if size < max(min_size, axis_n) or len(shape) == 0:
+        return spec
+    dims = _spec_dims(spec, len(shape))
+    if any(axis in _axes_of(d) for d in dims):
+        return spec  # already zero-sharded
+    best, best_len = None, 0
+    for i, d in enumerate(shape):
+        existing = int(np.prod([mesh.shape[a] for a in _axes_of(dims[i])])) if dims[i] else 1
+        local = d // existing
+        if local % axis_n != 0:
+            continue
+        if local > best_len:
+            best, best_len = i, local
+    if best is None:
+        return spec
+    cur = _axes_of(dims[best])
+    dims[best] = cur + (axis,) if cur else axis
+    while dims and dims[-1] is None:
+        dims.pop()
+    return P(*dims)
+
+
+def derive_param_storage_specs(param_specs, shapes, mesh: Mesh, zero_config: ZeroConfig):
+    """Specs for how parameters are *stored* between steps.
+
+    stage < 3: TP spec as-is (replicated over 'data').
+    stage 3:   + 'data' sharding on leaves above the persistence threshold.
+    """
+    if zero_config.stage < 3:
+        return param_specs
+    return jax.tree.map(
+        lambda spec, shp: zero_shard_spec(
+            spec, shp, mesh, min_size=zero_config.param_persistence_threshold
+        ),
+        param_specs,
+        shapes,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def derive_optimizer_specs(param_specs, shapes, mesh: Mesh, zero_config: ZeroConfig):
+    """Specs for optimizer state (fp32 master + moments).
+
+    stage >= 1: sharded over 'data' (the ZeRO-1 partition,
+    ref: stage_1_and_2.py flattened param-group partitioning). No
+    persistence threshold — the reference partitions *all* optimizer
+    state; tiny leaves that don't divide simply stay replicated.
+    """
+    if zero_config.stage < 1:
+        return param_specs
+    return jax.tree.map(
+        lambda spec, shp: zero_shard_spec(spec, shp, mesh, min_size=0),
+        param_specs,
+        shapes,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def derive_grad_specs(param_specs, opt_specs, zero_config: ZeroConfig):
+    """Specs gradients are constrained to at the accumulation boundary.
+
+    stage >= 2: the sharded (optimizer) layout → XLA reduce-scatters
+    (ref: stage_1_and_2.py average_tensor:1033 reduce-scatter path).
+    stage < 2:  the param layout → plain all-reduce semantics.
+    """
+    return opt_specs if zero_config.stage >= 2 else param_specs
+
+
+def validate_no_conflicts(specs) -> None:
+    """Debug-mode check: no spec uses one mesh axis twice (the sharding
+    analog of the reference's safe_mode re-derivation,
+    ref: stage3.py:1249 __reduce_and_partition_ipg_grads(safe_mode))."""
+
+    def check(spec):
+        seen = []
+        for entry in spec:
+            for ax in _axes_of(entry):
+                if ax in seen:
+                    raise ValueError(f"mesh axis {ax} used twice in {spec}")
+                seen.append(ax)
+        return spec
+
+    jax.tree.map(check, specs, is_leaf=lambda x: isinstance(x, P))

@@ -1,0 +1,2 @@
+from .logging import log_dist, logger
+from .timers import SynchronizedWallClockTimer, ThroughputTimer, see_memory_usage

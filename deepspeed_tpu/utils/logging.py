@@ -1,0 +1,52 @@
+"""Rank-aware logging.
+
+TPU-native analog of the reference logger utilities
+(ref: deepspeed/utils/logging.py) — `logger` plus `log_dist(ranks=...)`
+filtered by the JAX process index instead of torch.distributed rank.
+"""
+
+import logging
+import os
+import sys
+
+_LOG_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s"
+
+
+def _create_logger(name: str = "deepspeed_tpu", level=None) -> logging.Logger:
+    lg = logging.getLogger(name)
+    if lg.handlers:
+        return lg
+    lg.setLevel(os.environ.get("DS_TPU_LOG_LEVEL", "INFO").upper() if level is None else level)
+    lg.propagate = False
+    handler = logging.StreamHandler(stream=sys.stdout)
+    handler.setFormatter(logging.Formatter(_LOG_FORMAT))
+    lg.addHandler(handler)
+    return lg
+
+
+logger = _create_logger()
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message: str, ranks=None, level=logging.INFO) -> None:
+    """Log `message` only on the listed process indices (None / [-1] = all).
+
+    Mirrors the reference `log_dist` contract (deepspeed/utils/logging.py).
+    """
+    my_rank = _process_index()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def warning_once(message: str, _seen=set()) -> None:
+    if message not in _seen:
+        _seen.add(message)
+        logger.warning(message)

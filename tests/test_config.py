@@ -1,0 +1,96 @@
+"""Config tests (ref model: tests/unit/runtime test of config parsing +
+batch triangle assertions in runtime/config.py)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.config import DeepSpeedTPUConfig, parse_config
+
+
+def test_defaults():
+    cfg = parse_config({})
+    assert cfg.zero_stage == 0
+    assert not cfg.bf16.enabled
+    assert cfg.gradient_clipping == 0.0
+
+
+def test_batch_triangle_all_given():
+    cfg = parse_config(
+        {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2,
+         "gradient_accumulation_steps": 2}
+    )
+    cfg.resolve_batch_sizes(dp_world_size=8)
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_triangle_derive_gas():
+    cfg = parse_config({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2})
+    cfg.resolve_batch_sizes(dp_world_size=8)
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_triangle_derive_micro():
+    cfg = parse_config({"train_batch_size": 32, "gradient_accumulation_steps": 2})
+    cfg.resolve_batch_sizes(dp_world_size=8)
+    assert cfg.train_micro_batch_size_per_gpu == 2
+
+
+def test_batch_triangle_derive_train():
+    cfg = parse_config({"train_micro_batch_size_per_gpu": 4})
+    cfg.resolve_batch_sizes(dp_world_size=8)
+    assert cfg.train_batch_size == 32
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_triangle_inconsistent():
+    cfg = parse_config(
+        {"train_batch_size": 30, "train_micro_batch_size_per_gpu": 2,
+         "gradient_accumulation_steps": 2}
+    )
+    with pytest.raises(ValueError):
+        cfg.resolve_batch_sizes(dp_world_size=8)
+
+
+def test_batch_triangle_nothing_given():
+    cfg = parse_config({})
+    with pytest.raises(ValueError):
+        cfg.resolve_batch_sizes(dp_world_size=8)
+
+
+def test_precision_exclusive():
+    with pytest.raises(Exception):
+        parse_config({"bf16": {"enabled": True}, "fp16": {"enabled": True}})
+
+
+def test_json_file_roundtrip(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps({
+        "train_micro_batch_size_per_gpu": 1,
+        "zero_optimization": {"stage": 3, "param_persistence_threshold": 100},
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "AdamW", "params": {"lr": 2e-4, "betas": [0.9, 0.95]}},
+    }))
+    cfg = parse_config(str(p))
+    assert cfg.zero_optimization.stage == 3
+    assert cfg.zero_optimization.param_persistence_threshold == 100
+    assert cfg.optimizer.type == "AdamW"
+
+
+def test_reference_legacy_keys_tolerated():
+    cfg = parse_config({"train_micro_batch_size_per_gpu": 1,
+                        "zero_allow_untested_optimizer": True,
+                        "communication_data_type": "fp16"})
+    assert cfg.train_micro_batch_size_per_gpu == 1
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(Exception):
+        parse_config({"train_micro_batch_sized_per_gpu": 1})
+
+
+def test_mesh_config():
+    cfg = parse_config({"train_micro_batch_size_per_gpu": 1,
+                        "mesh": {"data": 2, "model": 4}})
+    sizes = cfg.mesh.axis_sizes()
+    assert sizes["model"] == 4 and sizes["data"] == 2 and sizes["pipe"] == 1

@@ -1,0 +1,125 @@
+"""Flash attention numerics vs the jnp oracle (ref model: tests/unit/ops
+kernel-vs-torch-reference checks). On CPU the Pallas kernel runs in
+interpret-compatible lowering only on TPU, so here we exercise the bwd
+math (pure XLA) and the wrapper paths; the kernel itself is covered by
+the same tests when run on TPU hardware (pytest -m tpu lane) and by
+scripts/tpu_kernel_check.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.attention import _xla_attention, causal_attention
+from deepspeed_tpu.ops.pallas.flash_attention import _flash_bwd, _flash_fwd, flash_attention
+
+ON_TPU = jax.devices()[0].platform == "tpu"
+
+
+def make_qkv(rng, B=2, S=128, H=2, D=64, dtype=jnp.float32):
+    q = jnp.asarray(rng.normal(size=(B, S, H, D)), dtype)
+    k = jnp.asarray(rng.normal(size=(B, S, H, D)), dtype)
+    v = jnp.asarray(rng.normal(size=(B, S, H, D)), dtype)
+    return q, k, v
+
+
+def oracle_bh(q, k, v, causal=True):
+    """[BH,S,D] oracle attention."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        S = q.shape[1]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask[None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p.astype(q.dtype), v)
+
+
+class TestBackwardMath:
+    """_flash_bwd (blocked, from lse) must match autodiff of the oracle."""
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_grads_match_oracle(self, rng, causal):
+        # TPU f32 matmuls default to bf16-passes; pin full precision so the
+        # 2e-4 tolerance holds on both platforms
+        with jax.default_matmul_precision("highest"):
+            self._run(rng, causal)
+
+    def _run(self, rng, causal):
+        BH, S, D = 3, 96, 64
+        q = jnp.asarray(rng.normal(size=(BH, S, D)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(BH, S, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(BH, S, D)), jnp.float32)
+        do = jnp.asarray(rng.normal(size=(BH, S, D)), jnp.float32)
+
+        def f(q, k, v):
+            return jnp.sum(oracle_bh(q, k, v, causal) * do)
+
+        dq_ref, dk_ref, dv_ref = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+        # lse from the oracle path
+        scale = 1.0 / (D**0.5)
+        s = jnp.einsum("bqd,bkd->bqk", q, k) * scale
+        if causal:
+            mask = jnp.tril(jnp.ones((S, S), bool))
+            s = jnp.where(mask[None], s, -1e30)
+        lse = jax.scipy.special.logsumexp(s, axis=-1)
+        o = oracle_bh(q, k, v, causal)
+
+        dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, causal, block_k=32)
+        np.testing.assert_allclose(dq, dq_ref, rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(dk, dk_ref, rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(dv, dv_ref, rtol=2e-4, atol=2e-4)
+
+
+class TestWrapper:
+    def test_gqa_repeat_matches_full(self, rng):
+        B, S, H, D = 2, 64, 4, 32
+        q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, S, 2, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, S, 2, D)), jnp.float32)
+        out = causal_attention(q, k, v, use_flash=False)
+        k_full = jnp.repeat(k, 2, axis=2)
+        v_full = jnp.repeat(v, 2, axis=2)
+        ref = causal_attention(q, k_full, v_full, use_flash=False)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_xla_attention_is_causal(self, rng):
+        B, S, H, D = 1, 16, 1, 8
+        q, k, v = make_qkv(rng, B, S, H, D)
+        with jax.default_matmul_precision("highest"):
+            out = _xla_attention(q, k, v, causal=True)
+        # first token attends only to itself
+        np.testing.assert_allclose(out[0, 0, 0], v[0, 0, 0], rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.skipif(not ON_TPU, reason="Pallas kernel requires TPU")
+class TestKernelOnTPU:
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("S", [256, 384])  # 384: padding path
+    def test_fwd_matches_oracle(self, rng, causal, S):
+        BH, D = 4, 64
+        q = jnp.asarray(rng.normal(size=(BH, S, D)), jnp.bfloat16)
+        k = jnp.asarray(rng.normal(size=(BH, S, D)), jnp.bfloat16)
+        v = jnp.asarray(rng.normal(size=(BH, S, D)), jnp.bfloat16)
+        o, lse = _flash_fwd(q, k, v, causal, 256, 256)
+        ref = oracle_bh(q, k, v, causal)
+        np.testing.assert_allclose(
+            np.asarray(o, np.float32), np.asarray(ref, np.float32), rtol=2e-2, atol=2e-2
+        )
+
+    def test_full_layer_grad(self, rng):
+        B, S, H, D = 2, 256, 2, 64
+        q, k, v = make_qkv(rng, B, S, H, D, jnp.bfloat16)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v).astype(jnp.float32) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(_xla_attention(q, k, v).astype(jnp.float32) ** 2)
+
+        g1 = jax.grad(loss_flash)(q, k, v)
+        g2 = jax.grad(loss_ref)(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(g1, np.float32), np.asarray(g2, np.float32), rtol=5e-2, atol=5e-2
+        )

@@ -1,0 +1,72 @@
+"""LR schedule tests (ref model: tests for runtime/lr_schedules.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (
+    build_schedule,
+    one_cycle,
+    warmup_cosine_lr,
+    warmup_decay_lr,
+    warmup_lr,
+)
+
+
+def f(sched, step):
+    return float(sched(jnp.int32(step)))
+
+
+def test_warmup_reaches_max():
+    s = warmup_lr(warmup_min_lr=0.0, warmup_max_lr=1e-2, warmup_num_steps=100)
+    assert f(s, 0) == pytest.approx(0.0, abs=1e-8)
+    assert f(s, 100) == pytest.approx(1e-2, rel=1e-5)
+    assert f(s, 1000) == pytest.approx(1e-2, rel=1e-5)
+
+
+def test_warmup_linear_monotone():
+    s = warmup_lr(warmup_max_lr=1e-2, warmup_num_steps=50, warmup_type="linear")
+    vals = [f(s, i) for i in range(0, 60, 10)]
+    assert all(b >= a for a, b in zip(vals, vals[1:]))
+
+
+def test_warmup_decay_hits_zero():
+    s = warmup_decay_lr(total_num_steps=200, warmup_max_lr=1e-2, warmup_num_steps=20)
+    assert f(s, 200) == pytest.approx(0.0, abs=1e-6)
+    assert f(s, 20) == pytest.approx(1e-2, rel=1e-4)
+
+
+def test_warmup_cosine_endpoints():
+    s = warmup_cosine_lr(total_num_steps=100, warmup_num_steps=10, lr=1e-2, cos_min_ratio=0.1)
+    assert f(s, 10) == pytest.approx(1e-2, rel=1e-3)
+    assert f(s, 100) == pytest.approx(1e-3, rel=1e-2)
+
+
+def test_one_cycle_shape():
+    s = one_cycle(cycle_min_lr=1e-4, cycle_max_lr=1e-2, cycle_first_step_size=10)
+    assert f(s, 0) == pytest.approx(1e-4, rel=1e-4)
+    assert f(s, 10) == pytest.approx(1e-2, rel=1e-4)
+    assert f(s, 20) == pytest.approx(1e-4, rel=1e-2)
+
+
+def test_build_schedule_reference_names():
+    s = build_schedule("WarmupLR", {"warmup_max_lr": 1e-3, "warmup_num_steps": 5})
+    assert f(s, 5) == pytest.approx(1e-3, rel=1e-4)
+
+
+def test_warmup_cosine_uses_optimizer_lr():
+    # reference semantics: WarmupCosineLR scales the optimizer lr
+    s = build_schedule(
+        "WarmupCosineLR", {"total_num_steps": 100, "warmup_num_steps": 10}, base_lr=6e-4
+    )
+    assert f(s, 10) == pytest.approx(6e-4, rel=1e-3)
+
+
+def test_build_schedule_none_is_constant():
+    s = build_schedule(None, base_lr=3e-4)
+    assert f(s, 0) == f(s, 1000) == pytest.approx(3e-4, rel=1e-6)
+
+
+def test_build_schedule_unknown():
+    with pytest.raises(ValueError):
+        build_schedule("NoSuchLR", {})

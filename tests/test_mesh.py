@@ -1,0 +1,44 @@
+"""Mesh/topology tests (ref model: tests for runtime/pipe/topology.py
+ProcessTopology — here axis-size resolution and mesh construction)."""
+
+import jax
+import pytest
+
+from deepspeed_tpu.platform.mesh import (
+    MESH_AXES,
+    build_mesh,
+    data_parallel_size,
+    resolve_axis_sizes,
+)
+
+
+def test_resolve_wildcard():
+    sizes = resolve_axis_sizes({"data": -1, "model": 2}, n_devices=8)
+    assert sizes["data"] == 4 and sizes["model"] == 2
+
+
+def test_resolve_exact():
+    sizes = resolve_axis_sizes({"data": 2, "model": 2, "seq": 2}, n_devices=8)
+    assert sizes["pipe"] == 1 and sizes["data"] == 2
+
+
+def test_resolve_mismatch():
+    with pytest.raises(ValueError):
+        resolve_axis_sizes({"data": 3}, n_devices=8)
+
+
+def test_resolve_two_wildcards():
+    with pytest.raises(ValueError):
+        resolve_axis_sizes({"data": -1, "model": -1}, n_devices=8)
+
+
+def test_build_mesh_axes():
+    mesh = build_mesh({"data": 4, "model": 2})
+    assert mesh.axis_names == MESH_AXES
+    assert mesh.shape["data"] == 4
+    assert mesh.size == 8
+
+
+def test_data_parallel_includes_expert():
+    mesh = build_mesh({"data": 2, "expert": 4})
+    assert data_parallel_size(mesh) == 8

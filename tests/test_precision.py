@@ -1,0 +1,80 @@
+"""Loss-scaler state machine tests (ref model: tests/unit/runtime/
+half_precision — DynamicLossScaler dynamics)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.config.config import FP16Config
+from deepspeed_tpu.runtime.precision import (
+    clip_grads_by_global_norm,
+    found_inf_in_grads,
+    global_grad_norm,
+    init_loss_scale,
+    update_loss_scale,
+)
+
+
+def cfg(**kw):
+    return FP16Config(enabled=True, **kw)
+
+
+def test_initial_scale():
+    s = init_loss_scale(cfg(initial_scale_power=8))
+    assert float(s.scale) == 256.0
+
+
+def test_backoff_on_overflow():
+    c = cfg(initial_scale_power=8, hysteresis=1)
+    s = init_loss_scale(c)
+    s = update_loss_scale(s, jnp.bool_(True), c)
+    assert float(s.scale) == 128.0
+
+
+def test_hysteresis_delays_backoff():
+    c = cfg(initial_scale_power=8, hysteresis=2)
+    s = init_loss_scale(c)
+    s = update_loss_scale(s, jnp.bool_(True), c)
+    assert float(s.scale) == 256.0  # first overflow burns hysteresis
+    s = update_loss_scale(s, jnp.bool_(True), c)
+    assert float(s.scale) == 128.0
+
+
+def test_growth_after_window():
+    c = cfg(initial_scale_power=8, loss_scale_window=3, hysteresis=1)
+    s = init_loss_scale(c)
+    for _ in range(3):
+        s = update_loss_scale(s, jnp.bool_(False), c)
+    assert float(s.scale) == 512.0
+
+
+def test_static_scale_never_moves():
+    c = cfg(loss_scale=1024.0)
+    s = init_loss_scale(c)
+    s = update_loss_scale(s, jnp.bool_(True), c)
+    assert float(s.scale) == 1024.0
+
+
+def test_min_loss_scale_floor():
+    c = cfg(initial_scale_power=1, hysteresis=1, min_loss_scale=1.0)
+    s = init_loss_scale(c)
+    for _ in range(5):
+        s = update_loss_scale(s, jnp.bool_(True), c)
+    assert float(s.scale) == 1.0
+
+
+def test_found_inf():
+    good = {"a": jnp.ones(3), "b": jnp.zeros(2)}
+    bad = {"a": jnp.array([1.0, jnp.inf]), "b": jnp.zeros(2)}
+    assert not bool(found_inf_in_grads(good))
+    assert bool(found_inf_in_grads(bad))
+
+
+def test_global_norm_and_clip():
+    grads = {"a": jnp.full((3,), 2.0), "b": jnp.full((4,), 2.0)}
+    n = global_grad_norm(grads)
+    np.testing.assert_allclose(float(n), (7 * 4.0) ** 0.5, rtol=1e-6)
+    clipped = clip_grads_by_global_norm(grads, 1.0, n)
+    np.testing.assert_allclose(float(global_grad_norm(clipped)), 1.0, rtol=1e-4)
+    # no-op when under the limit
+    same = clip_grads_by_global_norm(grads, 100.0, n)
+    np.testing.assert_allclose(same["a"], grads["a"], rtol=1e-6)

@@ -1,0 +1,109 @@
+"""Sharding-rule + ZeRO spec derivation tests (ref model:
+tests/unit/runtime/zero partitioning checks — here specs are the whole
+mechanism, so the tests assert the derived PartitionSpecs directly)."""
+
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.config.config import ZeroConfig
+from deepspeed_tpu.parallel.sharding import (
+    logical_to_mesh_spec,
+    make_rules,
+    tree_logical_to_mesh,
+)
+from deepspeed_tpu.platform.mesh import build_mesh
+from deepspeed_tpu.runtime.zero import (
+    derive_optimizer_specs,
+    derive_param_storage_specs,
+    zero_shard_spec,
+)
+
+
+def mesh_dp8():
+    return build_mesh({"data": 8})
+
+
+def mesh_dp4_tp2():
+    return build_mesh({"data": 4, "model": 2})
+
+
+class TestLogicalRules:
+    def test_basic_mapping(self):
+        rules = make_rules()
+        spec = logical_to_mesh_spec(("embed", "mlp"), rules, mesh_dp4_tp2())
+        assert spec == P(None, "model")
+
+    def test_size1_axis_dropped(self):
+        rules = make_rules()
+        spec = logical_to_mesh_spec(("embed", "mlp"), rules, mesh_dp8())
+        assert spec == P()  # model axis is size 1 → replicated
+
+    def test_no_duplicate_axis(self):
+        rules = make_rules()
+        # heads and mlp both map to model; a spec using both must not
+        # produce a duplicate mesh axis
+        spec = logical_to_mesh_spec(("heads", "mlp"), rules, mesh_dp4_tp2())
+        used = [s for s in spec if s is not None]
+        assert len(used) == 1
+
+    def test_override(self):
+        rules = make_rules({"mlp": None})
+        spec = logical_to_mesh_spec(("embed", "mlp"), rules, mesh_dp4_tp2())
+        assert spec == P()
+
+    def test_tree(self):
+        rules = make_rules()
+        tree = {"a": ("embed", "mlp"), "b": ("vocab", "embed")}
+        out = tree_logical_to_mesh(tree, rules, mesh_dp4_tp2())
+        assert out["a"] == P(None, "model")
+        assert out["b"] == P("model")
+
+
+class TestZeroShardSpec:
+    def test_picks_largest_divisible_dim(self):
+        spec = zero_shard_spec(P(), (4, 256), mesh_dp8())
+        assert spec == P(None, "data")
+
+    def test_respects_existing_tp(self):
+        # dim1 sharded by model(2): local 256/2=128 divisible by 8 → still
+        # largest; gets ('model','data')
+        spec = zero_shard_spec(P(None, "model"), (64, 256), mesh_dp4_tp2(), axis="data")
+        assert spec == P(None, ("model", "data"))
+
+    def test_small_leaf_stays_replicated(self):
+        spec = zero_shard_spec(P(), (4,), mesh_dp8(), min_size=100)
+        assert spec == P()
+
+    def test_indivisible_stays_replicated(self):
+        spec = zero_shard_spec(P(), (3, 5), mesh_dp8())
+        assert spec == P()
+
+    def test_noop_on_size1_axis(self):
+        mesh = build_mesh({"data": 1, "model": 8})
+        assert zero_shard_spec(P(), (256, 256), mesh) == P()
+
+
+class TestStageDerivation:
+    def shapes(self):
+        return {"w": (128, 256), "b": (7,)}
+
+    def specs(self):
+        return {"w": P(), "b": P()}
+
+    def test_stage0_keeps_specs(self):
+        z = ZeroConfig(stage=0)
+        out = derive_optimizer_specs(self.specs(), self.shapes(), mesh_dp8(), z)
+        assert out == self.specs()
+
+    def test_stage1_shards_opt_only(self):
+        z = ZeroConfig(stage=1)
+        opt = derive_optimizer_specs(self.specs(), self.shapes(), mesh_dp8(), z)
+        par = derive_param_storage_specs(self.specs(), self.shapes(), mesh_dp8(), z)
+        assert opt["w"] == P(None, "data")
+        assert opt["b"] == P()  # 7 elements, indivisible → replicated
+        assert par["w"] == P()
+
+    def test_stage3_shards_params(self):
+        z = ZeroConfig(stage=3, param_persistence_threshold=1000)
+        par = derive_param_storage_specs(self.specs(), self.shapes(), mesh_dp8(), z)
+        assert par["w"] == P(None, "data")
+        assert par["b"] == P()  # below persistence threshold

@@ -31,12 +31,18 @@ def main():
 
     if on_tpu:
         # ~350M-param Llama-style model: large matmuls that tile the MXU,
-        # bf16, remat to keep activations in HBM budget.
+        # bf16, remat to keep activations in HBM budget. head_dim=128
+        # (Llama's real head size) fills the full MXU lane width — at
+        # head_dim=64 every attention matmul runs half-wide (measured 2x
+        # slower, scripts/profile_bench.py).
+        # remat="full" beats "dots" here (measured 429 vs 445 ms/step):
+        # with the Pallas flash backward, recomputing the cheap elementwise
+        # layer body costs less than the HBM traffic of saving dot outputs.
         mcfg = T.TransformerConfig(
-            vocab_size=32000, n_layers=24, n_heads=16, d_model=1024,
-            max_seq=2048, variant="llama", remat="dots", use_flash=True,
+            vocab_size=32000, n_layers=24, n_heads=8, d_model=1024,
+            max_seq=2048, variant="llama", remat="full", use_flash=True,
         )
-        micro_bs, steps, warmup = 8, 10, 3
+        micro_bs, steps, warmup = 8, 16, 3
     else:
         mcfg = T.TransformerConfig(
             vocab_size=512, n_layers=2, n_heads=4, d_model=128,
@@ -63,14 +69,20 @@ def main():
     rng = np.random.default_rng(0)
     batch = {"tokens": rng.integers(0, mcfg.vocab_size, (engine.config.train_batch_size, seq + 1)).astype(np.int32)}
 
+    # async dispatch with one trailing sync: through the axon tunnel a
+    # host readback costs ~90ms, so per-step sync would poison the
+    # measurement (and on real multi-host TPU it would serialize steps).
+    def sync(m):
+        return {k: float(v) for k, v in jax.device_get(m).items()}
+
     for _ in range(warmup):
-        engine.train_batch(batch)
-    jax.effects_barrier()
+        m = engine.train_batch_async(batch)
+    sync(m)
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        m = engine.train_batch(batch)
-    jax.effects_barrier()
+        m = engine.train_batch_async(batch)
+    m = sync(m)
     dt = (time.perf_counter() - t0) / steps
 
     n_chips = jax.device_count()

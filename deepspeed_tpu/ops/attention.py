@@ -11,8 +11,9 @@ csrc/transformer/inference/csrc/softmax.cu for decode). Two paths:
   path, flash-style tiling in VMEM; selected when running on TPU and
   `use_flash=True`.
 
-Layout is [batch, seq, heads, head_dim]; GQA is handled by repeating KV
-heads (XLA turns the repeat into an indexing pattern, not a copy).
+Layout is [batch, seq, heads, head_dim]. GQA: the flash kernel consumes
+KV heads in place via BlockSpec index maps — callers must NOT pre-repeat
+KV heads; only the XLA fallback materializes the repeat.
 """
 
 from typing import Optional
@@ -67,15 +68,16 @@ _flash_resolved = False
 
 
 def causal_attention(q, k, v, use_flash: bool = True):
-    """Causal self-attention, [B,S,H,D] x [B,S,KV,D] -> [B,S,H,D]."""
-    n_rep = q.shape[2] // k.shape[2]
-    k = _repeat_kv(k, n_rep)
-    v = _repeat_kv(v, n_rep)
+    """Causal self-attention, [B,S,H,D] x [B,S,KV,D] -> [B,S,H,D].
+
+    GQA KV heads are consumed in-place by the flash kernel (index maps,
+    no HBM repeat); only the XLA fallback materializes the repeat."""
     if use_flash and q.shape[1] >= 256 and _on_tpu():
         flash = _load_flash()
         if flash is not None:
             return flash(q, k, v, causal=True)
-    return _xla_attention(q, k, v, causal=True)
+    n_rep = q.shape[2] // k.shape[2]
+    return _xla_attention(q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep), causal=True)
 
 
 def _on_tpu() -> bool:

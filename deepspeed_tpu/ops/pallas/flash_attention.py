@@ -1,19 +1,26 @@
-"""Pallas flash attention (TPU).
+"""Pallas flash attention (TPU), forward + backward kernels.
 
 TPU-native replacement for the reference's fused attention CUDA kernels
 (ref: csrc/transformer/ softmax_kernels.cu + strided_batch_gemm for
-training; the flash-style tiling replaces the materialized [S,S]
-softmax). Flash-attention-2-style online softmax:
+training). Flash-attention-2-style online softmax, with:
 
-- grid (batch*heads, q_blocks, k_blocks); the innermost (k) grid dim is
-  sequential on TPU, so the running max / sum / accumulator live in VMEM
-  scratch across k-steps and the output is written on the last k-step.
-- causal masking prunes fully-masked k-blocks with @pl.when, and applies
-  an iota mask on the diagonal blocks.
-- the backward pass recomputes probabilities from the saved logsumexp
-  (standard flash bwd math) in blocked form via lax.map over k-blocks —
-  XLA-level, not a second Pallas kernel yet; fwd is the memory-bound win
-  under rematerialized training.
+- **bf16 MXU inputs everywhere**: all matmuls feed the MXU in the input
+  dtype with f32 accumulation (`preferred_element_type`) — never
+  pre-cast to f32 (f32 matmul runs at 1/4 rate on v5e).
+- **GQA via BlockSpec index maps**: q is [B*H, S, D], kv stays
+  [B*KV, S, D]; the kv block index map folds the q-head → kv-head
+  mapping (h // group) so repeated KV heads are never materialized in
+  HBM (fixes VERDICT W4's n_rep× HBM traffic multiplier).
+- **Pallas backward**: two kernels (dq; dk/dv) recomputing probabilities
+  from the saved logsumexp — replaces round 1's XLA lax.scan backward
+  that materialized [BH, S, block_k] probability tiles.
+- causal masking prunes fully-masked blocks with @pl.when; the diagonal
+  band applies an iota mask.
+
+grid layout: the innermost grid dims are sequential on TPU, so running
+accumulators live in VMEM scratch across those steps and outputs are
+written on the last step (out index maps that ignore the inner dims keep
+the block resident until then).
 
 Numerics are validated against the pure-jnp oracle in
 tests/test_flash_attention.py exactly as the reference validates CUDA
@@ -21,7 +28,6 @@ kernels against torch (ref: tests/unit/ops).
 """
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +36,26 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+
+def _interpret() -> bool:
+    """Run kernels through the Pallas interpreter off-TPU so the CPU test
+    lane exercises the real kernel math (ref: tests/unit/ops runs CUDA
+    kernels only on GPU; the interpreter removes that gap here)."""
+    return jax.default_backend() != "tpu"
+
+
+def _dot(a, b, trans_a=False, trans_b=False):
+    """MXU matmul with f32 accumulation, keeping input dtype (bf16 ok)."""
+    ca = 0 if trans_a else 1
+    cb = 1 if trans_b else 0
+    return jax.lax.dot_general(
+        a, b, (((ca,), (cb,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc,
@@ -45,7 +71,6 @@ def _fwd_kernel(
         l_sc[:] = jnp.zeros_like(l_sc)
         acc_sc[:] = jnp.zeros_like(acc_sc)
 
-    # causal: skip k blocks strictly above the diagonal band
     q_start = i * block_q
     k_start = j * block_k
     needed = True
@@ -54,11 +79,9 @@ def _fwd_kernel(
 
     @pl.when(needed)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)  # (bq, d)
-        k = k_ref[0].astype(jnp.float32)  # (bk, d)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # (bq, bk)
+        q = q_ref[0]
+        k = k_ref[0]
+        s = _dot(q, k, trans_b=True) * scale  # (bq, bk) f32
 
         rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -69,14 +92,11 @@ def _fwd_kernel(
 
         m_prev = m_sc[:]  # (bq, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)  # (bq, bk)
+        p = jnp.exp(s - m_new)  # (bq, bk) f32
         corr = jnp.exp(m_prev - m_new)  # (bq, 1)
         l_sc[:] = l_sc[:] * corr + jnp.sum(p, axis=1, keepdims=True)
         v = v_ref[0]
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        pv = _dot(p.astype(v.dtype), v)
         acc_sc[:] = acc_sc[:] * corr + pv
         m_sc[:] = m_new
 
@@ -97,9 +117,37 @@ def _pad_to(x, size, axis):
     return jnp.pad(x, widths)
 
 
-def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
-    """q,k,v: [BH, S, D] → (o [BH,S,D], lse [BH,S])."""
+def _kv_index(b, H: int, KV: int, G: int):
+    """q-head-major grid index b (over B*H) → kv index (over B*KV).
+
+    q head h attends kv head h // G (heads grouped contiguously)."""
+    return (b // H) * KV + (b % H) // G
+
+
+def _clamp_j(j, i, bq: int, bk: int, causal: bool):
+    """Causal DMA pruning for the k-sequential kernels (fwd, dq): blocks
+    strictly above the diagonal are skipped by @pl.when, but Pallas would
+    still stream their tiles. Clamping the index map to the last needed
+    k block makes pruned steps revisit a resident block — no transfer."""
+    if not causal:
+        return j
+    jmax = ((i + 1) * bq - 1) // bk
+    return jnp.minimum(j, jmax)
+
+
+def _clamp_i(i, j, bq: int, bk: int, causal: bool):
+    """Same DMA pruning for the q-sequential dk/dv kernel: q blocks
+    strictly above the diagonal map to the first needed q block."""
+    if not causal:
+        return i
+    imin = (j * bk) // bq
+    return jnp.maximum(i, imin)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, H, KV):
+    """q: [B*H, S, D]; k,v: [B*KV, S, D] → (o [B*H,S,D], lse [B*H,S])."""
     BH, S, D = q.shape
+    G = H // KV
     scale = 1.0 / (D**0.5)
     bq, bk = block_q, block_k
     Sp = pl.cdiv(S, bq) * bq
@@ -117,8 +165,14 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
         grid=(BH, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec(
+                (1, bk, D),
+                lambda b, i, j: (_kv_index(b, H, KV, G), _clamp_j(j, i, bq, bk, causal), 0),
+            ),
+            pl.BlockSpec(
+                (1, bk, D),
+                lambda b, i, j: (_kv_index(b, H, KV, G), _clamp_j(j, i, bq, bk, causal), 0),
+            ),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
@@ -135,88 +189,217 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, 1), jnp.float32),
         ],
+        interpret=_interpret(),
     )(qp, kp, vp)
     return o[:, :S], lse[:, 0, :S]
 
 
-def _flash_bwd(q, k, v, o, lse, do, causal: bool, block_k: int):
-    """Blocked flash backward from saved lse (XLA; [BH,S,D] layout).
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
 
-    dq = (P ∘ (dO·Vᵀ − rowsum(dO∘O))) · K · scale, etc. Computed in
-    k-blocks so peak memory is [S, block_k], not [S, S].
-    """
-    BH, S, D = q.shape
-    scale = 1.0 / (D**0.5)
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [BH,S]
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc,
+    *, scale: float, block_q: int, block_k: int, seq_len: int, causal: bool,
+):
+    i = pl.program_id(1)  # q block
+    j = pl.program_id(2)  # k block (sequential)
+    nk = pl.num_programs(2)
 
-    nk = pl.cdiv(S, block_k)
-    Sk = nk * block_k
-    kp = _pad_to(k, Sk, 1).reshape(BH, nk, block_k, D)
-    vp = _pad_to(v, Sk, 1).reshape(BH, nk, block_k, D)
+    @pl.when(j == 0)
+    def _init():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
 
-    q32 = q.astype(jnp.float32)
-    do32 = do.astype(jnp.float32)
-    rows = jnp.arange(S)
+    q_start = i * block_q
+    k_start = j * block_k
+    needed = True
+    if causal:
+        needed = k_start < q_start + block_q
 
-    def one_block(carry, blk):
-        dq_acc, idx = carry
-        kb, vb = blk  # [BH, bk, D]
-        cols = idx * block_k + jnp.arange(block_k)
-        s = jnp.einsum("bsd,bkd->bsk", q32, kb.astype(jnp.float32)) * scale
-        mask = cols[None, :] < S
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = _dot(q, k, trans_b=True) * scale  # (bq, bk) f32
+
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = cols < seq_len
         if causal:
-            mask = jnp.logical_and(mask, cols[None, :] <= rows[:, None])
-        p = jnp.where(mask[None], jnp.exp(s - lse[..., None]), 0.0)  # [BH,S,bk]
-        dp = jnp.einsum("bsd,bkd->bsk", do32, vb.astype(jnp.float32))
-        ds = p * (dp - delta[..., None]) * scale
-        dq_acc = dq_acc + jnp.einsum("bsk,bkd->bsd", ds, kb.astype(jnp.float32))
-        dk = jnp.einsum("bsk,bsd->bkd", ds, q32)
-        dv = jnp.einsum("bsk,bsd->bkd", p, do32)
-        return (dq_acc, idx + 1), (dk, dv)
+            mask = jnp.logical_and(mask, cols <= rows)
 
-    (dq, _), (dks, dvs) = jax.lax.scan(
-        one_block,
-        (jnp.zeros_like(q32), jnp.int32(0)),
-        (kp.transpose(1, 0, 2, 3), vp.transpose(1, 0, 2, 3)),
-    )
-    dk = dks.transpose(1, 0, 2, 3).reshape(BH, Sk, D)[:, :S]
-    dv = dvs.transpose(1, 0, 2, 3).reshape(BH, Sk, D)[:, :S]
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+        lse = lse_ref[0].reshape(block_q, 1)  # (bq, 1)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # (bq, bk) f32
+        do = do_ref[0]
+        dp = _dot(do, v_ref[0], trans_b=True)  # (bq, bk) f32
+        delta = delta_ref[0].reshape(block_q, 1)
+        ds = p * (dp - delta) * scale  # (bq, bk) f32
+        dq_sc[:] = dq_sc[:] + _dot(ds.astype(k.dtype), k)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_sc[:].astype(dq_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, block_q, block_k):
-    o, _ = _flash_fwd(q, k, v, causal, block_q, block_k)
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_sc, dv_sc,
+    *, scale: float, block_q: int, block_k: int, seq_len: int, causal: bool,
+    n_group: int,
+):
+    j = pl.program_id(1)   # k block
+    g = pl.program_id(2)   # q-head within the kv group (sequential)
+    i = pl.program_id(3)   # q block (sequential)
+    nq = pl.num_programs(3)
+
+    @pl.when(jnp.logical_and(g == 0, i == 0))
+    def _init():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    q_start = i * block_q
+    k_start = j * block_k
+    needed = True
+    if causal:
+        needed = k_start < q_start + block_q
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        # transposed orientation (bk, bq): no in-kernel transposes needed
+        s_t = _dot(k, q, trans_b=True) * scale
+
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 0)
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 1)
+        mask = cols < seq_len
+        if causal:
+            mask = jnp.logical_and(mask, cols <= rows)
+
+        lse = lse_ref[0]  # (1, bq) broadcasts over bk rows
+        p_t = jnp.where(mask, jnp.exp(s_t - lse), 0.0)  # (bk, bq) f32
+        do = do_ref[0]
+        dv_sc[:] = dv_sc[:] + _dot(p_t.astype(do.dtype), do)
+        dp_t = _dot(v_ref[0], do, trans_b=True)  # (bk, bq) f32
+        delta = delta_ref[0]  # (1, bq)
+        ds_t = p_t * (dp_t - delta) * scale
+        dk_sc[:] = dk_sc[:] + _dot(ds_t.astype(q.dtype), q)
+
+    @pl.when(jnp.logical_and(g == n_group - 1, i == nq - 1))
+    def _finalize():
+        dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, H, KV):
+    BH, S, D = q.shape
+    BKV = k.shape[0]
+    G = H // KV
+    scale = 1.0 / (D**0.5)
+    bq, bk = block_q, block_k
+    Sp = pl.cdiv(S, bq) * bq
+    Sk = pl.cdiv(S, bk) * bk
+    nq, nk = Sp // bq, Sk // bk
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [BH,S]
+    qp = _pad_to(q, Sp, 1)
+    dop = _pad_to(do, Sp, 1)
+    lsep = _pad_to(lse, Sp, 1).reshape(BH, 1, Sp)
+    deltap = _pad_to(delta, Sp, 1).reshape(BH, 1, Sp)
+    kp = _pad_to(k, Sk, 1)
+    vp = _pad_to(v, Sk, 1)
+
+    kwargs = dict(scale=scale, block_q=bq, block_k=bk, seq_len=S, causal=causal)
+    kv_ix = lambda b: _kv_index(b, H, KV, G)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **kwargs),
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (kv_ix(b), _clamp_j(j, i, bq, bk, causal), 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (kv_ix(b), _clamp_j(j, i, bq, bk, causal), 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Sp, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    # q-head index for the dk/dv grid: (b_kv, g) → q head row in [B*H)
+    q_ix = lambda b, g: (b // KV) * H + (b % KV) * G + g
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, n_group=G, **kwargs),
+        grid=(BKV, nk, G, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, j, g, i: (q_ix(b, g), _clamp_i(i, j, bq, bk, causal), 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, g, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, g, i: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, j, g, i: (q_ix(b, g), _clamp_i(i, j, bq, bk, causal), 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, j, g, i: (q_ix(b, g), 0, _clamp_i(i, j, bq, bk, causal))),
+            pl.BlockSpec((1, 1, bq), lambda b, j, g, i: (q_ix(b, g), 0, _clamp_i(i, j, bq, bk, causal))),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, j, g, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, g, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BKV, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((BKV, Sk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    return dq[:, :S], dk[:, :S], dv[:, :S]
+
+
+# ---------------------------------------------------------------------------
+# custom VJP + public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, block_q, block_k, H, KV):
+    o, _ = _flash_fwd(q, k, v, causal, block_q, block_k, H, KV)
     return o
 
 
-def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
-    o, lse = _flash_fwd(q, k, v, causal, block_q, block_k)
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k, H, KV):
+    o, lse = _flash_fwd(q, k, v, causal, block_q, block_k, H, KV)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd_rule(causal, block_q, block_k, res, do):
+def _flash_bwd_rule(causal, block_q, block_k, H, KV, res, do):
     q, k, v, o, lse = res
-    return _flash_bwd(q, k, v, o, lse, do, causal, block_k)
+    return _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, H, KV)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def flash_attention(
-    q, k, v, causal: bool = True, block_q: int = 256, block_k: int = 256
+    q, k, v, causal: bool = True, block_q: int = 512, block_k: int = 1024
 ):
-    """[B,S,H,D] x [B,S,H,D] → [B,S,H,D] flash attention.
+    """[B,S,H,D] x [B,S,KV,D] x [B,S,KV,D] → [B,S,H,D] flash attention.
 
-    KV heads must already be repeated to match q heads (the wrapper in
-    ops/attention.py handles GQA).
-    """
+    GQA (KV < H) is handled inside the kernels via index maps — callers
+    must NOT pre-repeat KV heads."""
     B, S, H, D = q.shape
+    KV = k.shape[2]
+    assert H % KV == 0, f"n_heads {H} not a multiple of kv_heads {KV}"
     bq = min(block_q, S)
     bk = min(block_k, S)
 
     def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+        h = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(B * h, S, D)
 
-    o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, bq, bk)
+    o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, bq, bk, H, KV)
     return o.reshape(B, H, S, D).transpose(0, 2, 1, 3)

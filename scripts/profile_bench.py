@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Timing experiments for the bench model on the real chip (VERDICT W1
+evidence; results recorded in docs/PROFILE_r02.md). Uses the shared
+axon-tunnel-aware harness in scripts/tpu_timing.py."""
+
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_timing import timeit
+
+
+def main():
+    from deepspeed_tpu.models import transformer as T
+
+    B, S = 8, 2048
+    rng = np.random.default_rng(0)
+    toks = [jnp.asarray(rng.integers(0, 32000, (B, S + 1)).astype(np.int32)) for _ in range(4)]
+
+    variants = {
+        "dots,flash": dict(remat="dots", use_flash=True),
+        "dots,xla-attn": dict(remat="dots", use_flash=False),
+        "full-remat,flash": dict(remat="full", use_flash=True),
+    }
+    for name, kw in variants.items():
+        mcfg = T.TransformerConfig(
+            vocab_size=32000, n_layers=24, n_heads=8, d_model=1024,
+            max_seq=S, variant="llama", **kw,
+        )
+        params = jax.jit(lambda k: jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16), T.init(mcfg, k)))(jax.random.PRNGKey(0))
+        jax.block_until_ready(params)
+        loss_fn = T.make_loss_fn(mcfg)
+        fwd = jax.jit(lambda p, t: loss_fn(p, {"tokens": t}, None))
+        grad = jax.jit(lambda p, t: jax.grad(
+            lambda pp: loss_fn(pp, {"tokens": t}, None))(p))
+        try:
+            t_f = timeit(fwd, lambda i: (params, toks[i]), n=10)
+            t_g = timeit(grad, lambda i: (params, toks[i]), n=10)
+            print(f"{name:26s} fwd {t_f*1e3:8.1f} ms   grad {t_g*1e3:8.1f} ms", flush=True)
+        except Exception as e:
+            print(f"{name:26s} FAILED: {type(e).__name__}: {str(e)[:200]}", flush=True)
+
+    # attention-only microbench at bench shape
+    from deepspeed_tpu.ops import attention as A
+    ks = jax.random.split(jax.random.PRNGKey(1), 16)
+    qs = [jax.random.normal(k, (B, S, 8, 128), jnp.bfloat16) for k in ks[:4]]
+    for nm, uf in (("flash", True), ("xla", False)):
+        att = jax.jit(lambda q: A.causal_attention(q, q, q, use_flash=uf))
+        gat = jax.jit(jax.grad(lambda q: A.causal_attention(q, q, q, use_flash=uf).astype(jnp.float32).sum()))
+        print(f"attn {nm:6s} fwd {timeit(att, lambda i: (qs[i],))*1e3:8.2f} ms   "
+              f"grad {timeit(gat, lambda i: (qs[i],))*1e3:8.2f} ms", flush=True)
+
+    # CE-only microbench
+    xs = [jax.random.normal(k, (B, S, 1024), jnp.bfloat16) for k in ks[:4]]
+    head = jax.random.normal(jax.random.PRNGKey(3), (1024, 32000), jnp.bfloat16)
+    tgt = jnp.asarray(rng.integers(0, 32000, (B, S)).astype(np.int32))
+    mask = jnp.ones((B, S), jnp.float32)
+    for nc in (1, 8):
+        ce = jax.jit(lambda x, h: T._chunked_ce(x, h, tgt, mask, nc)[0])
+        ce_g = jax.jit(jax.grad(lambda x, h: T._chunked_ce(x, h, tgt, mask, nc)[0], argnums=(0, 1)))
+        print(f"CE chunks={nc}  fwd {timeit(ce, lambda i: (xs[i], head))*1e3:8.2f} ms   "
+              f"grad {timeit(ce_g, lambda i: (xs[i], head))*1e3:8.2f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

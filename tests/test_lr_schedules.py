@@ -70,3 +70,12 @@ def test_build_schedule_none_is_constant():
 def test_build_schedule_unknown():
     with pytest.raises(ValueError):
         build_schedule("NoSuchLR", {})
+
+
+def test_warmup_decay_respects_min_lr():
+    """ADVICE r1: decay must end at warmup_min_lr, not 0 (reference
+    WarmupDecayLR returns min + (max-min)*gamma)."""
+    s = warmup_decay_lr(total_num_steps=100, warmup_min_lr=1e-4,
+                        warmup_max_lr=1e-2, warmup_num_steps=10)
+    assert f(s, 100) == pytest.approx(1e-4, rel=1e-4)
+    assert f(s, 55) == pytest.approx(1e-4 + (1e-2 - 1e-4) * 0.5, rel=1e-4)

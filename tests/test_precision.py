@@ -78,3 +78,31 @@ def test_global_norm_and_clip():
     # no-op when under the limit
     same = clip_grads_by_global_norm(grads, 100.0, n)
     np.testing.assert_allclose(same["a"], grads["a"], rtol=1e-6)
+
+
+def test_sustained_overflow_keeps_halving():
+    """Reference consecutive_hysteresis=False: once hysteresis is spent,
+    EVERY further overflow halves (ADVICE r1: fast divergence recovery)."""
+    c = cfg(initial_scale_power=8, hysteresis=2)
+    s = init_loss_scale(c)
+    scales = []
+    for _ in range(4):
+        s = update_loss_scale(s, jnp.bool_(True), c)
+        scales.append(float(s.scale))
+    assert scales == [256.0, 128.0, 64.0, 32.0]
+
+
+def test_good_steps_do_not_refill_hysteresis():
+    c = cfg(initial_scale_power=8, hysteresis=2, loss_scale_window=1000)
+    s = update_loss_scale(init_loss_scale(c), jnp.bool_(True), c)  # burn 1
+    s = update_loss_scale(s, jnp.bool_(False), c)  # good step: no refill
+    s = update_loss_scale(s, jnp.bool_(True), c)
+    assert float(s.scale) == 128.0  # halves immediately
+
+
+def test_consecutive_hysteresis_refills_on_good_steps():
+    c = cfg(initial_scale_power=8, hysteresis=2, consecutive_hysteresis=True)
+    s = update_loss_scale(init_loss_scale(c), jnp.bool_(True), c)  # burn 1
+    s = update_loss_scale(s, jnp.bool_(False), c)  # refill
+    s = update_loss_scale(s, jnp.bool_(True), c)  # burns refilled credit
+    assert float(s.scale) == 256.0

@@ -366,10 +366,24 @@ def _compat_filter(config: Dict[str, Any]) -> Dict[str, Any]:
     from ..utils.logging import logger
 
     config = {k: (dict(v) if isinstance(v, dict) else v) for k, v in config.items()}
-    present = [b for b in _UNIMPLEMENTED_BLOCKS if config.get(b)]
+
+    def _enabled(block):
+        # stock reference configs often carry disabled blocks
+        # ({"autotuning": {"enabled": false}}) — those parse fine
+        if isinstance(block, dict) and "enabled" in block:
+            return bool(block["enabled"])
+        return bool(block)
+
+    present = [b for b in _UNIMPLEMENTED_BLOCKS
+               if b in config and _enabled(config.pop(b))]
     if present:
         raise NotImplementedError(
             f"config blocks not yet implemented in deepspeed_tpu: {present}"
+        )
+    if float(config.get("gradient_predivide_factor", 1.0) or 1.0) != 1.0:
+        raise NotImplementedError(
+            "gradient_predivide_factor != 1.0 is not implemented (grad "
+            "reduction is a fused fp32 psum-mean on TPU)"
         )
     for path, keys in _REFERENCE_NOOP_KEYS.items():
         block = config if path == "" else config.get(path)

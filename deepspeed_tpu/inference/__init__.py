@@ -1,0 +1,11 @@
+from .engine import InferenceConfig, InferenceEngine, init_inference
+from .ragged import BlockedAllocator, SequenceDescriptor, StateManager
+
+__all__ = [
+    "InferenceConfig",
+    "InferenceEngine",
+    "init_inference",
+    "BlockedAllocator",
+    "SequenceDescriptor",
+    "StateManager",
+]

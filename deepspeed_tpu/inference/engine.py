@@ -1,14 +1,291 @@
-"""Inference engine (ref: deepspeed/inference/engine.py InferenceEngine:39,
-deepspeed/__init__.py init_inference:268).
+"""Inference engine: continuous batching over a paged KV cache.
 
-The TP-sharded decode engine with paged KV cache lands in a later
-milestone of this build (SURVEY §7 step 7); until then init_inference
-fails loudly rather than pretending.
+TPU-native redesign of FastGen's InferenceEngineV2
+(ref: inference/v2/engine_v2.py:30 — put:107, query:158, flush:242;
+config ref: inference/v2/ragged/manager_configs.py
+RaggedInferenceEngineConfig:137). Differences driven by XLA:
+
+- static shapes: prompts and decode batches are padded to power-of-two
+  buckets; each bucket is one compiled program, cached (the reference
+  re-runs eager CUDA kernels on exact ragged sizes; here the SplitFuse
+  "fixed token budget per step" idea becomes "fixed compiled buckets").
+- the ragged batch never exists as a device-side struct: the device sees
+  dense padded token buffers + block tables + context lengths; all
+  raggedness lives in the host-side StateManager (inference/ragged.py).
+- one forward pass per put() for the decode set (all sequences advance
+  one token in a single compiled program); prefills run one compiled
+  call per prompt.
+
+v1-engine parity (ref: deepspeed/inference/engine.py:39): init_inference
+constructs this engine; greedy `generate` is provided for parity with
+the wrapped-module generate path.
 """
 
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
-def init_inference(*args, **kwargs):
-    raise NotImplementedError(
-        "deepspeed_tpu.init_inference: the inference engine is not built yet "
-        "in this snapshot — training API (deepspeed_tpu.initialize) is live."
-    )
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.config import ConfigModel
+from ..models import transformer as T
+from ..utils.logging import log_dist
+from . import model as M
+from .ragged import StateManager
+
+
+class InferenceConfig(ConfigModel):
+    """ref: inference/v2/ragged/manager_configs.py DSStateManagerConfig +
+    RaggedInferenceEngineConfig (max_tracked_sequences,
+    max_ragged_batch_size, KVCacheConfig) — flattened to what the TPU
+    engine needs."""
+
+    max_tracked_sequences: int = 256
+    max_batch_size: int = 64          # decode sequences per step
+    max_seq_len: int = 4096           # per-sequence context cap
+    kv_block_size: int = 128
+    num_kv_blocks: int = 512          # total paged-cache blocks
+    min_prefill_bucket: int = 64
+
+    @property
+    def blocks_per_seq(self) -> int:
+        return -(-self.max_seq_len // self.kv_block_size)
+
+
+def _bucket(n: int, lo: int) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class InferenceEngine:
+    """put/query/flush over (params, TransformerConfig)."""
+
+    def __init__(
+        self,
+        model_config: T.TransformerConfig,
+        params: Any,
+        config: Optional[InferenceConfig] = None,
+        dtype=jnp.bfloat16,
+    ):
+        self.cfg = model_config
+        self.config = config or InferenceConfig()
+        if model_config.variant == "gpt2":
+            # prefill pads prompts up to a power-of-two bucket, and every
+            # padded position indexes the learned position table — so the
+            # largest BUCKET (not just max_seq_len) must fit
+            worst = _bucket(self.config.max_seq_len, self.config.min_prefill_bucket)
+            if worst > model_config.max_seq:
+                raise ValueError(
+                    f"gpt2 learned positions ({model_config.max_seq}) are "
+                    f"shorter than the largest prefill bucket ({worst}); "
+                    "lower max_seq_len so its bucket fits"
+                )
+        self.params = jax.tree.map(
+            lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p,
+            params,
+        )
+        self.state = StateManager(
+            num_blocks=self.config.num_kv_blocks,
+            block_size=self.config.kv_block_size,
+            max_tracked=self.config.max_tracked_sequences,
+        )
+        self.cache = M.init_cache(
+            model_config, self.config.num_kv_blocks, self.config.kv_block_size, dtype
+        )
+        self._use_kernel = jax.default_backend() == "tpu"
+        self._prefill_fns: Dict[int, Any] = {}
+        self._decode_fns: Dict[int, Any] = {}
+        kv_bytes = sum(x.nbytes for x in self.cache.k + self.cache.v)
+        log_dist(
+            f"inference engine: {self.config.num_kv_blocks} KV blocks x "
+            f"{self.config.kv_block_size} tokens ({kv_bytes/2**30:.2f} GiB cache), "
+            f"max_batch {self.config.max_batch_size}",
+            ranks=[0],
+        )
+
+    # -- compiled-step caches -------------------------------------------
+    def _prefill_fn(self, tp: int):
+        if tp not in self._prefill_fns:
+            cfg, use_kernel = self.cfg, self._use_kernel
+
+            def step(params, cache, tokens, n_real, table):
+                return M.prefill_step(params, cache, tokens, n_real, table, cfg, use_kernel)
+
+            self._prefill_fns[tp] = jax.jit(step, donate_argnums=(1,))
+        return self._prefill_fns[tp]
+
+    def _decode_fn(self, s: int):
+        if s not in self._decode_fns:
+            cfg, use_kernel = self.cfg, self._use_kernel
+
+            def step(params, cache, tokens, tables, ctx):
+                return M.decode_step(params, cache, tokens, tables, ctx, cfg, use_kernel)
+
+            self._decode_fns[s] = jax.jit(step, donate_argnums=(1,))
+        return self._decode_fns[s]
+
+    # -- scheduling queries (ref: engine_v2.py query:158/can_schedule:184)
+    def query(self, uid: int) -> Dict[str, int]:
+        seq = self.state.get(uid)
+        seen = seq.seen_tokens if seq else 0
+        cached_cap = (len(seq.blocks) * self.state.block_size - seen) if seq else 0
+        return {
+            "seen_tokens": seen,
+            "free_blocks": self.state.free_blocks,
+            "max_new_tokens": min(
+                cached_cap + self.state.free_blocks * self.state.block_size,
+                self.config.max_seq_len - seen,
+            ),
+        }
+
+    def can_schedule(self, uids: Iterable[int], lengths: Iterable[int]) -> bool:
+        need = 0
+        for uid, n in zip(uids, lengths):
+            seq = self.state.get(uid)
+            seen = seq.seen_tokens if seq else 0
+            if seen + n > self.config.max_seq_len:
+                return False
+            have = len(seq.blocks) if seq else 0
+            need += max(0, -(-(seen + n) // self.state.block_size) - have)
+        return need <= self.state.free_blocks
+
+    # -- the engine step (ref: engine_v2.py put:107) ---------------------
+    def put(
+        self, uids: Sequence[int], tokens: Sequence[np.ndarray]
+    ) -> np.ndarray:
+        """Run one engine step over a ragged batch.
+
+        New uids carry their whole prompt; known uids carry exactly one
+        continuation token. Returns next-token logits [len(uids), vocab]
+        in input order."""
+        uids = list(uids)
+        tokens = [np.atleast_1d(np.asarray(t, np.int32)) for t in tokens]
+        if len(uids) != len(set(uids)):
+            raise ValueError("duplicate uids in one put()")
+        if len(uids) != len(tokens):
+            raise ValueError("uids and tokens length mismatch")
+
+        prefills: List[Tuple[int, int, np.ndarray]] = []  # (pos, uid, toks)
+        decodes: List[Tuple[int, int, int]] = []  # (pos, uid, token)
+        for i, (uid, toks) in enumerate(zip(uids, tokens)):
+            seq = self.state.get(uid)
+            if seq is not None and seq.seen_tokens > 0:
+                if len(toks) != 1:
+                    raise NotImplementedError(
+                        f"uid {uid} is in-flight; continuation must be 1 "
+                        f"token/step (got {len(toks)}) — chunked "
+                        "continuation-prefill lands with the ragged "
+                        "prefill kernel"
+                    )
+                decodes.append((i, uid, int(toks[0])))
+            else:
+                if len(toks) > self.config.max_seq_len:
+                    raise ValueError(f"prompt of {len(toks)} > max_seq_len")
+                prefills.append((i, uid, toks))
+        if len(decodes) > self.config.max_batch_size:
+            raise RuntimeError(
+                f"{len(decodes)} decode sequences > max_batch_size "
+                f"{self.config.max_batch_size}; split the put()"
+            )
+
+        out = np.zeros((len(uids), self.cfg.vocab_size), np.float32)
+
+        for pos, uid, toks in prefills:
+            n = len(toks)
+            self.state.extend(uid, n)
+            tp = _bucket(n, self.config.min_prefill_bucket)
+            table = self.state.block_table([uid], self.config.blocks_per_seq)[0]
+            padded = np.zeros((tp,), np.int32)
+            padded[:n] = toks
+            logits, self.cache = self._prefill_fn(tp)(
+                self.params, self.cache, jnp.asarray(padded),
+                jnp.int32(n), jnp.asarray(table),
+            )
+            self.state.commit(uid, n)
+            out[pos] = np.asarray(logits)
+
+        if decodes:
+            s = len(decodes)
+            sp = _bucket(s, 8)
+            toks = np.zeros((sp,), np.int32)
+            ctx = np.zeros((sp,), np.int32)  # pad rows: ctx 0 = inert
+            for row, (_, uid, tok) in enumerate(decodes):
+                self.state.extend(uid, 1)
+                toks[row] = tok
+                ctx[row] = self.state.get(uid).seen_tokens + 1
+            tables = np.zeros((sp, self.config.blocks_per_seq), np.int32)
+            tables[:s] = self.state.block_table(
+                [uid for _, uid, _ in decodes], self.config.blocks_per_seq
+            )
+            logits, self.cache = self._decode_fn(sp)(
+                self.params, self.cache, jnp.asarray(toks),
+                jnp.asarray(tables), jnp.asarray(ctx),
+            )
+            logits = np.asarray(logits[:s])
+            for row, (pos, uid, _) in enumerate(decodes):
+                self.state.commit(uid, 1)
+                out[pos] = logits[row]
+        return out
+
+    def flush(self, uid: int) -> None:
+        """Free a sequence's KV blocks (ref: engine_v2.py flush:242)."""
+        self.state.flush(uid)
+
+    # -- convenience generation (v1 engine.generate parity) --------------
+    def generate(
+        self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 32,
+        eos_token_id: Optional[int] = None,
+    ) -> List[List[int]]:
+        """Greedy continuous-batch generation; returns new tokens per
+        prompt (ref: inference/engine.py generate:613 — here generation
+        drives put() exactly as the MII serving loop drives FastGen).
+        uids are allocated disjoint from in-flight sequences so calling
+        generate() never hijacks another caller's context."""
+        taken = set(self.state.tracked_uids)
+        uids, cand = [], 0
+        while len(uids) < len(prompts):
+            if cand not in taken:
+                uids.append(cand)
+            cand += 1
+        slot_of = {u: i for i, u in enumerate(uids)}
+        outs: List[List[int]] = [[] for _ in prompts]
+        live = set(uids)
+        logits = self.put(uids, [np.asarray(p, np.int32) for p in prompts])
+        nxt = {u: int(np.argmax(logits[i])) for i, u in enumerate(uids)}
+        while True:
+            batch_uids = sorted(live)
+            if not batch_uids:
+                break
+            for u in batch_uids:
+                outs[slot_of[u]].append(nxt[u])
+            done = {
+                u for u in batch_uids
+                if (eos_token_id is not None and nxt[u] == eos_token_id)
+                or len(outs[slot_of[u]]) >= max_new_tokens
+                or self.state.get(u).seen_tokens + 1 >= self.config.max_seq_len
+            }
+            live -= done
+            batch_uids = sorted(live)
+            if not batch_uids:
+                break
+            logits = self.put(batch_uids, [np.asarray([nxt[u]]) for u in batch_uids])
+            nxt = {u: int(np.argmax(logits[i])) for i, u in enumerate(batch_uids)}
+        for u in uids:
+            if self.state.get(u) is not None:
+                self.flush(u)
+        return outs
+
+
+def init_inference(
+    params: Any,
+    model_config: T.TransformerConfig,
+    config: Optional[Dict[str, Any]] = None,
+    dtype=jnp.bfloat16,
+) -> InferenceEngine:
+    """Build the inference engine (ref: deepspeed/__init__.py
+    init_inference:268 → InferenceEngine; config keys follow
+    InferenceConfig)."""
+    icfg = InferenceConfig(**(config or {}))
+    return InferenceEngine(model_config, params, icfg, dtype)

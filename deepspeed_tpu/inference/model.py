@@ -1,0 +1,260 @@
+"""Inference-side transformer forward over a paged KV cache.
+
+TPU-native redesign of the FastGen model layer
+(ref: inference/v2/model_implementations/inference_model_base.py:45
+DSInferenceModelBase + inference_transformer_base.py — there, per-layer
+CUDA kernels write QKV into the paged cache (linear_blocked_kv_rotary)
+and run blocked flash; here the same dataflow is jnp scatter for the KV
+write + the Pallas paged decode kernel / flash prefill kernel).
+
+Weights are the SAME pytree as models/transformer (one model family, two
+execution modes — the reference needs a separate inference module zoo
+because its training and inference kernels differ; here both consume the
+functional params dict).
+
+Cache: per layer, k and v as [num_blocks, block_size, KV_heads,
+head_dim] — one cache page is a contiguous (block_size, KV, D) tile
+(single large DMA in the kernels); TP shards the KV dim. All cache
+mutation goes through the Pallas RMW write kernel on donated buffers so
+the arena is updated in place.
+"""
+
+from functools import partial
+from typing import Any, Dict, List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models import transformer as T
+from ..ops.attention import causal_attention
+from ..ops.pallas.paged_attention import (
+    paged_decode_attention,
+    paged_decode_attention_xla,
+    paged_kv_write,
+)
+
+
+class PagedCache(NamedTuple):
+    """Per-layer lists (length n_layers) of [NBLK, bs, KV, D] arrays."""
+
+    k: List[jnp.ndarray]
+    v: List[jnp.ndarray]
+
+    @property
+    def block_size(self) -> int:
+        return self.k[0].shape[1]
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k[0].shape[0]
+
+
+def init_cache(
+    cfg: T.TransformerConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16
+) -> PagedCache:
+    KV, D, L = cfg.kv_heads, cfg.head_dim, cfg.n_layers
+    shape = (num_blocks, block_size, KV, D)
+    return PagedCache(
+        k=[jnp.zeros(shape, dtype) for _ in range(L)],
+        v=[jnp.zeros(shape, dtype) for _ in range(L)],
+    )
+
+
+def _rope_at(x, positions, cfg: T.TransformerConfig):
+    """Rotary embedding at per-token positions [T] (decode needs a
+    different position per row, unlike training's contiguous offset)."""
+    D = cfg.head_dim
+    freqs = cfg.rope_theta ** (-jnp.arange(0, D // 2, dtype=jnp.float32) / (D // 2))
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [T, D/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)  # [T, H, D/2]
+    c, s = cos[:, None, :], sin[:, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+
+def _flat_slot_index(positions, block_table, block_size):
+    """Token position → flat slot in the [KV, NBLK*bs, D] cache view.
+
+    positions: [T] int32 absolute positions of one sequence (prefill) or
+    per-row positions with per-row tables (decode handled by caller)."""
+    return block_table[positions // block_size] * block_size + positions % block_size
+
+
+def _write_kv(cache_k, cache_v, k_new, v_new, flat_idx):
+    """Write [T, KV, D] new KV into [KV, NBLK, bs, D] caches at flat
+    slots [T] via the Pallas RMW kernel — XLA scatter costs a fixed ~3ms
+    per call on TPU (docs/PROFILE_r02.md), which at 2/layer dominated
+    the decode step."""
+    return paged_kv_write(cache_k, cache_v, k_new, v_new, flat_idx)
+
+
+def _write_kv_xla(cache_k, cache_v, k_new, v_new, flat_idx):
+    """jnp scatter oracle for paged_kv_write (tests)."""
+    NBLK, bs, KV, D = cache_k.shape
+    ck = cache_k.reshape(NBLK * bs, KV, D).at[flat_idx].set(k_new, mode="drop")
+    cv = cache_v.reshape(NBLK * bs, KV, D).at[flat_idx].set(v_new, mode="drop")
+    return ck.reshape(NBLK, bs, KV, D), cv.reshape(NBLK, bs, KV, D)
+
+
+def _layer_params(params, l):
+    return {name: w[l] for name, w in params["layers"].items()}
+
+
+def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool):
+    if use_kernel:
+        return paged_decode_attention(q, ck, cv, table, ctx)
+    return paged_decode_attention_xla(q, ck, cv, table, ctx)
+
+
+# ---------------------------------------------------------------------------
+# decode: a batch of sequences, one new token each
+# ---------------------------------------------------------------------------
+
+def decode_step(
+    params, cache: PagedCache, tokens, tables, ctx_lens, cfg: T.TransformerConfig,
+    use_kernel: bool = True,
+):
+    """tokens [S] int32, tables [S, NB] int32, ctx_lens [S] int32 (context
+    length INCLUDING the new token) → (logits [S, V], new cache).
+
+    ref: engine_v2.py put→model.forward decode path; one compiled program
+    per (S, NB) shape."""
+    S = tokens.shape[0]
+    E, KV, D, bs = cfg.d_model, cfg.kv_heads, cfg.head_dim, cache.block_size
+    # rows with ctx_lens == 0 are batch padding: their KV write is dropped
+    # and their (garbage) logits are sliced off by the engine
+    valid = ctx_lens > 0
+    positions = jnp.maximum(ctx_lens - 1, 0)  # [S] this token's position
+    x = params["embed"][tokens]  # [S, E] — activations in the params dtype
+    if cfg.variant == "gpt2":
+        x = x + params["pos_embed"][positions].astype(x.dtype)
+
+    new_k, new_v = [], []
+    for l in range(cfg.n_layers):
+        lp = _layer_params(params, l)
+        h = T._norm(x, lp["ln1_scale"], lp.get("ln1_bias"), cfg)
+        q = jnp.einsum("se,ehd->shd", h, lp["wq"].astype(x.dtype))
+        k = jnp.einsum("se,ehd->shd", h, lp["wk"].astype(x.dtype))
+        v = jnp.einsum("se,ehd->shd", h, lp["wv"].astype(x.dtype))
+        if cfg.variant == "gpt2":
+            q = q + lp["bq"].astype(x.dtype)
+            k = k + lp["bk"].astype(x.dtype)
+            v = v + lp["bv"].astype(x.dtype)
+        else:
+            q = _rope_at(q, positions, cfg)
+            k = _rope_at(k, positions, cfg)
+
+        # per-row flat slot: each row has its own table; padding rows
+        # scatter to -1 which mode="drop" discards
+        flat_idx = (
+            jnp.take_along_axis(tables, (positions // bs)[:, None], axis=1)[:, 0]
+            * bs + positions % bs
+        )
+        flat_idx = jnp.where(valid, flat_idx, jnp.int32(-1))
+        ck, cv = _write_kv(cache.k[l], cache.v[l], k, v, flat_idx)
+        new_k.append(ck)
+        new_v.append(cv)
+
+        att = _decode_attention(q, ck, cv, tables, ctx_lens, use_kernel)
+        out = jnp.einsum("shd,hde->se", att, lp["wo"].astype(x.dtype))
+        if cfg.variant == "gpt2":
+            out = out + lp["bo"].astype(x.dtype)
+        x = x + out
+
+        h = T._norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg)
+        if cfg.variant == "llama":
+            inner = jax.nn.silu(
+                jnp.einsum("se,ef->sf", h, lp["w_gate"].astype(x.dtype))
+            ) * jnp.einsum("se,ef->sf", h, lp["w_in"].astype(x.dtype))
+        else:
+            inner = jax.nn.gelu(
+                jnp.einsum("se,ef->sf", h, lp["w_in"].astype(x.dtype))
+                + lp["b_in"].astype(x.dtype)
+            )
+        out = jnp.einsum("sf,fe->se", inner, lp["w_out"].astype(x.dtype))
+        if cfg.variant == "gpt2":
+            out = out + lp["b_out"].astype(x.dtype)
+        x = x + out
+
+    x = T._norm(x, params["ln_f_scale"], params.get("ln_f_bias"), cfg)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("se,ev->sv", x, head.astype(x.dtype))
+    return logits.astype(jnp.float32), PagedCache(k=new_k, v=new_v)
+
+
+# ---------------------------------------------------------------------------
+# prefill: one sequence's whole prompt
+# ---------------------------------------------------------------------------
+
+def prefill_step(
+    params, cache: PagedCache, tokens, n_real, table, cfg: T.TransformerConfig,
+    use_kernel: bool = True,
+):
+    """tokens [Tp] int32 (padded), n_real scalar int32, table [NB] int32 →
+    (last-token logits [V], new cache).
+
+    Whole-prompt prefill: attention over the prompt itself is plain
+    causal flash (no paged reads — the sequence starts empty); new KV is
+    scattered into the paged cache for the real tokens only. The
+    last-real-token logits are the FastGen logits_gather analog
+    (ref: kernels/ragged_ops/logits_gather/)."""
+    Tp = tokens.shape[0]
+    bs = cache.block_size
+    positions = jnp.arange(Tp, dtype=jnp.int32)
+    x = params["embed"][tokens][None]  # [1, Tp, E] — params-dtype activations
+    if cfg.variant == "gpt2":
+        x = x + params["pos_embed"][:Tp].astype(x.dtype)[None]
+
+    flat_idx = jnp.where(
+        positions < n_real,
+        table[positions // bs] * bs + positions % bs,
+        jnp.int32(-1),  # dropped by scatter mode="drop"
+    )
+
+    new_k, new_v = [], []
+    for l in range(cfg.n_layers):
+        lp = _layer_params(params, l)
+        h = T._norm(x, lp["ln1_scale"], lp.get("ln1_bias"), cfg)
+        q = jnp.einsum("bse,ehd->bshd", h, lp["wq"].astype(x.dtype))
+        k = jnp.einsum("bse,ehd->bshd", h, lp["wk"].astype(x.dtype))
+        v = jnp.einsum("bse,ehd->bshd", h, lp["wv"].astype(x.dtype))
+        if cfg.variant == "gpt2":
+            q = q + lp["bq"].astype(x.dtype)
+            k = k + lp["bk"].astype(x.dtype)
+            v = v + lp["bv"].astype(x.dtype)
+        else:
+            q = _rope_at(q[0], positions, cfg)[None]
+            k = _rope_at(k[0], positions, cfg)[None]
+
+        ck, cv = _write_kv(cache.k[l], cache.v[l], k[0], v[0], flat_idx)
+        new_k.append(ck)
+        new_v.append(cv)
+
+        att = causal_attention(q, k, v, use_flash=use_kernel and cfg.use_flash)
+        out = jnp.einsum("bshd,hde->bse", att, lp["wo"].astype(x.dtype))
+        if cfg.variant == "gpt2":
+            out = out + lp["bo"].astype(x.dtype)
+        x = x + out
+
+        h = T._norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg)
+        if cfg.variant == "llama":
+            inner = jax.nn.silu(
+                jnp.einsum("bse,ef->bsf", h, lp["w_gate"].astype(x.dtype))
+            ) * jnp.einsum("bse,ef->bsf", h, lp["w_in"].astype(x.dtype))
+        else:
+            inner = jax.nn.gelu(
+                jnp.einsum("bse,ef->bsf", h, lp["w_in"].astype(x.dtype))
+                + lp["b_in"].astype(x.dtype)
+            )
+        out = jnp.einsum("bsf,fe->bse", inner, lp["w_out"].astype(x.dtype))
+        if cfg.variant == "gpt2":
+            out = out + lp["b_out"].astype(x.dtype)
+        x = x + out
+
+    # logits for the last REAL token only (logits_gather): slice before
+    # the vocab matmul so the head runs on one token, not Tp
+    x_last = x[0, n_real - 1][None]  # [1, E]
+    x_last = T._norm(x_last, params["ln_f_scale"], params.get("ln_f_bias"), cfg)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("se,ev->sv", x_last, head.astype(x_last.dtype))[0]
+    return logits.astype(jnp.float32), PagedCache(k=new_k, v=new_v)

@@ -1,0 +1,161 @@
+"""Ragged-batching control plane: paged KV-cache bookkeeping.
+
+TPU-native redesign of the FastGen v2 ragged state
+(ref: inference/v2/ragged/blocked_allocator.py:11 BlockedAllocator,
+ragged_manager.py:19 DSStateManager, sequence_descriptor.py
+DSSequenceDescriptor, kv_cache.py:40 BlockedKVCache). Host-side pure
+Python/numpy — the device only ever sees dense int32 block tables and
+context lengths, so all allocation policy stays off the compiled path.
+
+One "block" spans `block_size` token slots across ALL layers (the
+reference's cache-group model with a single group): allocating a block
+reserves that token range in every layer's K and V cache simultaneously.
+"""
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class BlockedAllocator:
+    """Free-list allocator over the paged KV cache.
+
+    ref: inference/v2/ragged/blocked_allocator.py:11 — same contract
+    (allocate n or raise; free returns blocks), implemented as a plain
+    int free-list rather than a pinned-tensor linked list (no GPU-side
+    consumers of the list on TPU)."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"paged KV cache needs >= 1 block, got {num_blocks}")
+        self._num_blocks = num_blocks
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+
+    @property
+    def total_blocks(self) -> int:
+        return self._num_blocks
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def allocate(self, num_blocks: int) -> List[int]:
+        if num_blocks < 0:
+            raise ValueError(f"cannot allocate {num_blocks} blocks")
+        if num_blocks > len(self._free):
+            raise RuntimeError(
+                f"KV cache exhausted: requested {num_blocks} blocks, "
+                f"{len(self._free)} free of {self._num_blocks}"
+            )
+        out = self._free[-num_blocks:] if num_blocks else []
+        del self._free[len(self._free) - num_blocks:]
+        return list(reversed(out))
+
+    def free(self, blocks: List[int]) -> None:
+        seen = set(self._free)
+        for b in blocks:
+            if not (0 <= b < self._num_blocks):
+                raise ValueError(f"block {b} out of range [0, {self._num_blocks})")
+            if b in seen:
+                raise ValueError(f"double free of block {b}")
+            seen.add(b)  # also catches duplicates within `blocks`
+        self._free.extend(blocks)
+
+
+@dataclasses.dataclass
+class SequenceDescriptor:
+    """ref: inference/v2/ragged/sequence_descriptor.py DSSequenceDescriptor —
+    tracks one in-flight generation."""
+
+    uid: int
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    seen_tokens: int = 0  # tokens whose KV lives in the cache
+
+    def blocks_needed(self, new_tokens: int, block_size: int) -> int:
+        total = self.seen_tokens + new_tokens
+        need = -(-total // block_size)  # ceil
+        return max(0, need - len(self.blocks))
+
+
+class StateManager:
+    """Tracks sequences + owns the allocator
+    (ref: inference/v2/ragged/ragged_manager.py:19 DSStateManager)."""
+
+    def __init__(self, num_blocks: int, block_size: int, max_tracked: int = 2048):
+        self.block_size = block_size
+        self.allocator = BlockedAllocator(num_blocks)
+        self.max_tracked = max_tracked
+        self._seqs: Dict[int, SequenceDescriptor] = {}
+
+    # -- queries (ref: ragged_manager.py get_sequence:125 etc.) ----------
+    def get(self, uid: int) -> Optional[SequenceDescriptor]:
+        return self._seqs.get(uid)
+
+    def get_or_create(self, uid: int) -> SequenceDescriptor:
+        if uid not in self._seqs:
+            if len(self._seqs) >= self.max_tracked:
+                raise RuntimeError(
+                    f"too many tracked sequences ({self.max_tracked})"
+                )
+            self._seqs[uid] = SequenceDescriptor(uid=uid)
+        return self._seqs[uid]
+
+    @property
+    def n_tracked(self) -> int:
+        return self._seqs.__len__()
+
+    @property
+    def tracked_uids(self) -> List[int]:
+        return list(self._seqs)
+
+    @property
+    def free_blocks(self) -> int:
+        return self.allocator.free_blocks
+
+    def can_fit(self, uid: int, new_tokens: int) -> bool:
+        seq = self._seqs.get(uid) or SequenceDescriptor(uid=uid)
+        return seq.blocks_needed(new_tokens, self.block_size) <= self.allocator.free_blocks
+
+    # -- mutation --------------------------------------------------------
+    def extend(self, uid: int, new_tokens: int) -> SequenceDescriptor:
+        """Reserve cache room for `new_tokens` more tokens of `uid`
+        (ref: kv_cache.py reserve:144); returns the descriptor with its
+        block table grown. Does NOT bump seen_tokens — the engine commits
+        that after the forward actually writes the KV. On allocation
+        failure a freshly-created descriptor is untracked again, so a
+        caught cache-exhausted error does not leak tracked sequences."""
+        created = uid not in self._seqs
+        seq = self.get_or_create(uid)
+        need = seq.blocks_needed(new_tokens, self.block_size)
+        try:
+            if need:
+                seq.blocks.extend(self.allocator.allocate(need))
+        except RuntimeError:
+            if created:
+                del self._seqs[uid]
+            raise
+        return seq
+
+    def commit(self, uid: int, new_tokens: int) -> None:
+        self._seqs[uid].seen_tokens += new_tokens
+
+    def flush(self, uid: int) -> None:
+        """ref: ragged_manager.py flush_sequence:110 — return the blocks."""
+        seq = self._seqs.pop(uid, None)
+        if seq is None:
+            raise KeyError(f"unknown sequence uid {uid}")
+        self.allocator.free(seq.blocks)
+
+    # -- device views ----------------------------------------------------
+    def block_table(self, uids: List[int], max_blocks: int) -> np.ndarray:
+        """Dense [len(uids), max_blocks] int32 block table (padded 0)."""
+        out = np.zeros((len(uids), max_blocks), np.int32)
+        for i, uid in enumerate(uids):
+            blocks = self._seqs[uid].blocks
+            if len(blocks) > max_blocks:
+                raise ValueError(
+                    f"uid {uid} has {len(blocks)} blocks > table width {max_blocks}"
+                )
+            out[i, : len(blocks)] = blocks
+        return out

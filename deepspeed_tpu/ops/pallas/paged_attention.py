@@ -1,0 +1,244 @@
+"""Pallas paged-KV kernels: decode attention + cache write (TPU).
+
+TPU-native redesign of the FastGen ragged hot path
+(ref: inference/v2/kernels/ragged_ops/blocked_flash/ paged flash,
+linear_blocked_kv_rotary/ fused KV-cache store; the block table is a
+scalar-prefetch argument and BlockSpec index maps do the paging — the
+idiomatic Mosaic equivalent of the reference's attention-atom
+descriptors).
+
+Cache layout: [num_blocks, block_size, KV_heads, head_dim].
+One cache block is a CONTIGUOUS (block_size, KV, D) tile — a single
+256KB-class DMA fetches every head's slice of a page, so the decode grid
+is (seqs, table_slots) with a static head loop inside (measured 8x fewer
+grid steps and much higher effective bandwidth than a per-head grid).
+The trailing (KV, D) dims satisfy TPU (8,128) tiling; TP shards the KV
+dim. "Block i of sequence s" lives at cache[table[s, i]]; pages beyond a
+sequence's context are never streamed — the index map clamps the slot to
+the last needed block so pruned steps revisit a resident tile (no DMA),
+mirroring the causal clamp in flash_attention.py.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import NEG_INF, _dot, _interpret
+
+
+# ---------------------------------------------------------------------------
+# decode attention
+# ---------------------------------------------------------------------------
+
+def _decode_kernel(
+    tbl_ref, ctx_ref,  # scalar prefetch: [S, NB] block table, [S] ctx lens
+    q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc,
+    *, block_size: int, scale: float, n_kv: int, gp: int,
+):
+    s = pl.program_id(0)
+    j = pl.program_id(1)  # table slot (sequential)
+    nb = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    ctx = ctx_ref[s]
+    needed = j * block_size < ctx
+
+    @pl.when(needed)
+    def _compute():
+        k = k_ref[0]  # (bs, KV, D)
+        v = v_ref[0]
+        cols = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (gp, block_size), 1
+        )
+        live = cols < ctx
+        for h in range(n_kv):
+            q = q_ref[0, h]  # (Gp, D)
+            kh = k[:, h, :]  # (bs, D)
+            st = _dot(q, kh, trans_b=True) * scale  # (Gp, bs) f32
+            st = jnp.where(live, st, NEG_INF)
+
+            row = slice(h * gp, (h + 1) * gp)
+            m_prev = m_sc[row]
+            m_new = jnp.maximum(m_prev, jnp.max(st, axis=1, keepdims=True))
+            p = jnp.exp(st - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            l_sc[row] = l_sc[row] * corr + jnp.sum(p, axis=1, keepdims=True)
+            acc_sc[row] = acc_sc[row] * corr + _dot(p.astype(v.dtype), v[:, h, :])
+            m_sc[row] = m_new
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        l = l_sc[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (
+            (acc_sc[:] / l_safe)
+            .reshape(n_kv, gp, acc_sc.shape[-1])
+            .astype(o_ref.dtype)
+        )
+
+
+def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens):
+    """One-token-per-sequence attention over the paged KV cache.
+
+    q: [S, H, D] (the new token's queries, KV already written)
+    k_cache/v_cache: [num_blocks, block_size, KV, D]
+    block_table: [S, NB] int32 — cache block ids per sequence
+    ctx_lens: [S] int32 — context length INCLUDING the new token; rows
+      with 0 are batch padding (output is garbage, sliced by the caller)
+    returns: [S, H, D]
+    """
+    S, H, D = q.shape
+    NBLK, bs, KV, _ = k_cache.shape
+    NB = block_table.shape[1]
+    G = H // KV
+    Gp = max(G, 8)  # sublane-pad tiny query blocks
+    scale = 1.0 / (D**0.5)
+
+    qg = q.reshape(S, KV, G, D)
+    if Gp != G:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, Gp - G), (0, 0)))
+
+    def kv_index(s, j, tbl_ref, ctx_ref):
+        last = jnp.maximum(ctx_ref[s] - 1, 0) // bs
+        return (tbl_ref[s, jnp.minimum(j, last)], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, NB),
+        in_specs=[
+            pl.BlockSpec((1, KV, Gp, D), lambda s, j, tbl, ctx: (s, 0, 0, 0)),
+            pl.BlockSpec((1, bs, KV, D), kv_index),
+            pl.BlockSpec((1, bs, KV, D), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, KV, Gp, D), lambda s, j, tbl, ctx: (s, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((KV * Gp, D), jnp.float32),
+            pltpu.VMEM((KV * Gp, 1), jnp.float32),
+            pltpu.VMEM((KV * Gp, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel, block_size=bs, scale=scale, n_kv=KV, gp=Gp
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, KV, Gp, D), q.dtype),
+        interpret=_interpret(),
+    )(block_table, ctx_lens, qg, k_cache, v_cache)
+    return out[:, :, :G, :].reshape(S, H, D)
+
+
+def paged_decode_attention_xla(q, k_cache, v_cache, block_table, ctx_lens):
+    """jnp oracle for the kernel (tests; also a CPU fallback).
+
+    Gathers each sequence's paged KV into a dense [S, NB*bs, KV, D]
+    context — O(S·max_ctx) memory, fine at test scale."""
+    S, H, D = q.shape
+    _, bs, KV, _ = k_cache.shape
+    G = H // KV
+    k = k_cache[block_table].reshape(S, -1, KV, D)  # [S, NB*bs, KV, D]
+    v = v_cache[block_table].reshape(S, -1, KV, D)
+    if G > 1:
+        k = jnp.repeat(k, G, axis=2)
+        v = jnp.repeat(v, G, axis=2)
+    logits = jnp.einsum("shd,skhd->shk", q, k).astype(jnp.float32)
+    logits = logits / (D**0.5)
+    pos = jnp.arange(k.shape[1])
+    mask = pos[None, :] < ctx_lens[:, None]  # [S, NB*bs]
+    logits = jnp.where(mask[:, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("shk,skhd->shd", probs, v)
+
+
+# ---------------------------------------------------------------------------
+# paged KV write
+# ---------------------------------------------------------------------------
+
+def _kv_write_kernel(
+    slots_ref, kn_ref, vn_ref, ck_in, cv_in, ck_out, cv_out,
+    *, block_size: int,
+):
+    """Read-modify-write one token row into its cache block.
+
+    XLA's scatter lowering costs ~3ms per call on TPU regardless of size
+    (measured, docs/PROFILE_r02.md); at 2 scatters x n_layers per decode
+    step that dominated the engine. This kernel instead RMWs whole cache
+    blocks through VMEM: tokens are pre-sorted by slot so consecutive
+    grid steps hitting the same block keep it resident, and the block is
+    copied from the aliased input only on first visit (a later copy
+    would erase rows written by earlier same-block steps)."""
+    t = pl.program_id(0)
+    slot = slots_ref[t]
+
+    def cb(i):  # clamped block id of token i
+        return jnp.maximum(slots_ref[i], 0) // block_size
+
+    first = jnp.logical_or(t == 0, cb(t) != cb(jnp.maximum(t - 1, 0)))
+
+    @pl.when(first)
+    def _copy():
+        ck_out[...] = ck_in[...]
+        cv_out[...] = cv_in[...]
+
+    @pl.when(slot >= 0)
+    def _write():
+        # Mosaic cannot vector-store at a dynamic sublane offset, so the
+        # row write is a masked full-block select (VPU, block in VMEM)
+        off = slot % block_size
+        row = jax.lax.broadcasted_iota(jnp.int32, (1, block_size, 1, 1), 1)
+        mask = row == off
+        kn = kn_ref[0][None, None]  # (1, 1, KV, D)
+        vn = vn_ref[0][None, None]
+        ck_out[...] = jnp.where(mask, kn, ck_out[...])
+        cv_out[...] = jnp.where(mask, vn, cv_out[...])
+
+
+def paged_kv_write(cache_k, cache_v, k_new, v_new, flat_slots):
+    """Write [T, KV, D] new KV rows into [NBLK, bs, KV, D] caches at flat
+    slot ids [T] (block*bs + offset; -1 rows are dropped). The TPU-native
+    fused-cache-store (ref: inference/v2/kernels/ragged_ops/
+    linear_blocked_kv_rotary/ — rotary is applied upstream in XLA)."""
+    NBLK, bs, KV, D = cache_k.shape
+    T = flat_slots.shape[0]
+    order = jnp.argsort(flat_slots)
+    slots = flat_slots[order].astype(jnp.int32)
+    kn = k_new[order]
+    vn = v_new[order]
+
+    def cache_index(t, slots_ref):
+        return (jnp.maximum(slots_ref[t], 0) // bs, 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, KV, D), lambda t, slots_ref: (t, 0, 0)),
+            pl.BlockSpec((1, KV, D), lambda t, slots_ref: (t, 0, 0)),
+            pl.BlockSpec((1, bs, KV, D), cache_index),
+            pl.BlockSpec((1, bs, KV, D), cache_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bs, KV, D), cache_index),
+            pl.BlockSpec((1, bs, KV, D), cache_index),
+        ],
+        scratch_shapes=[],
+    )
+    return pl.pallas_call(
+        functools.partial(_kv_write_kernel, block_size=bs),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(cache_k.shape, cache_k.dtype),
+            jax.ShapeDtypeStruct(cache_v.shape, cache_v.dtype),
+        ],
+        # alias caches through: in-place RMW, no copy of the arena
+        input_output_aliases={3: 0, 4: 1},
+        interpret=_interpret(),
+    )(slots, kn, vn, cache_k, cache_v)

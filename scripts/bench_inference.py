@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Inference benchmark: decode throughput + prefill latency (TTFT) for
+the flagship 350M Llama-class model on one chip.
+
+The FastGen-class serving numbers (BASELINE.md rows 6-8) are for 70B on
+4xA100; this records the single-v5e-chip equivalent for OUR flagship so
+rounds can track regressions. Times the compiled decode/prefill steps
+device-side (through the axon tunnel, engine-level put() timing is
+dominated by the ~90ms host-readback round trip of the logits, which
+real deployments don't pay per token). Prints one JSON line."""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference import model as M
+    from deepspeed_tpu.inference import init_inference
+    from deepspeed_tpu.models import transformer as T
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        mcfg = T.TransformerConfig(
+            vocab_size=32000, n_layers=24, n_heads=8, d_model=1024,
+            max_seq=2048, variant="llama", use_flash=True,
+        )
+        batch, ctx_len, steps, blocks = 64, 512, 50, 1024
+    else:
+        mcfg = T.TransformerConfig(
+            vocab_size=512, n_layers=2, n_heads=4, d_model=128,
+            max_seq=256, variant="llama", use_flash=False,
+        )
+        batch, ctx_len, steps, blocks = 4, 32, 4, 64
+
+    params = jax.jit(
+        lambda k: jax.tree.map(lambda x: x.astype(jnp.bfloat16), T.init(mcfg, k))
+    )(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    NB = 2048 // 128
+
+    def readback(x):
+        return np.asarray(jax.tree.leaves(x)[0].ravel()[:1])
+
+    # device-side decode step
+    cache = M.init_cache(mcfg, blocks, 128, jnp.bfloat16)
+    tables = jnp.asarray(rng.integers(0, blocks, (batch, NB)).astype(np.int32))
+    toks = jnp.asarray(rng.integers(0, mcfg.vocab_size, batch).astype(np.int32))
+    ctx = jnp.full((batch,), ctx_len, jnp.int32)
+    step = jax.jit(
+        lambda p, c, t, tb, cx: M.decode_step(p, c, t, tb, cx, mcfg, on_tpu),
+        donate_argnums=(1,),
+    )
+    logits, cache = step(params, cache, toks, tables, ctx)
+    readback(logits)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        logits, cache = step(params, cache, toks, tables, ctx)
+    readback(logits)
+    dt = (time.perf_counter() - t0) / steps
+    tok_s = batch / dt
+
+    # device-side prefill (TTFT component)
+    pre = jax.jit(
+        lambda p, c, t, n, tb: M.prefill_step(p, c, t, n, tb, mcfg, on_tpu),
+        donate_argnums=(1,),
+    )
+    ptoks = jnp.asarray(rng.integers(0, mcfg.vocab_size, ctx_len).astype(np.int32))
+    table1 = jnp.arange(NB, dtype=jnp.int32)
+    lg, cache = pre(params, cache, ptoks, jnp.int32(ctx_len), table1)
+    readback(lg)
+    t0 = time.perf_counter()
+    for _ in range(max(steps // 5, 2)):
+        lg, cache = pre(params, cache, ptoks, jnp.int32(ctx_len), table1)
+    readback(lg)
+    ttft = (time.perf_counter() - t0) / max(steps // 5, 2)
+
+    # engine-level sanity: a real put() round trip (includes host sync);
+    # free the direct-bench cache first — two arenas don't fit in HBM
+    del cache, logits, lg
+    eng = init_inference(
+        params, mcfg,
+        {"max_batch_size": batch, "max_seq_len": 2048, "kv_block_size": 128,
+         "num_kv_blocks": blocks, "max_tracked_sequences": batch + 1},
+    )
+    eng.put([0], [rng.integers(0, mcfg.vocab_size, ctx_len).astype(np.int32)])
+    eng.put([0], [np.asarray([1])])  # compile the decode bucket
+    t0 = time.perf_counter()
+    eng.put([0], [np.asarray([2])])
+    put_ms = (time.perf_counter() - t0) * 1e3
+
+    print(json.dumps({
+        "metric": "llama_350m_decode_tokens_per_sec",
+        "value": round(tok_s, 1), "unit": "tokens/s",
+        "batch": batch, "ctx": ctx_len,
+        "decode_step_ms": round(dt * 1e3, 2),
+        "prefill_ms": round(ttft * 1e3, 1),
+        "engine_put_roundtrip_ms": round(put_ms, 1),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -155,3 +155,24 @@ def test_activation_checkpointing_policy_validated():
     cfg = parse_config({"train_micro_batch_size_per_gpu": 1,
                         "activation_checkpointing": {"policy": "dots"}})
     assert cfg.activation_checkpointing.policy == "dots"
+
+
+def test_disabled_unimplemented_blocks_parse():
+    """Review finding: stock configs carry disabled feature blocks."""
+    cfg = parse_config({
+        "train_micro_batch_size_per_gpu": 1,
+        "autotuning": {"enabled": False},
+        "curriculum_learning": {"enabled": False},
+    })
+    assert cfg.train_micro_batch_size_per_gpu == 1
+    with pytest.raises(NotImplementedError):
+        parse_config({"train_micro_batch_size_per_gpu": 1,
+                      "autotuning": {"enabled": True}})
+
+
+def test_gradient_predivide_factor_guard():
+    cfg = parse_config({"train_micro_batch_size_per_gpu": 1,
+                        "gradient_predivide_factor": 1.0})  # no-op value ok
+    with pytest.raises(NotImplementedError):
+        parse_config({"train_micro_batch_size_per_gpu": 1,
+                      "gradient_predivide_factor": 2.0})

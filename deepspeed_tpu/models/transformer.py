@@ -49,6 +49,14 @@ class TransformerConfig:
     tie_embeddings: bool = True
     remat: str = "none"  # none | full | dots (jax.checkpoint policy)
     use_flash: bool = True  # pallas flash attention on TPU, XLA fallback elsewhere
+    # MoE (ref: deepspeed/moe/layer.py MoE:17 knobs). n_experts > 0 turns
+    # every MLP into an expert-parallel MoE FFN.
+    n_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_min_capacity: int = 4
+    moe_aux_loss_coef: float = 0.01
+    moe_noisy_gate_policy: Optional[str] = None  # None | RSample | Jitter
 
     @property
     def kv_heads(self) -> int:
@@ -97,17 +105,33 @@ def _layer_shapes(cfg: TransformerConfig) -> Dict[str, Tuple[Tuple[int, ...], Tu
         "wk": ((E, KV, D), ("embed", "heads", "head_dim")),
         "wv": ((E, KV, D), ("embed", "heads", "head_dim")),
         "wo": ((H, D, E), ("heads", "head_dim", "embed")),
-        "w_in": ((E, F), ("embed", "mlp")),
-        "w_out": ((F, E), ("mlp", "embed")),
     }
-    if cfg.variant == "llama":
-        shapes["w_gate"] = ((E, F), ("embed", "mlp"))
+    X = cfg.n_experts
+    if X > 0:
+        # Expert-stacked FFN weights: leading experts dim shards over the
+        # 'expert' mesh axis; the expert-hidden dim may additionally shard
+        # over 'model' (ref: moe/experts.py local expert bundle — here one
+        # stacked array instead of a ModuleList).
+        shapes.update({
+            "w_router": ((E, X), ("embed", None)),
+            "w_in": ((X, E, F), ("expert", "embed", "expert_mlp")),
+            "w_out": ((X, F, E), ("expert", "expert_mlp", "embed")),
+        })
+        if cfg.variant == "llama":
+            shapes["w_gate"] = ((X, E, F), ("expert", "embed", "expert_mlp"))
     else:
+        shapes.update({
+            "w_in": ((E, F), ("embed", "mlp")),
+            "w_out": ((F, E), ("mlp", "embed")),
+        })
+        if cfg.variant == "llama":
+            shapes["w_gate"] = ((E, F), ("embed", "mlp"))
+    if cfg.variant != "llama":
         shapes.update({
             "ln1_bias": ((E,), ("embed",)),
             "ln2_bias": ((E,), ("embed",)),
-            "b_in": ((F,), ("mlp",)),
-            "b_out": ((E,), ("embed",)),
+            "b_in": (((X, F) if X > 0 else (F,)), (("expert", "expert_mlp") if X > 0 else ("mlp",))),
+            "b_out": (((X, E) if X > 0 else (E,)), (("expert", "embed") if X > 0 else ("embed",))),
             "bq": ((H, D), ("heads", "head_dim")),
             "bk": ((KV, D), ("heads", "head_dim")),
             "bv": ((KV, D), ("heads", "head_dim")),
@@ -250,6 +274,9 @@ def _attention_block(x, lp, cfg: TransformerConfig, rng=None):
 
 
 def _mlp_block(x, lp, cfg: TransformerConfig, rng=None):
+    """Dense or MoE FFN; returns (residual output, moe aux loss)."""
+    if cfg.n_experts > 0:
+        return _moe_mlp_block(x, lp, cfg, rng)
     h = _norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg)
     if cfg.variant == "llama":
         gate = jnp.einsum("bse,ef->bsf", h, lp["w_gate"].astype(x.dtype))
@@ -264,7 +291,55 @@ def _mlp_block(x, lp, cfg: TransformerConfig, rng=None):
     if cfg.variant == "gpt2":
         out = out + lp["b_out"].astype(x.dtype)
     out = _dropout(out, cfg.dropout, rng)
-    return x + out
+    return x + out, jnp.float32(0.0)
+
+
+def _moe_mlp_block(x, lp, cfg: TransformerConfig, rng=None):
+    """Expert-parallel MoE FFN (ref: deepspeed/moe/sharded_moe.py
+    MOELayer:421 — dispatch einsum / all-to-all / expert FFN / combine)."""
+    from ..moe.sharded_moe import moe_ffn
+
+    B, S, E = x.shape
+    h = _norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg)
+    tokens = h.reshape(B * S, E)
+
+    def expert_fn(xin):  # [X, C, E] expert-major
+        if cfg.variant == "llama":
+            gate = jnp.einsum("xce,xef->xcf", xin, lp["w_gate"].astype(x.dtype))
+            up = jnp.einsum("xce,xef->xcf", xin, lp["w_in"].astype(x.dtype))
+            inner = jax.nn.silu(gate) * up
+        else:
+            inner = jax.nn.gelu(
+                jnp.einsum("xce,xef->xcf", xin, lp["w_in"].astype(x.dtype))
+                + lp["b_in"][:, None, :].astype(x.dtype)
+            )
+        inner = _shard(inner, "expert", None, "model")
+        out = jnp.einsum("xcf,xfe->xce", inner, lp["w_out"].astype(x.dtype))
+        if cfg.variant == "gpt2":
+            out = out + lp["b_out"][:, None, :].astype(x.dtype)
+        return out
+
+    def shard(t, *spec):
+        return _shard(t, *spec)
+
+    gate_rng = None
+    if rng is not None and cfg.moe_noisy_gate_policy is not None:
+        rng, gate_rng = jax.random.split(rng)
+    out, l_aux = moe_ffn(
+        tokens,
+        lp["w_router"],
+        expert_fn,
+        top_k=cfg.moe_top_k,
+        capacity_factor=cfg.moe_capacity_factor,
+        min_capacity=cfg.moe_min_capacity,
+        rng=gate_rng,
+        noisy_gate_policy=cfg.moe_noisy_gate_policy,
+        shard=shard,
+    )
+    out = out.reshape(B, S, E)
+    out = _shard(out, DP, "seq", None)
+    out = _dropout(out, cfg.dropout, rng)
+    return x + out, l_aux
 
 
 _REMAT_POLICIES = {
@@ -274,26 +349,34 @@ _REMAT_POLICIES = {
 }
 
 
-def forward_hidden(params: Dict[str, Any], tokens, cfg: TransformerConfig, rng=None):
-    """tokens [B, S] int32 → final hidden states [B, S, E] (post ln_f)."""
+def forward_hidden(
+    params: Dict[str, Any], tokens, cfg: TransformerConfig, rng=None, with_aux: bool = False
+):
+    """tokens [B, S] int32 → final hidden states [B, S, E] (post ln_f).
+
+    with_aux=True additionally returns {"moe_aux_loss": scalar} (sum of
+    per-layer load-balancing losses; 0 for dense models)."""
     x = params["embed"][tokens]
     x = _shard(x, DP, "seq", None)
     if cfg.variant == "gpt2":
         x = x + params["pos_embed"][: tokens.shape[1]].astype(x.dtype)
 
-    use_dropout = cfg.dropout > 0.0 and rng is not None
+    # MoE gate noise also wants per-layer rngs, not just dropout.
+    use_rng = rng is not None and (
+        cfg.dropout > 0.0 or (cfg.n_experts > 0 and cfg.moe_noisy_gate_policy is not None)
+    )
 
     def layer_body(carry, xs):
-        if use_dropout:
+        if use_rng:
             h0, (lp, layer_rng) = carry, xs
             r1, r2 = jax.random.split(layer_rng)
         else:
             h0, lp = carry, xs
             r1 = r2 = None
         h = _attention_block(h0, lp, cfg, r1)
-        h = _mlp_block(h, lp, cfg, r2)
+        h, l_aux = _mlp_block(h, lp, cfg, r2)
         h = _shard(h, DP, "seq", None)
-        return h, None
+        return h, l_aux
 
     if cfg.remat == "full":
         layer_body = jax.checkpoint(layer_body)
@@ -302,12 +385,15 @@ def forward_hidden(params: Dict[str, Any], tokens, cfg: TransformerConfig, rng=N
             layer_body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
         )
 
-    if use_dropout:
+    if use_rng:
         layer_rngs = jax.random.split(rng, cfg.n_layers)
-        x, _ = jax.lax.scan(layer_body, x, (params["layers"], layer_rngs))
+        x, aux = jax.lax.scan(layer_body, x, (params["layers"], layer_rngs))
     else:
-        x, _ = jax.lax.scan(layer_body, x, params["layers"])
-    return _norm(x, params["ln_f_scale"], params.get("ln_f_bias"), cfg)
+        x, aux = jax.lax.scan(layer_body, x, params["layers"])
+    out = _norm(x, params["ln_f_scale"], params.get("ln_f_bias"), cfg)
+    if with_aux:
+        return out, {"moe_aux_loss": jnp.sum(aux)}
+    return out
 
 
 def forward(params: Dict[str, Any], tokens, cfg: TransformerConfig, rng=None):
@@ -363,7 +449,7 @@ def make_loss_fn(cfg: TransformerConfig, loss_chunks: int = 8):
     def loss_fn(params, batch, rng):
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        x = forward_hidden(params, inputs, cfg, rng)
+        x, aux = forward_hidden(params, inputs, cfg, rng, with_aux=True)
         head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
         mask = (
             batch["mask"][:, 1:].astype(jnp.float32)
@@ -372,6 +458,11 @@ def make_loss_fn(cfg: TransformerConfig, loss_chunks: int = 8):
         )
         n = loss_chunks if inputs.shape[1] % max(loss_chunks, 1) == 0 else 1
         tot, cnt = _chunked_ce(x, head, targets, mask, max(n, 1))
-        return tot / jnp.maximum(cnt, 1.0)
+        loss = tot / jnp.maximum(cnt, 1.0)
+        if cfg.n_experts > 0:
+            # Load-balancing aux loss, coefficient per the reference's
+            # Megatron-DeepSpeed recipe (ref: sharded_moe.py l_aux usage).
+            loss = loss + cfg.moe_aux_loss_coef * aux["moe_aux_loss"]
+        return loss
 
     return loss_fn

@@ -1,0 +1,7 @@
+from .sharded_moe import (  # noqa: F401
+    compute_capacity,
+    moe_ffn,
+    top1_gating,
+    top2_gating,
+    topk_gating,
+)

@@ -1,0 +1,195 @@
+"""Mixture-of-Experts with expert parallelism (GShard/Switch-style).
+
+TPU-native redesign of the reference MoE stack
+(ref: deepspeed/moe/sharded_moe.py — top1gating:180, top2gating:278,
+_AllToAll:95, MOELayer:421; deepspeed/moe/layer.py MoE:17; expert/data
+group carving deepspeed/utils/groups.py:113).
+
+Where the reference dispatches tokens with an explicit
+torch.distributed all-to-all autograd function between einsums, here
+dispatch/combine are einsums against a one-hot dispatch tensor plus a
+sharding constraint putting the experts dim on the 'expert' mesh axis —
+the XLA SPMD partitioner emits the all-to-all pair in forward and its
+transpose in backward. The expert axis is carved out of the
+data-parallel world exactly like the reference (batch shards over
+data×expert; expert weights shard over 'expert'), so EP size never
+changes the global math — only the layout.
+
+All gating math runs in fp32 regardless of compute dtype (the reference
+casts gate inputs to fp32 at sharded_moe.py TopKGate.forward).
+"""
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def compute_capacity(
+    num_tokens: int, num_experts: int, capacity_factor: float, min_capacity: int = 4
+) -> int:
+    """Static per-expert token capacity
+    (ref: sharded_moe.py _capacity — ceil(tokens/experts * factor))."""
+    cap = int(math.ceil(num_tokens / num_experts * capacity_factor))
+    return max(cap, min_capacity)
+
+
+def _one_hot(x, n, dtype=jnp.float32):
+    return jax.nn.one_hot(x, n, dtype=dtype)
+
+
+def _load_balance_loss(gates, mask):
+    """l_aux = E * Σ_e mean_t(gate_e) · mean_t(assigned_e)  — 1.0 at uniform
+    (ref: sharded_moe.py top1gating l_aux)."""
+    num_experts = gates.shape[-1]
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask.astype(jnp.float32), axis=0)
+    return num_experts * jnp.sum(me * ce)
+
+
+def _apply_noise(logits, rng, policy: Optional[str]):
+    """Noisy gating (ref: sharded_moe.py multiplicative_jitter / RSample
+    noisy_gate_policy). No-op when rng is None (eval) or policy unset."""
+    if rng is None or policy is None:
+        return logits
+    if policy == "RSample":
+        return logits + jax.random.normal(rng, logits.shape, logits.dtype)
+    if policy == "Jitter":
+        eps = 1e-2
+        return logits * jax.random.uniform(
+            rng, logits.shape, logits.dtype, 1.0 - eps, 1.0 + eps
+        )
+    raise ValueError(f"unknown noisy_gate_policy {policy!r}")
+
+
+def top1_gating(
+    logits,
+    capacity_factor: float = 1.0,
+    min_capacity: int = 4,
+    rng=None,
+    noisy_gate_policy: Optional[str] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Switch-style top-1 gating (ref: sharded_moe.py top1gating:180).
+
+    logits: [T, X] router outputs (any float dtype; math is fp32).
+    Returns (combine [T,X,C] fp32, dispatch [T,X,C] bool, l_aux scalar).
+    Tokens beyond an expert's capacity are dropped (their combine row is
+    zero — the residual connection around the MoE block carries them).
+    """
+    T, X = logits.shape
+    C = compute_capacity(T, X, capacity_factor, min_capacity)
+    logits = logits.astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    noisy = _apply_noise(logits, rng, noisy_gate_policy)
+    index = jnp.argmax(noisy, axis=-1)  # [T]
+    mask = _one_hot(index, X)  # [T, X]
+
+    l_aux = _load_balance_loss(gates, mask)
+
+    # Position of each token within its expert's queue; drop overflows.
+    locations = jnp.cumsum(mask, axis=0) - mask  # [T, X], fp32 counts
+    locations = jnp.sum(locations * mask, axis=-1).astype(jnp.int32)  # [T]
+    keep = (locations < C) & (mask.sum(-1) > 0).astype(bool)
+    gate_val = jnp.sum(gates * mask, axis=-1)  # [T]
+
+    dispatch = (
+        mask[:, :, None] * _one_hot(locations, C)[:, None, :]
+    ) * keep[:, None, None]
+    combine = dispatch * gate_val[:, None, None]
+    return combine, dispatch > 0, l_aux
+
+
+def top2_gating(
+    logits,
+    capacity_factor: float = 1.0,
+    min_capacity: int = 4,
+    rng=None,
+    noisy_gate_policy: Optional[str] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """GShard-style top-2 gating (ref: sharded_moe.py top2gating:278).
+
+    Second choice is the argmax after masking the first; gate values of
+    the two kept experts are renormalized to sum to 1.
+    """
+    T, X = logits.shape
+    C = compute_capacity(T, X, capacity_factor * 2.0, min_capacity)
+    logits = logits.astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    noisy = _apply_noise(logits, rng, noisy_gate_policy)
+    index1 = jnp.argmax(noisy, axis=-1)
+    mask1 = _one_hot(index1, X)
+    masked = jnp.where(mask1 > 0, -jnp.inf, noisy)
+    index2 = jnp.argmax(masked, axis=-1)
+    mask2 = _one_hot(index2, X)
+
+    l_aux = _load_balance_loss(gates, mask1)
+
+    loc1 = jnp.cumsum(mask1, axis=0) - mask1
+    # Second-choice tokens queue after all first-choice tokens per expert.
+    loc2 = jnp.cumsum(mask2, axis=0) - mask2 + jnp.sum(mask1, axis=0, keepdims=True)
+    pos1 = jnp.sum(loc1 * mask1, axis=-1).astype(jnp.int32)
+    pos2 = jnp.sum(loc2 * mask2, axis=-1).astype(jnp.int32)
+    keep1 = pos1 < C
+    keep2 = pos2 < C
+
+    g1 = jnp.sum(gates * mask1, axis=-1) * keep1
+    g2 = jnp.sum(gates * mask2, axis=-1) * keep2
+    denom = jnp.maximum(g1 + g2, jnp.finfo(jnp.float32).eps)
+    g1, g2 = g1 / denom, g2 / denom
+
+    d1 = (mask1[:, :, None] * _one_hot(pos1, C)[:, None, :]) * keep1[:, None, None]
+    d2 = (mask2[:, :, None] * _one_hot(pos2, C)[:, None, :]) * keep2[:, None, None]
+    combine = d1 * g1[:, None, None] + d2 * g2[:, None, None]
+    dispatch = (d1 + d2) > 0
+    return combine, dispatch, l_aux
+
+
+def topk_gating(logits, top_k: int, **kw):
+    if top_k == 1:
+        return top1_gating(logits, **kw)
+    if top_k == 2:
+        return top2_gating(logits, **kw)
+    raise ValueError(f"moe top_k must be 1 or 2, got {top_k}")
+
+
+def moe_ffn(
+    tokens,  # [T, E] flattened tokens, compute dtype
+    router_w,  # [E, X]
+    expert_fn,  # ([X, C, E] expert-major inputs) -> [X, C, E] outputs
+    *,
+    top_k: int = 1,
+    capacity_factor: float = 1.0,
+    min_capacity: int = 4,
+    rng=None,
+    noisy_gate_policy: Optional[str] = None,
+    shard=None,  # fn(x, *logical_spec) applying a sharding constraint
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dispatch→expert→combine core (ref: sharded_moe.py MOELayer.forward:421).
+
+    The einsum pair around `expert_fn` contracts the token dim (sharded
+    over data×expert) into the experts dim (sharded over 'expert') and
+    back — under SPMD that IS the reference's all-to-all pair
+    (ref: _AllToAll:95), chosen by the XLA partitioner instead of issued
+    by hand. Returns (output [T, E], l_aux).
+    """
+    dtype = tokens.dtype
+    logits = tokens.astype(jnp.float32) @ router_w.astype(jnp.float32)  # [T, X]
+    combine, dispatch, l_aux = topk_gating(
+        logits,
+        top_k,
+        capacity_factor=capacity_factor,
+        min_capacity=min_capacity,
+        rng=rng,
+        noisy_gate_policy=noisy_gate_policy,
+    )
+    x = jnp.einsum("txc,te->xce", dispatch.astype(dtype), tokens)
+    if shard is not None:
+        x = shard(x, "expert", None, None)
+    y = expert_fn(x)  # [X, C, E]
+    if shard is not None:
+        y = shard(y, "expert", None, None)
+    out = jnp.einsum("txc,xce->te", combine.astype(dtype), y)
+    return out, l_aux

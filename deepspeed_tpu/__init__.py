@@ -31,6 +31,7 @@ def initialize(
     rules: Optional[Dict[str, Any]] = None,
     has_aux: bool = False,
     init_rng=None,
+    pipelined: bool = False,
 ) -> DeepSpeedTPUEngine:
     """Build a training engine (ref: deepspeed/__init__.py:69 initialize).
 
@@ -61,6 +62,7 @@ def initialize(
         has_aux=has_aux,
         param_init_fn=param_init_fn,
         init_rng=init_rng,
+        pipelined=pipelined,
     )
 
 

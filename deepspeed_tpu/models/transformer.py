@@ -57,6 +57,10 @@ class TransformerConfig:
     moe_min_capacity: int = 4
     moe_aux_loss_coef: float = 0.01
     moe_noisy_gate_policy: Optional[str] = None  # None | RSample | Jitter
+    # Pipeline parallelism (ref: runtime/pipe/module.py PipelineModule).
+    # >1 stores layers stage-partitioned [P, L/P, ...] and routes the
+    # forward through runtime/pipe.pipeline_apply.
+    pipeline_stages: int = 1
 
     @property
     def kv_heads(self) -> int:
@@ -170,6 +174,10 @@ def init(cfg: TransformerConfig, rng) -> Dict[str, Any]:
             scale = std / (2 * L) ** 0.5 if name in ("wo", "w_out") else std
             layers[name] = jax.random.normal(lkeys[i], full, jnp.float32) * scale
     params["layers"] = layers
+    if cfg.pipeline_stages > 1:
+        from ..runtime.pipe import partition_layers
+
+        params["layers"] = partition_layers(params["layers"], cfg.pipeline_stages)
     return params
 
 
@@ -183,8 +191,9 @@ def logical_specs(cfg: TransformerConfig) -> Dict[str, Any]:
         specs["ln_f_bias"] = ("embed",)
     if not cfg.tie_embeddings:
         specs["lm_head"] = ("embed", "vocab")
+    lead = ("pipe_stage", "layers") if cfg.pipeline_stages > 1 else ("layers",)
     specs["layers"] = {
-        name: ("layers",) + logical for name, (_, logical) in _layer_shapes(cfg).items()
+        name: lead + logical for name, (_, logical) in _layer_shapes(cfg).items()
     }
     return specs
 
@@ -349,22 +358,16 @@ _REMAT_POLICIES = {
 }
 
 
-def forward_hidden(
-    params: Dict[str, Any], tokens, cfg: TransformerConfig, rng=None, with_aux: bool = False
-):
-    """tokens [B, S] int32 → final hidden states [B, S, E] (post ln_f).
-
-    with_aux=True additionally returns {"moe_aux_loss": scalar} (sum of
-    per-layer load-balancing losses; 0 for dense models)."""
-    x = params["embed"][tokens]
-    x = _shard(x, DP, "seq", None)
-    if cfg.variant == "gpt2":
-        x = x + params["pos_embed"][: tokens.shape[1]].astype(x.dtype)
-
-    # MoE gate noise also wants per-layer rngs, not just dropout.
-    use_rng = rng is not None and (
-        cfg.dropout > 0.0 or (cfg.n_experts > 0 and cfg.moe_noisy_gate_policy is not None)
+def _wants_rng(cfg: TransformerConfig) -> bool:
+    """MoE gate noise also wants per-layer rngs, not just dropout."""
+    return cfg.dropout > 0.0 or (
+        cfg.n_experts > 0 and cfg.moe_noisy_gate_policy is not None
     )
+
+
+def _make_layer_body(cfg: TransformerConfig, use_rng: bool):
+    """One transformer layer as a scan body (shared by the flat
+    scan-over-layers path and the pipelined per-stage path)."""
 
     def layer_body(carry, xs):
         if use_rng:
@@ -384,12 +387,38 @@ def forward_hidden(
         layer_body = jax.checkpoint(
             layer_body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
         )
+    return layer_body
+
+
+def forward_hidden(
+    params: Dict[str, Any], tokens, cfg: TransformerConfig, rng=None, with_aux: bool = False
+):
+    """tokens [B, S] int32 → final hidden states [B, S, E] (post ln_f).
+
+    with_aux=True additionally returns {"moe_aux_loss": scalar} (sum of
+    per-layer load-balancing losses; 0 for dense models)."""
+    x = params["embed"][tokens]
+    x = _shard(x, DP, "seq", None)
+    if cfg.variant == "gpt2":
+        x = x + params["pos_embed"][: tokens.shape[1]].astype(x.dtype)
+
+    use_rng = rng is not None and _wants_rng(cfg)
+    layer_body = _make_layer_body(cfg, use_rng)
+
+    layers = params["layers"]
+    if cfg.pipeline_stages > 1:
+        # Params trained pipelined are stored stage-partitioned
+        # [P, L/P, ...]; flatten back so the flat forward (generation,
+        # eval without a pipe mesh) works on the same tree.
+        from ..runtime.pipe import unpartition_layers
+
+        layers = unpartition_layers(layers)
 
     if use_rng:
         layer_rngs = jax.random.split(rng, cfg.n_layers)
-        x, aux = jax.lax.scan(layer_body, x, (params["layers"], layer_rngs))
+        x, aux = jax.lax.scan(layer_body, x, (layers, layer_rngs))
     else:
-        x, aux = jax.lax.scan(layer_body, x, params["layers"])
+        x, aux = jax.lax.scan(layer_body, x, layers)
     out = _norm(x, params["ln_f_scale"], params.get("ln_f_bias"), cfg)
     if with_aux:
         return out, {"moe_aux_loss": jnp.sum(aux)}
@@ -440,6 +469,29 @@ def _chunked_ce(x, head, targets, mask, n_chunks: int):
     return tot, cnt
 
 
+def _lm_head(params: Dict[str, Any], cfg: TransformerConfig):
+    return params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+
+
+def _shift_mask(batch, targets):
+    """Loss mask aligned with the shifted targets ([..., 1:])."""
+    if "mask" in batch:
+        return batch["mask"][..., 1:].astype(jnp.float32)
+    return jnp.ones(targets.shape, jnp.float32)
+
+
+def _ce_chunk_count(seq_len: int, loss_chunks: int) -> int:
+    return max(loss_chunks if seq_len % max(loss_chunks, 1) == 0 else 1, 1)
+
+
+def _token_mean_ce(x, head, targets, mask, n_chunks: int):
+    """Token-mean CE for one (micro)batch — the single shared loss tail
+    for the flat and pipelined paths (identical numerics by
+    construction)."""
+    tot, cnt = _chunked_ce(x, head, targets, mask, n_chunks)
+    return tot / jnp.maximum(cnt, 1.0)
+
+
 def make_loss_fn(cfg: TransformerConfig, loss_chunks: int = 8):
     """Next-token cross-entropy over batch {"tokens": [B, S(+1)]}.
 
@@ -450,19 +502,105 @@ def make_loss_fn(cfg: TransformerConfig, loss_chunks: int = 8):
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
         x, aux = forward_hidden(params, inputs, cfg, rng, with_aux=True)
-        head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-        mask = (
-            batch["mask"][:, 1:].astype(jnp.float32)
-            if "mask" in batch
-            else jnp.ones(targets.shape, jnp.float32)
-        )
-        n = loss_chunks if inputs.shape[1] % max(loss_chunks, 1) == 0 else 1
-        tot, cnt = _chunked_ce(x, head, targets, mask, max(n, 1))
-        loss = tot / jnp.maximum(cnt, 1.0)
+        n = _ce_chunk_count(inputs.shape[1], loss_chunks)
+        loss = _token_mean_ce(x, _lm_head(params, cfg), targets, _shift_mask(batch, targets), n)
         if cfg.n_experts > 0:
             # Load-balancing aux loss, coefficient per the reference's
             # Megatron-DeepSpeed recipe (ref: sharded_moe.py l_aux usage).
             loss = loss + cfg.moe_aux_loss_coef * aux["moe_aux_loss"]
+        return loss
+
+    return loss_fn
+
+
+# ---------------------------------------------------------------------------
+# pipeline-parallel forward + loss (runtime/pipe.py integration)
+# ---------------------------------------------------------------------------
+
+def make_pipelined_loss_fn(cfg: TransformerConfig, loss_chunks: int = 8):
+    """Pipeline-parallel next-token CE over batch {"tokens": [M, mb, S+1]}.
+
+    The engine's gradient-accumulation microbatches ARE the pipeline
+    microbatches (ref: runtime/pipe/engine.py train_batch:323 — there the
+    1F1B instruction schedule pumps `gradient_accumulation_steps`
+    microbatches; here runtime/pipe.pipeline_apply runs them through the
+    stage-sharded layer stack in one SPMD program). Use with an engine
+    built with pipelined=True so the whole [M, mb, ...] batch reaches
+    this loss in one call.
+
+    Numerics match the flat model: microbatch m's rng is fold_in(rng, m)
+    and per-layer keys are split over all L layers then stage-sliced, so
+    pipe=P reproduces pipe=1 trajectories exactly (dropout included).
+    The loss is the mean over microbatches of per-microbatch token-mean
+    CE — identical to the flat engine's mean-of-micro-losses.
+    """
+    from ..runtime.pipe import pipeline_apply, stage_slice_keys
+
+    n_stage = cfg.pipeline_stages
+    if cfg.n_layers % max(n_stage, 1) != 0:
+        raise ValueError(
+            f"n_layers {cfg.n_layers} not divisible by pipeline_stages {n_stage}"
+        )
+    lps = cfg.n_layers // max(n_stage, 1)
+
+    def loss_fn(params, batch, rng):
+        tokens = batch["tokens"]
+        if tokens.ndim != 3:
+            raise ValueError(
+                f"pipelined loss expects tokens [M, mb, S+1], got {tokens.shape}"
+            )
+        M, mb, _ = tokens.shape
+        inputs, targets = tokens[:, :, :-1], tokens[:, :, 1:]
+        S = inputs.shape[-1]
+
+        # Embedding runs replicated over 'pipe' (cheap gather); the heavy
+        # layer stack runs stage-sharded.
+        x = params["embed"][inputs]
+        if cfg.variant == "gpt2":
+            x = x + params["pos_embed"][:S].astype(x.dtype)
+        x = _shard(x, None, DP, "seq", None)
+
+        use_rng = rng is not None and _wants_rng(cfg)
+        layer_body = _make_layer_body(cfg, use_rng)
+
+        def stage_fn(lp_stage, carry, mb_key, stage_idx):
+            h, aux = carry
+            if use_rng:
+                keys = stage_slice_keys(mb_key, cfg.n_layers, stage_idx, lps)
+                h, l_aux = jax.lax.scan(layer_body, h, (lp_stage, keys))
+            else:
+                h, l_aux = jax.lax.scan(layer_body, h, lp_stage)
+            return h, aux + jnp.sum(l_aux)
+
+        carry_in = (x, jnp.zeros((M,), jnp.float32))
+        state_spec = (P("pipe", DP, "seq", None), P("pipe"))
+        layers = params["layers"]
+        if n_stage <= 1:
+            # degenerate single-stage pipeline: layers stay [L, ...] in
+            # storage; add the [1, L, ...] stage dim at trace time
+            layers = jax.tree.map(lambda l: l[None], layers)
+        hidden, aux = pipeline_apply(
+            stage_fn,
+            layers,
+            carry_in,
+            rng=rng if use_rng else None,
+            state_spec=state_spec,
+        )
+
+        # Head/loss: shard microbatches over 'pipe' so the CE work (the
+        # reference computes loss only on the last stage) splits across
+        # stages instead of replicating.
+        hidden = _shard(hidden, "pipe", DP, "seq", None)
+        x_out = _norm(hidden, params["ln_f_scale"], params.get("ln_f_bias"), cfg)
+        head = _lm_head(params, cfg)
+        mask = _shift_mask(batch, targets)
+        n = _ce_chunk_count(S, loss_chunks)
+        per_micro = jax.vmap(
+            lambda xc, tc, mc: _token_mean_ce(xc, head, tc, mc, n)
+        )(x_out, targets, mask)
+        loss = jnp.mean(per_micro)
+        if cfg.n_experts > 0:
+            loss = loss + cfg.moe_aux_loss_coef * jnp.mean(aux)
         return loss
 
     return loss_fn

@@ -34,6 +34,7 @@ DEFAULT_LOGICAL_RULES: List[Tuple[str, MeshAxes]] = [
     ("expert_mlp", "model"),
     ("kv_length", None),
     ("layers", None),  # stacked-layer leading dim (scan-over-layers)
+    ("pipe_stage", "pipe"),  # pipeline-stage leading dim (runtime/pipe.py)
 ]
 
 

@@ -82,21 +82,32 @@ class DeepSpeedTPUEngine:
         has_aux: bool = False,
         param_init_fn: Optional[Callable] = None,
         init_rng: Optional[Any] = None,
+        pipelined: bool = False,
     ):
         """`params` is either a concrete pytree, or (with `param_init_fn`)
         a pytree of ShapeDtypeStructs — then params are materialized
         *directly sharded* by running init under jit with out_shardings,
-        the functional zero.Init (ref: partition_parameters.py Init:780)."""
+        the functional zero.Init (ref: partition_parameters.py Init:780).
+
+        pipelined=True declares a pipeline-parallel loss_fn (e.g.
+        models.transformer.make_pipelined_loss_fn): it receives the WHOLE
+        [gas, micro_batch, ...] batch in one call and runs the microbatch
+        loop itself through the stage-sharded layer stack
+        (runtime/pipe.py) — the PipelineEngine analog
+        (ref: runtime/pipe/engine.py:55)."""
         self.config = config
         self.loss_fn = loss_fn
         self.has_aux = has_aux
+        self.pipelined = pipelined
         self.mesh = mesh if mesh is not None else build_mesh(config.mesh.axis_sizes())
-        if self.mesh.shape.get("pipe", 1) > 1:
+        if self.mesh.shape.get("pipe", 1) > 1 and not pipelined:
             # Devices on a pipe axis would hold replicated params and
             # receive no batch shard — fail loudly (VERDICT r1 W3).
             raise NotImplementedError(
-                "mesh {pipe: >1} requires the pipeline engine; "
-                "use deepspeed_tpu.pipe (pending) or fold pipe into data/model axes"
+                "mesh {pipe: >1} requires a pipelined loss "
+                "(models.transformer.make_pipelined_loss_fn + "
+                "initialize(..., pipelined=True)) or folding pipe into "
+                "data/model axes"
             )
         self.dp_world_size = data_parallel_size(self.mesh)
         config.resolve_batch_sizes(self.dp_world_size)
@@ -242,6 +253,8 @@ class DeepSpeedTPUEngine:
                 loss_fn, policy=remat_policy, static_argnums=()
             )
 
+        pipelined = self.pipelined
+
         def step_fn(state: TrainState, batch):
             master = state.master if use_master else cast_params(state.params, jnp.float32)
             scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
@@ -268,17 +281,35 @@ class DeepSpeedTPUEngine:
                 acc = jax.tree.map(jnp.add, acc, grads)
                 return (acc, loss_sum + loss), None
 
-            zeros = jax.tree.map(
-                lambda m, s: shd.constraint(jnp.zeros(m.shape, jnp.float32), s, mesh),
-                master,
-                grad_specs,
-            )
-            idxs = jnp.arange(gas)
-            (grads, loss_sum), _ = jax.lax.scan(micro, (zeros, jnp.float32(0.0)), (idxs, batch))
+            if pipelined:
+                # The pipelined loss consumes ALL microbatches in one call
+                # (the microbatch loop lives inside runtime/pipe.py's
+                # collective-permute program) — no outer GAS scan.
+                def scaled_loss(m):
+                    p = cast_params(m, compute_dtype)
+                    out = loss_fn(p, batch, base_rng)
+                    l, _aux = out if has_aux else (out, None)
+                    return l * scale, l
 
-            inv = 1.0 / (gas * scale)
-            grads = jax.tree.map(lambda g: g * inv, grads)
-            loss = loss_sum / gas
+                grads, loss = jax.grad(scaled_loss, has_aux=True)(master)
+                grads = jax.tree.map(
+                    lambda g, s: shd.constraint(g, s, mesh), grads, grad_specs
+                )
+                grads = jax.tree.map(lambda g: g * (1.0 / scale), grads)
+            else:
+                zeros = jax.tree.map(
+                    lambda m, s: shd.constraint(jnp.zeros(m.shape, jnp.float32), s, mesh),
+                    master,
+                    grad_specs,
+                )
+                idxs = jnp.arange(gas)
+                (grads, loss_sum), _ = jax.lax.scan(
+                    micro, (zeros, jnp.float32(0.0)), (idxs, batch)
+                )
+
+                inv = 1.0 / (gas * scale)
+                grads = jax.tree.map(lambda g: g * inv, grads)
+                loss = loss_sum / gas
 
             grad_norm = global_grad_norm(grads)
             if fp16:
@@ -431,7 +462,16 @@ class DeepSpeedTPUEngine:
                 return out[0] if has_aux else out
 
             self._eval_step_fn = jax.jit(ev)
-        batch = self.shard_batch(batch, leading_accum_dim=False)
+        if self.pipelined:
+            # A pipelined loss wants [M, mb, ...]. Any 2-D batch (including
+            # partial validation batches) runs as ONE pipeline microbatch;
+            # pre-microbatched 3-D input passes through untouched.
+            def add_micro_dim(x):
+                x = np.asarray(x)
+                return x[None] if x.ndim == 2 else x
+
+            batch = jax.tree.map(add_micro_dim, batch)
+        batch = self.shard_batch(batch, leading_accum_dim=self.pipelined)
         with jax.sharding.set_mesh(self.mesh):
             return float(self._eval_step_fn(self.state.params, batch))
 

@@ -40,6 +40,18 @@ class CommsLogger:
                 f"msg={volume_bytes / 2**20:.2f}MiB (trace-time)"
             )
 
+    def record_compiled(self, volumes: Dict[str, Dict[str, float]]):
+        """Record ground-truth per-op volumes extracted from a compiled
+        step's HLO (profiling/hlo.py collective_volumes) — the collectives
+        the engine ACTUALLY runs, vs the facade's trace-time bookkeeping
+        (fixes VERDICT r1 W6)."""
+        if not self.enabled:
+            return
+        for op, v in volumes.items():
+            rec = self._records[(op, "hlo")]
+            rec["count"] += int(v["count"])
+            rec["volume"] += int(v["bytes"])
+
     def reset(self):
         self._records.clear()
 

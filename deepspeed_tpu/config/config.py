@@ -110,13 +110,16 @@ class MeshConfig(ConfigModel):
 
     pipe: int = 1
     data: int = -1
+    # ZeRO sub-group axis (MiCS): set directly, or derived from
+    # zero_optimization.zero_hpz_partition_size by the engine.
+    zero: int = 1
     expert: int = 1
     seq: int = 1
     model: int = 1
 
     def axis_sizes(self) -> Dict[str, int]:
-        return {"pipe": self.pipe, "data": self.data, "expert": self.expert,
-                "seq": self.seq, "model": self.model}
+        return {"pipe": self.pipe, "data": self.data, "zero": self.zero,
+                "expert": self.expert, "seq": self.seq, "model": self.model}
 
 
 class ActivationCheckpointingConfig(ConfigModel):
@@ -145,6 +148,21 @@ class ActivationCheckpointingConfig(ConfigModel):
                 "(expected none|full|dots|dots_no_batch)"
             )
         return self
+
+
+class AioConfig(ConfigModel):
+    """ref: csrc/aio handle knobs (deepspeed_py_aio_handle.h:15-39, config
+    'aio' block). Drives the native I/O library (csrc/aio/ds_aio.cpp)
+    behind NVMe offload: block_size chunks each request across the pool,
+    thread_count sizes the pool. queue_depth/single_submit/overlap_events
+    are libaio submission details the thread pool subsumes — accepted for
+    config compatibility, no separate effect."""
+
+    block_size: int = 1 << 20
+    queue_depth: int = 8
+    thread_count: int = 4
+    single_submit: bool = False
+    overlap_events: bool = True
 
 
 class CommsLoggerConfig(ConfigModel):
@@ -210,6 +228,7 @@ class DeepSpeedTPUConfig(ConfigModel):
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
     monitor: MonitorConfig = Field(default_factory=MonitorConfig)
     checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
+    aio: AioConfig = Field(default_factory=AioConfig)
 
     @model_validator(mode="after")
     def _check_precision(self):
@@ -223,14 +242,10 @@ class DeepSpeedTPUConfig(ConfigModel):
         (VERDICT r1 W2: 'dead config knobs are silent lies')."""
         z = self.zero_optimization
         unimpl = []
-        if z.zero_quantized_weights or z.zero_quantized_gradients:
-            unimpl.append("zero_optimization.zero_quantized_weights/gradients (ZeRO++)")
-        if z.zero_hpz_partition_size not in (0, 1):
-            unimpl.append("zero_optimization.zero_hpz_partition_size (hpZ/MiCS)")
+        if z.zero_quantized_gradients:
+            unimpl.append("zero_optimization.zero_quantized_gradients (ZeRO++ qgZ)")
         if z.offload_param.device != OffloadDevice.none:
             unimpl.append("zero_optimization.offload_param")
-        if z.offload_optimizer.device == OffloadDevice.nvme:
-            unimpl.append("zero_optimization.offload_optimizer.device=nvme")
         if self.activation_checkpointing.partition_activations:
             unimpl.append("activation_checkpointing.partition_activations")
         if self.activation_checkpointing.cpu_checkpointing:
@@ -344,8 +359,6 @@ _REFERENCE_NOOP_KEYS: Dict[str, tuple] = {
         "contiguous_memory_optimization", "synchronize_checkpoint_boundary",
         "profile",
     ),
-    "aio": ("block_size", "queue_depth", "thread_count", "single_submit",
-            "overlap_events"),
 }
 
 # Renames: reference key → our key (same block).
@@ -403,8 +416,6 @@ def _compat_filter(config: Dict[str, Any]) -> Dict[str, Any]:
             for old, new in renames.items():
                 if old in block and new not in block:
                     block[new] = block.pop(old)
-    # top-level "aio" block: parsed for key filtering above, then dropped
-    config.pop("aio", None)
     return config
 
 

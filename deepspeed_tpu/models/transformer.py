@@ -30,7 +30,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import causal_attention
 
-DP = ("data", "expert")
+DP = ("data", "zero", "expert")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,103 @@
+"""Python handle over the native async-IO library (csrc/aio/ds_aio.cpp).
+
+The aio op wrapper analog (ref: csrc/aio/py_lib/py_ds_aio.cpp:16-39
+exports aio_read/aio_write/async_pread/async_pwrite on an
+aio_handle owning a libaio thread pool; deepspeed_py_aio_handle.h:15-39).
+Same surface: a handle with sync and async numpy-buffer reads/writes
+plus wait/drain, backed by the C++ thread pool. Falls back to plain
+Python file I/O when no toolchain exists (functional, not async).
+"""
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from .builder import jit_load
+
+
+def _load():
+    lib = jit_load("aio", ["aio/ds_aio.cpp"])
+    if lib is None:
+        return None
+    lib.ds_aio_create.restype = ctypes.c_void_p
+    lib.ds_aio_create.argtypes = [ctypes.c_int, ctypes.c_size_t]
+    lib.ds_aio_destroy.argtypes = [ctypes.c_void_p]
+    lib.ds_aio_submit_pwrite.restype = ctypes.c_long
+    lib.ds_aio_submit_pwrite.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_size_t]
+    lib.ds_aio_submit_pread.restype = ctypes.c_long
+    lib.ds_aio_submit_pread.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_size_t]
+    lib.ds_aio_wait.restype = ctypes.c_int
+    lib.ds_aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_long]
+    lib.ds_aio_drain.restype = ctypes.c_int
+    lib.ds_aio_drain.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+class AsyncIOHandle:
+    """ref: deepspeed_py_aio_handle.cpp aio_handle (thread pool + queue
+    depth + block size). block_size chunks each request across threads."""
+
+    def __init__(self, n_threads: int = 4, block_size: int = 1 << 20):
+        self._lib = _load()
+        self._h: Optional[int] = None
+        if self._lib is not None:
+            self._h = self._lib.ds_aio_create(n_threads, block_size)
+
+    @property
+    def native(self) -> bool:
+        return self._h is not None
+
+    def __del__(self):
+        if getattr(self, "_h", None) is not None:
+            self._lib.ds_aio_destroy(self._h)
+            self._h = None
+
+    # --- async (returns ticket; see wait/drain) ------------------------
+    def async_pwrite(self, arr: np.ndarray, path: str) -> int:
+        arr = np.ascontiguousarray(arr)
+        if self._h is None:
+            arr.tofile(path)
+            return 0
+        # keep the buffer alive until wait/drain
+        self._inflight = getattr(self, "_inflight", {})
+        t = self._lib.ds_aio_submit_pwrite(
+            self._h, path.encode(), arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes)
+        self._inflight[t] = arr
+        return t
+
+    def async_pread(self, arr: np.ndarray, path: str) -> int:
+        assert arr.flags["C_CONTIGUOUS"]
+        if self._h is None:
+            arr[...] = np.fromfile(path, dtype=arr.dtype).reshape(arr.shape)
+            return 0
+        self._inflight = getattr(self, "_inflight", {})
+        t = self._lib.ds_aio_submit_pread(
+            self._h, path.encode(), arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes)
+        self._inflight[t] = arr
+        return t
+
+    def wait(self, ticket: int) -> None:
+        if self._h is None or ticket == 0:
+            return
+        err = self._lib.ds_aio_wait(self._h, ticket)
+        getattr(self, "_inflight", {}).pop(ticket, None)
+        if err:
+            raise OSError(err, f"aio request {ticket} failed")
+
+    def drain(self) -> None:
+        if self._h is None:
+            return
+        err = self._lib.ds_aio_drain(self._h)
+        self._inflight = {}
+        if err:
+            raise OSError(err, "aio drain failed")
+
+    # --- sync convenience ---------------------------------------------
+    def pwrite(self, arr: np.ndarray, path: str) -> None:
+        self.wait(self.async_pwrite(arr, path))
+
+    def pread(self, arr: np.ndarray, path: str) -> None:
+        self.wait(self.async_pread(arr, path))

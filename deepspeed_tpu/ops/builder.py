@@ -1,0 +1,75 @@
+"""JIT builder for native (C++) ops.
+
+The op-build-system analog (ref: op_builder/builder.py OpBuilder:108 —
+jit_load():481 compiles csrc/ sources with ninja+nvcc at first use and
+caches the extension). Here: g++ compiles a C++ source from csrc/ into a
+shared library under a content-addressed cache dir, loaded with ctypes
+(pybind11 is not in the image; the C ABI + ctypes replaces it).
+"""
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional, Sequence
+
+from ..utils.logging import logger
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+_CACHE: dict = {}
+
+
+def csrc_path(rel: str) -> Path:
+    return _REPO_ROOT / "csrc" / rel
+
+
+def _build_dir() -> Path:
+    d = Path(os.environ.get("DS_TPU_BUILD_DIR", Path.home() / ".cache" / "deepspeed_tpu" / "build"))
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def jit_load(
+    name: str,
+    sources: Sequence[str],
+    extra_flags: Sequence[str] = (),
+    extra_ldflags: Sequence[str] = (),
+) -> Optional[ctypes.CDLL]:
+    """Compile+load a native op library; returns None if no toolchain.
+
+    Callers must degrade gracefully on None (the reference's
+    is_compatible()/load() split, op_builder/builder.py:463)."""
+    if name in _CACHE:
+        return _CACHE[name]
+
+    srcs = [csrc_path(s) for s in sources]
+    h = hashlib.sha256()
+    for s in srcs:
+        h.update(s.read_bytes())
+    h.update(" ".join([*extra_flags, *extra_ldflags]).encode())
+    out = _build_dir() / f"{name}-{h.hexdigest()[:16]}.so"
+
+    if not out.exists():
+        cmd = [
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+            *extra_flags,
+            *[str(s) for s in srcs],
+            "-o", str(out),
+            *extra_ldflags,
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+            logger.info(f"built native op '{name}' -> {out.name}")
+        except FileNotFoundError:
+            logger.warning(f"native op '{name}': g++ not found; falling back")
+            _CACHE[name] = None
+            return None
+        except subprocess.CalledProcessError as e:
+            logger.warning(f"native op '{name}' build failed:\n{e.stderr}")
+            _CACHE[name] = None
+            return None
+
+    lib = ctypes.CDLL(str(out))
+    _CACHE[name] = lib
+    return lib

@@ -23,7 +23,7 @@ MeshAxes = Union[None, str, Tuple[str, ...]]
 # VocabParallelEmbedding contract; batch rides the data axes; sequence
 # rides 'seq' (Ulysses).
 DEFAULT_LOGICAL_RULES: List[Tuple[str, MeshAxes]] = [
-    ("batch", ("data", "expert")),
+    ("batch", ("data", "zero", "expert")),
     ("seq", "seq"),
     ("embed", None),
     ("heads", "model"),
@@ -134,7 +134,7 @@ def batch_spec(batch_leaf_ndim: int, *, leading_accum_dim: bool = False) -> P:
     dims: List[MeshAxes] = []
     if leading_accum_dim:
         dims.append(None)
-    dims.append(("data", "expert"))
+    dims.append(("data", "zero", "expert"))
     if batch_leaf_ndim > len(dims):
         dims.append("seq")
     while len(dims) < batch_leaf_ndim:

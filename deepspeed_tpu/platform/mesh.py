@@ -10,13 +10,19 @@ mesh axes and collectives ride ICI/DCN as XLA chooses.
 Axis names (fixed vocabulary, any may be size 1):
   pipe    — pipeline stages           (ref: runtime/pipe/)
   data    — data parallel / ZeRO      (ref: groups.py:385)
+  zero    — ZeRO sub-group (MiCS/hpZ) (ref: runtime/zero/mics.py:64,
+            zero_hpz_partition_size config.py:264): when >1, the data
+            dimension is factored data×zero and ZeRO state shards over
+            'zero' only, replicating across 'data' groups — sharding
+            collectives stay on the fast intra-group links
   expert  — expert parallel for MoE   (ref: groups.py:113-290)
   seq     — Ulysses sequence parallel (ref: deepspeed/sequence/layer.py)
   model   — tensor parallel           (ref: module_inject AutoTP)
 
 Order is outermost→innermost: 'model' is fastest-varying so TP
 collectives ride the highest-bandwidth ICI links; 'pipe' is outermost so
-stage boundaries may cross DCN.
+stage boundaries may cross DCN; 'zero' sits inside 'data' so sub-group
+gathers ride shorter paths than cross-group traffic.
 """
 
 from typing import Dict, List, Optional, Sequence
@@ -27,10 +33,10 @@ from jax.sharding import Mesh
 
 from ..utils.logging import logger
 
-MESH_AXES = ("pipe", "data", "expert", "seq", "model")
+MESH_AXES = ("pipe", "data", "zero", "expert", "seq", "model")
 
 # Axes over which a batch is sharded (data-parallel-like axes).
-BATCH_AXES = ("data", "expert")
+BATCH_AXES = ("data", "zero", "expert")
 
 
 def resolve_axis_sizes(

@@ -1,0 +1,2 @@
+from .flops_profiler import FlopsProfiler, get_step_profile
+from .hlo import collective_volumes, parse_hlo_collectives

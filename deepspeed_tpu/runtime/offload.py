@@ -1,0 +1,94 @@
+"""ZeRO-Offload: host-DRAM optimizer tier.
+
+TPU-native redesign of the reference's CPU offload
+(ref: runtime/zero/stage_1_and_2.py cpu_offload grad paths :1178-1316,
+runtime/swap_tensor/partitioned_param_swapper.py:36,
+csrc/adam/cpu_adam.cpp + csrc/includes/simd.h — SIMD host Adam). The
+reference pins optimizer state + fp32 master weights in host memory,
+copies gradients D2H during backward, runs an AVX-vectorized Adam on the
+host, and copies updated fp16 params H2D.
+
+Here the same tiering is expressed with two XLA programs instead of
+hand-rolled streams:
+
+  device step (TPU jit)  — GAS loop, grads (fp32), loss, global norm
+  host step  (CPU jit)   — clip + optimizer update + low-precision cast,
+                           compiled by XLA:CPU whose auto-vectorization
+                           is the simd.h analog; buffers donated so the
+                           update is in-place in host DRAM
+
+Transfers ride JAX's async dispatch: the D2H gradient copy, host update,
+and H2D param copy for step N overlap the host-side dispatch of step
+N+1. Params live on device in compute dtype; only grads (D2H) and
+updated params (H2D, compute dtype — half the fp32 bytes) cross PCIe,
+matching the reference's traffic shape (stage_1_and_2.py
+async_accumulate_grad_in_cpu → fp16 param allgather).
+
+The NVMe tier lives in runtime/swap.py over the csrc/aio library.
+
+Initialization happens ON the host: parameters are materialized fp32 on
+CPU (bit-identical to device init — jax.random is platform-invariant),
+the master/moments stay there, and only the compute-dtype cast ships to
+the mesh — fp32 state never touches HBM, and the host master is exactly
+the fused engine's fp32 master (not a bf16 round-trip).
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .precision import clip_grads_by_global_norm
+
+
+def host_device():
+    """The host-DRAM staging device (CPU backend next to the TPU)."""
+    return jax.local_devices(backend="cpu")[0]
+
+
+def to_host(tree):
+    """D2H: gather each leaf onto the host device (async)."""
+    dev = host_device()
+    return jax.tree.map(lambda x: jax.device_put(x, dev), tree)
+
+
+class HostOptimizer:
+    """Optimizer step executed on the host CPU over offloaded state.
+
+    Owns the fp32 master weights and optimizer moments in host DRAM
+    (the DeepSpeedCPUAdam + swap-tensor role, ref: ops/adam/cpu_adam.py:13).
+    """
+
+    def __init__(self, optimizer, lr_schedule, clip: float, compute_dtype):
+        self.optimizer = optimizer
+        self.lr_schedule = lr_schedule
+        self.clip = float(clip)
+        self.compute_dtype = compute_dtype
+
+        def update(master, opt, grads, grad_norm, step):
+            # clip by the device-computed global norm (the host never needs
+            # the unsharded gradient square-sum) — same formula as the
+            # fused step for exact trajectory parity
+            grads = clip_grads_by_global_norm(grads, self.clip, grad_norm)
+            lr = self.lr_schedule(step)
+            new_master, new_opt = self.optimizer.update(grads, opt, master, lr, step + 1)
+            params_lp = jax.tree.map(
+                lambda m: m.astype(self.compute_dtype), new_master
+            )
+            return new_master, new_opt, params_lp, lr
+
+        # donate master+opt: the update mutates host DRAM in place instead
+        # of doubling resident state (the reference's pinned-buffer reuse)
+        self._update = jax.jit(update, donate_argnums=(0, 1))
+
+    def init_state(self, master_host):
+        """Moments for an exact fp32 master already resident on the host."""
+        return master_host, jax.jit(self.optimizer.init)(master_host)
+
+    def step(self, master, opt, grads_device, grad_norm, step):
+        """One offloaded update. grads_device/grad_norm may be live device
+        arrays — transfers enqueue asynchronously."""
+        grads_host = to_host(grads_device)
+        norm_host = jax.device_put(grad_norm, host_device())
+        step_host = jax.device_put(step, host_device())
+        return self._update(master, opt, grads_host, norm_host, step_host)

@@ -1,0 +1,205 @@
+"""NVMe optimizer-state tier (ZeRO-Infinity).
+
+TPU-native redesign of the reference's swap machinery
+(ref: runtime/swap_tensor/partitioned_optimizer_swapper.py:219,
+async_swapper.py AsyncTensorSwapper, optimizer_utils.py — optimizer
+state lives in NVMe files, swapped in around each sub-group's update
+with double buffering over the csrc/aio thread pool).
+
+Layout: one file per parameter leaf holding fp32 [master | moment_0 |
+moment_1 | ...] concatenated. Each step walks the leaves in order with
+one-leaf read-ahead: while leaf i's host update runs, leaf i+1's read is
+in flight on the aio thread pool, and leaf i-1's write-back drains —
+the async_swapper double-buffering pattern. The per-leaf update is a
+jitted XLA:CPU program (the cpu_adam SIMD analog).
+
+Peak host memory is O(2 leaves), not O(model): the point of the tier.
+"""
+
+import os
+import uuid
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.aio import AsyncIOHandle
+from .offload import host_device
+from .precision import clip_grads_by_global_norm
+
+
+class NVMeOptimizerSwapper:
+    def __init__(self, optimizer, lr_schedule, clip: float, compute_dtype,
+                 nvme_path: str, n_threads: int = 4, block_size: int = 1 << 20):
+        self.optimizer = optimizer
+        self.lr_schedule = lr_schedule
+        self.clip = float(clip)
+        self.compute_dtype = compute_dtype
+        # Namespace per process AND engine instance so concurrent engines /
+        # restarted runs sharing one NVMe mount never cross-write live swap
+        # files (ref: swap_tensor paths are rank-namespaced).
+        tag = f"rank{jax.process_index()}-{uuid.uuid4().hex[:8]}"
+        self.dir = os.path.join(nvme_path, "ds_tpu_swap", tag)
+        os.makedirs(self.dir, exist_ok=True)
+        # Swap files are run-scratch (checkpoints gather durable state via
+        # export_state) — reclaim the NVMe space when the engine dies.
+        import atexit
+        import shutil
+
+        self._cleanup = atexit.register(
+            lambda d=self.dir: shutil.rmtree(d, ignore_errors=True)
+        )
+        self.aio = AsyncIOHandle(n_threads=n_threads, block_size=block_size)
+        self._moment_keys: List[str] = []
+        self._leaf_paths: List[Tuple] = []
+        self._shapes: Dict[Tuple, tuple] = {}
+        self._update_cache: Dict[tuple, Any] = {}
+        self._host = host_device()
+
+    def __del__(self):
+        try:
+            import shutil
+
+            shutil.rmtree(self.dir, ignore_errors=True)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------
+    def _file(self, path_tuple) -> str:
+        name = "__".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path_tuple)
+        return os.path.join(self.dir, name + ".bin")
+
+    def _moments_for(self, master: np.ndarray) -> List[np.ndarray]:
+        """Moment buffers for one leaf. Every registry optimizer inits
+        moments to zeros (verified with a probe); a nonzero-init optimizer
+        falls back to actually running init."""
+        if self._zero_init:
+            return [np.zeros_like(master) for _ in self._moment_keys]
+        st = jax.jit(self.optimizer.init)(jax.device_put(master, self._host))
+        return [np.asarray(st[k], np.float32) for k in self._moment_keys]
+
+    def init_state(self, master_host) -> None:
+        """Write the exact fp32 master + init moments per leaf to NVMe
+        (ref: partitioned_param_swapper initial swap-out)."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(master_host)
+        self._treedef = treedef
+        probe = self.optimizer.init(jnp.ones((2,), jnp.float32))
+        self._moment_keys = sorted(probe.keys())
+        self._zero_init = all(
+            not np.asarray(v).any() for v in jax.device_get(probe).values()
+        )
+        self._leaf_paths = [p for p, _ in flat]
+        for path, leaf in flat:
+            self._shapes[path] = tuple(leaf.shape)
+        self.import_state(
+            master_host,
+            None,  # None → init moments
+        )
+
+    # --- checkpoint interop (engine save/load) -------------------------
+    def export_state(self):
+        """Read every leaf's master+moments from NVMe into host trees —
+        the checkpoint-time gather (transient O(model) host RAM, same as
+        the reference's swap-aware checkpoint save)."""
+        masters, opts = [], {k: [] for k in self._moment_keys}
+        bufs = []
+        for path in self._leaf_paths:
+            size = int(np.prod(self._shapes[path])) if self._shapes[path] else 1
+            buf = np.empty(size * (1 + len(self._moment_keys)), np.float32)
+            bufs.append((buf, self.aio.async_pread(buf, self._file(path))))
+        for path, (buf, t) in zip(self._leaf_paths, bufs):
+            self.aio.wait(t)
+            shape = self._shapes[path]
+            size = int(np.prod(shape)) if shape else 1
+            masters.append(buf[:size].reshape(shape).copy())
+            for k, key in enumerate(self._moment_keys):
+                opts[key].append(buf[size * (1 + k): size * (2 + k)].reshape(shape).copy())
+        unflatten = lambda leaves: jax.tree_util.tree_unflatten(self._treedef, leaves)
+        return unflatten(masters), {k: unflatten(v) for k, v in opts.items()}
+
+    def import_state(self, master_tree, opt_tree) -> None:
+        """Write master (+ moments; None → freshly-initialized) to NVMe."""
+        flat_master = jax.tree.leaves(master_tree)
+        flat_moments = (
+            [jax.tree.leaves(opt_tree[k]) for k in self._moment_keys]
+            if opt_tree is not None
+            else None
+        )
+        for i, path in enumerate(self._leaf_paths):
+            master = np.asarray(jax.device_get(flat_master[i]), np.float32)
+            if flat_moments is None:
+                moments = self._moments_for(master)
+            else:
+                moments = [
+                    np.asarray(jax.device_get(m[i]), np.float32)
+                    for m in flat_moments
+                ]
+            buf = np.concatenate([master.ravel()] + [m.ravel() for m in moments])
+            self.aio.async_pwrite(buf, self._file(path))
+        self.aio.drain()
+
+    # ------------------------------------------------------------------
+    def _leaf_update(self, shape):
+        """Per-leaf jitted CPU update (cached per shape)."""
+        if shape not in self._update_cache:
+            clip = self.clip
+
+            def up(master, moments, grad, grad_norm, lr, step):
+                grad = clip_grads_by_global_norm(grad, clip, grad_norm)
+                opt = dict(zip(self._moment_keys, moments))
+                new_master, new_opt = self.optimizer.update(grad, opt, master, lr, step)
+                lp = new_master.astype(self.compute_dtype)
+                return new_master, [new_opt[k] for k in self._moment_keys], lp
+
+            self._update_cache[shape] = jax.jit(up)
+        return self._update_cache[shape]
+
+    def step(self, grads_host: List[np.ndarray], grad_norm, step_idx: int):
+        """One offloaded update over all leaves with read-ahead.
+
+        grads_host: flat list of fp32 numpy grads in leaf order.
+        Returns flat list of compute-dtype numpy params in leaf order.
+        """
+        n = len(self._leaf_paths)
+        norm = jnp.float32(np.asarray(grad_norm))
+        lr = jax.device_get(self.lr_schedule(jnp.int32(step_idx)))
+        nm = len(self._moment_keys)
+
+        def submit_read(i):
+            path = self._leaf_paths[i]
+            size = int(np.prod(self._shapes[path])) if self._shapes[path] else 1
+            buf = np.empty(size * (1 + nm), np.float32)
+            return buf, self.aio.async_pread(buf, self._file(path))
+
+        params_lp: List[np.ndarray] = []
+        pending = submit_read(0)
+        write_tickets: List[int] = []
+        for i in range(n):
+            buf, ticket = pending
+            self.aio.wait(ticket)
+            if i + 1 < n:
+                pending = submit_read(i + 1)  # read-ahead next leaf
+            path = self._leaf_paths[i]
+            shape = self._shapes[path]
+            size = int(np.prod(shape)) if shape else 1
+            master = buf[:size].reshape(shape)
+            moments = [
+                buf[size * (1 + k): size * (2 + k)].reshape(shape) for k in range(nm)
+            ]
+            dev = self._host
+            new_master, new_moments, lp = self._leaf_update(shape)(
+                jax.device_put(master, dev),
+                [jax.device_put(m, dev) for m in moments],
+                jax.device_put(grads_host[i].reshape(shape), dev),
+                jax.device_put(norm, dev), jnp.float32(lr), jnp.int32(step_idx + 1),
+            )
+            out = np.concatenate(
+                [np.asarray(new_master, np.float32).ravel()]
+                + [np.asarray(m, np.float32).ravel() for m in new_moments]
+            )
+            write_tickets.append(self.aio.async_pwrite(out, self._file(path)))
+            params_lp.append(np.asarray(lp))
+        for t in write_tickets:
+            self.aio.wait(t)
+        return params_lp, lr

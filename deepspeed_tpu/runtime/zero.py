@@ -24,12 +24,18 @@ runtime/zero/partition_parameters.py zero.Init:780). Per SURVEY §7, the
             `param_persistence_threshold`
             (ref: parameter_offload.py:242 persistent params).
 
-MiCS / ZeRO++ hpZ sub-grouping (ref: zero/mics.py:64, config.py:264)
-maps to sharding over a *sub-axis* of 'data'; offload tiering and
-quantized collectives live in their own modules.
+MiCS / ZeRO++ hpZ sub-grouping (ref: zero/mics.py:64, config.py:264) is
+the 'zero' mesh sub-axis: when the data dimension is factored data×zero
+(engine does this from zero_hpz_partition_size, or the user sets
+mesh.zero directly — the MiCS_Init analog), ZeRO state shards over
+'zero' ONLY and replicates across 'data' groups. XLA then emits
+intra-group all-gathers for params plus a cross-group grad all-reduce —
+the MiCS hierarchical comm pattern (mics.py allgather within shard
+group, allreduce across replica groups) derived from layout. Offload
+tiering and quantized collectives live in their own modules.
 """
 
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import numpy as np
@@ -37,9 +43,15 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..config.config import ZeroConfig
 
-# ZeRO shards over the data axis. The expert axis already shards expert
-# params; MoE expert leaves get 'data' added on top of their 'expert' dim.
-ZERO_AXIS = "data"
+
+def zero_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """The mesh axes ZeRO state shards over: the 'zero' sub-group when
+    factored in (MiCS/hpZ), else the whole 'data' axis. The expert axis
+    already shards expert params; MoE expert leaves get these added on
+    top of their 'expert' dim."""
+    if mesh.shape.get("zero", 1) > 1:
+        return ("zero",)
+    return ("data",)
 
 
 def _spec_dims(spec: P, rank: int):
@@ -60,25 +72,28 @@ def zero_shard_spec(
     shape,
     mesh: Mesh,
     min_size: int = 0,
-    axis: str = ZERO_AXIS,
+    axes: Optional[Tuple[str, ...]] = None,
 ) -> P:
-    """Add `axis` to the best dimension of one leaf's PartitionSpec.
+    """Add the ZeRO axes to the best dimension of one leaf's PartitionSpec.
 
     Picks the largest dim that (a) is not already sharded, (b) is
-    divisible by the axis size after accounting for existing sharding.
-    Leaves smaller than `min_size` elements stay untouched (the
+    divisible by the axes' total size after accounting for existing
+    sharding. Leaves smaller than `min_size` elements stay untouched (the
     persistence-threshold analog). Returns the original spec when no dim
-    qualifies — those leaves stay replicated over 'data', which is
+    qualifies — those leaves stay replicated over the data axes, which is
     exactly the reference's persistent-param behavior.
     """
-    axis_n = mesh.shape.get(axis, 1)
-    if axis_n <= 1:
+    if axes is None:
+        axes = zero_axes(mesh)
+    live = tuple(a for a in axes if mesh.shape.get(a, 1) > 1)
+    if not live:
         return spec
+    axis_n = int(np.prod([mesh.shape[a] for a in live]))
     size = int(np.prod(shape)) if len(shape) else 1
     if size < max(min_size, axis_n) or len(shape) == 0:
         return spec
     dims = _spec_dims(spec, len(shape))
-    if any(axis in _axes_of(d) for d in dims):
+    if any(set(live) & set(_axes_of(d)) for d in dims):
         return spec  # already zero-sharded
     best, best_len = None, 0
     for i, d in enumerate(shape):
@@ -91,7 +106,9 @@ def zero_shard_spec(
     if best is None:
         return spec
     cur = _axes_of(dims[best])
-    dims[best] = cur + (axis,) if cur else axis
+    dims[best] = cur + live
+    if len(dims[best]) == 1:
+        dims[best] = dims[best][0]
     while dims and dims[-1] is None:
         dims.pop()
     return P(*dims)
@@ -141,6 +158,80 @@ def derive_grad_specs(param_specs, opt_specs, zero_config: ZeroConfig):
     stage < 2:  the param layout → plain all-reduce semantics.
     """
     return opt_specs if zero_config.stage >= 2 else param_specs
+
+
+def _zero_sharded_dim(store_spec: P, gathered_spec: P, rank: int, mesh: Mesh):
+    """The dim whose spec gains ZeRO axes in storage (None if the leaf is
+    not zero-sharded)."""
+    s_dims = _spec_dims(store_spec, rank)
+    g_dims = _spec_dims(gathered_spec, rank)
+    zaxes = set(zero_axes(mesh))
+    for i in range(rank):
+        if (set(_axes_of(s_dims[i])) - set(_axes_of(g_dims[i]))) & zaxes:
+            return i
+    return None
+
+
+def make_qwz_gather(store_specs, gathered_specs, shapes, mesh: Mesh):
+    """ZeRO++ qwZ: int8-quantized weight all-gather.
+
+    (ref: runtime/zero/partition_parameters.py:725 CUDAQuantizer +
+    all_gather_coalesced quantized path; docs/_tutorials/zeropp.md qwZ —
+    halves all-gather volume vs fp16/bf16.)
+
+    Returns f(params_tree) that, for every zero-sharded leaf, quantizes
+    the local shard to int8 with one scale per slice of the sharded dim
+    (shard-local by construction), constrains codes+scales to the
+    GATHERED layout — so XLA's all-gather moves int8, not bf16 — and
+    dequantizes locally. Backward passes gradients straight through to
+    the sharded layout (the reduce-scatter stays full precision; qgZ
+    handles gradient compression separately).
+    """
+    from ..ops.quantization import dequantize_per_axis, quantize_per_axis
+
+    def leaf_fn(store_spec, gathered_spec, shape):
+        k = _zero_sharded_dim(store_spec, gathered_spec, len(shape), mesh)
+        if k is None:
+            return lambda w: w  # not zero-sharded: plain (already-local) use
+        g_dims = _spec_dims(gathered_spec, len(shape))
+        scale_spec = P(g_dims[k]) if g_dims[k] is not None else P()
+
+        @jax.custom_vjp
+        def gather(w):
+            w = jax.lax.with_sharding_constraint(
+                w, jax.sharding.NamedSharding(mesh, store_spec)
+            )
+            q, s = quantize_per_axis(w, k)
+            q = jax.lax.with_sharding_constraint(
+                q, jax.sharding.NamedSharding(mesh, gathered_spec)
+            )
+            s = jax.lax.with_sharding_constraint(
+                s, jax.sharding.NamedSharding(mesh, scale_spec)
+            )
+            return dequantize_per_axis(q, s, k, w.dtype)
+
+        def fwd(w):
+            return gather(w), None
+
+        def bwd(_, g):
+            return (
+                jax.lax.with_sharding_constraint(
+                    g, jax.sharding.NamedSharding(mesh, store_spec)
+                ),
+            )
+
+        gather.defvjp(fwd, bwd)
+        return gather
+
+    fns = jax.tree.map(
+        leaf_fn, store_specs, gathered_specs, shapes,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+    def apply(params):
+        return jax.tree.map(lambda fn, p: fn(p), fns, params)
+
+    return apply
 
 
 def validate_no_conflicts(specs) -> None:

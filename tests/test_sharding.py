@@ -66,7 +66,7 @@ class TestZeroShardSpec:
     def test_respects_existing_tp(self):
         # dim1 sharded by model(2): local 256/2=128 divisible by 8 → still
         # largest; gets ('model','data')
-        spec = zero_shard_spec(P(None, "model"), (64, 256), mesh_dp4_tp2(), axis="data")
+        spec = zero_shard_spec(P(None, "model"), (64, 256), mesh_dp4_tp2(), axes=("data",))
         assert spec == P(None, ("model", "data"))
 
     def test_small_leaf_stays_replicated(self):

@@ -1,0 +1,186 @@
+"""ZeRO++ (qwZ quantized weight gather) and MiCS/hpZ sub-group tests.
+
+Ref model: tests/unit/runtime/zero/test_zeropp.py — the reference trains
+tiny models with qwZ/hpZ on and checks convergence; here additionally
+the sub-group sharding layout is asserted directly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import transformer as T
+from deepspeed_tpu.ops import quantization as Q
+
+VOCAB = 128
+
+
+def model_cfg(**kw):
+    base = dict(vocab_size=VOCAB, n_layers=2, n_heads=4, d_model=64, max_seq=32,
+                variant="llama", use_flash=False)
+    base.update(kw)
+    return T.TransformerConfig(**base)
+
+
+def ds_config(**kw):
+    base = {
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3, "weight_decay": 0.01}},
+        "gradient_clipping": 1.0,
+        "seed": 7,
+        "steps_per_print": 1000,
+    }
+    base.update(kw)
+    return base
+
+
+def build_engine(**cfg_kw):
+    mcfg = model_cfg()
+    return ds.initialize(
+        ds_config(**cfg_kw),
+        loss_fn=T.make_loss_fn(mcfg),
+        param_init_fn=lambda k: T.init(mcfg, k),
+        param_logical_specs=T.logical_specs(mcfg),
+    )
+
+
+def data(n=4, batch=16, seq=33, seed=0):
+    r = np.random.default_rng(seed)
+    return [{"tokens": r.integers(0, VOCAB, (batch, seq)).astype(np.int32)} for _ in range(n)]
+
+
+def losses(engine, batches):
+    return [engine.train_batch(b)["loss"] for b in batches]
+
+
+class TestQuantizationKernels:
+    def test_blockwise_roundtrip(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (1000,)) * 3.0
+        q, s = Q.quantize_blockwise(x, block=128)
+        y = Q.dequantize_blockwise(q, s, x.shape)
+        assert float(jnp.max(jnp.abs(x - y))) <= float(jnp.max(s)) / 2 + 1e-6
+
+    def test_per_axis_roundtrip(self):
+        x = jax.random.normal(jax.random.PRNGKey(1), (16, 64))
+        q, s = Q.quantize_per_axis(x, 0)
+        y = Q.dequantize_per_axis(q, s, 0)
+        # per-channel int8: max error is half a quantization step per row
+        err = jnp.max(jnp.abs(x - y), axis=1)
+        assert (np.asarray(err) <= np.asarray(s) * 0.5 + 1e-6).all()
+
+    def test_int4_pack_roundtrip(self):
+        q = jnp.array([[-7, 3, 0, 7, -1, 5]], jnp.int8)
+        assert (Q.unpack_int4(Q.pack_int4(q)) == q).all()
+
+    def test_zero_block_stays_zero(self):
+        x = jnp.zeros((256,))
+        q, s = Q.quantize_blockwise(x, block=64)
+        assert (Q.dequantize_blockwise(q, s, x.shape) == 0).all()
+
+
+class TestHpZ:
+    """zero_hpz_partition_size=k → data factored into data×zero."""
+
+    @pytest.fixture(scope="class")
+    def baseline(self):
+        engine = build_engine(
+            zero_optimization={"stage": 3, "param_persistence_threshold": 64})
+        return losses(engine, data())
+
+    def test_hpz_matches_full_sharding_trajectory(self, baseline):
+        engine = build_engine(zero_optimization={
+            "stage": 3, "param_persistence_threshold": 64,
+            "zero_hpz_partition_size": 2,
+        })
+        assert engine.mesh.shape["zero"] == 2
+        assert engine.mesh.shape["data"] == 4
+        np.testing.assert_allclose(losses(engine, data()), baseline, rtol=2e-4)
+
+    def test_hpz_shards_within_subgroup_only(self):
+        engine = build_engine(zero_optimization={
+            "stage": 3, "param_persistence_threshold": 64,
+            "zero_hpz_partition_size": 2,
+        })
+        spec = str(engine.state.params["layers"]["w_in"].sharding.spec)
+        assert "zero" in spec and "data" not in spec
+        # replicated across the 2 groups of 4: each device holds 1/2, not 1/8
+        full = build_engine(zero_optimization={
+            "stage": 3, "param_persistence_threshold": 64})
+        w_h = engine.state.params["layers"]["w_in"]
+        w_f = full.state.params["layers"]["w_in"]
+        assert (w_h.addressable_shards[0].data.size
+                == 4 * w_f.addressable_shards[0].data.size)
+
+    def test_explicit_mesh_zero_axis(self):
+        """MiCS style: user sets mesh.zero directly."""
+        engine = build_engine(
+            mesh={"data": 4, "zero": 2},
+            zero_optimization={"stage": 3, "param_persistence_threshold": 64})
+        spec = str(engine.state.params["layers"]["w_in"].sharding.spec)
+        assert "zero" in spec and "data" not in spec
+
+    def test_hpz_indivisible_raises(self):
+        with pytest.raises(ValueError, match="divisible"):
+            build_engine(mesh={"data": 3},
+                         zero_optimization={"stage": 3,
+                                            "zero_hpz_partition_size": 2})
+
+
+class TestQwZ:
+    """zero_quantized_weights: int8 weight gather, convergence parity."""
+
+    def test_qwz_converges_with_parity(self):
+        batches = data(8)
+        base = build_engine(
+            bf16={"enabled": True},
+            zero_optimization={"stage": 3, "param_persistence_threshold": 64})
+        qwz = build_engine(
+            bf16={"enabled": True},
+            zero_optimization={"stage": 3, "param_persistence_threshold": 64,
+                               "zero_quantized_weights": True})
+        lb = losses(base, batches)
+        lq = losses(qwz, batches)
+        assert lq[-1] < lq[0]  # training works
+        # ≤1% loss delta over the run (the ZeRO++ convergence-parity bar)
+        for a, b in zip(lb, lq):
+            assert abs(a - b) / a < 0.01, (lb, lq)
+
+    def test_qwz_with_hpz(self):
+        batches = data(6)
+        engine = build_engine(
+            bf16={"enabled": True},
+            zero_optimization={"stage": 3, "param_persistence_threshold": 64,
+                               "zero_quantized_weights": True,
+                               "zero_hpz_partition_size": 2})
+        ls = losses(engine, batches)
+        assert ls[-1] < ls[0]
+
+    def test_qwz_reduces_allgather_bytes(self):
+        """Comm-volume accounting: the compiled step's weight all-gathers
+        move fewer bytes with qwZ (the ZeRO++ claim, measured from HLO)."""
+        from deepspeed_tpu.profiling import collective_volumes
+
+        def gather_bytes(**zkw):
+            engine = build_engine(
+                bf16={"enabled": True},
+                zero_optimization={"stage": 3, "param_persistence_threshold": 64,
+                                   **zkw})
+            engine.train_batch(data(1)[0])
+            vols = collective_volumes(engine._train_compiled)
+            return vols.get("all-gather", {"bytes": 0})["bytes"]
+
+        base = gather_bytes()
+        qwz = gather_bytes(zero_quantized_weights=True)
+        assert qwz < base, (qwz, base)
+
+    def test_qwz_noop_without_sharded_leaves(self):
+        """stage<3 has no zero-sharded params → qwZ is an exact no-op."""
+        batches = data(3)
+        base = build_engine(zero_optimization={"stage": 1})
+        qwz = build_engine(zero_optimization={"stage": 1,
+                                              "zero_quantized_weights": True})
+        np.testing.assert_allclose(losses(qwz, batches), losses(base, batches),
+                                   rtol=1e-6)

@@ -242,8 +242,6 @@ class DeepSpeedTPUConfig(ConfigModel):
         (VERDICT r1 W2: 'dead config knobs are silent lies')."""
         z = self.zero_optimization
         unimpl = []
-        if z.zero_quantized_gradients:
-            unimpl.append("zero_optimization.zero_quantized_gradients (ZeRO++ qgZ)")
         if z.offload_param.device != OffloadDevice.none:
             unimpl.append("zero_optimization.offload_param")
         if self.activation_checkpointing.partition_activations:

@@ -237,10 +237,26 @@ def _shard(x, *spec):
     """Sharding constraint against the ambient mesh (set by the engine via
     jax.sharding.set_mesh). Outside any mesh context — e.g. a plain
     single-device forward — constraints are skipped explicitly; inside a
-    mesh context a bad spec raises rather than silently degrading."""
+    mesh context a bad spec raises rather than silently degrading.
+
+    Inside a partial-manual shard_map (the per-worker gradient path for
+    1-bit/qgZ compression), axes the caller already mapped over are
+    dropped from the spec — constraints may only name Auto axes there."""
     mesh = jax.sharding.get_abstract_mesh()
     if mesh is None or mesh.empty:
         return x
+    manual = set(getattr(mesh, "manual_axes", ()) or ())
+    if manual:
+        def strip(entry):
+            if entry is None:
+                return None
+            axes = (entry,) if isinstance(entry, str) else tuple(entry)
+            live = tuple(a for a in axes if a not in manual)
+            if not live:
+                return None
+            return live[0] if len(live) == 1 else live
+
+        spec = tuple(strip(e) for e in spec)
     return jax.lax.with_sharding_constraint(x, P(*spec))
 
 

@@ -187,6 +187,83 @@ def sgd(momentum: float = 0.0, weight_decay: float = 0.0, nesterov: bool = False
     return Optimizer(init, update, "sgd")
 
 
+class OnebitAdam:
+    """1-bit Adam (ref: runtime/fp16/onebit/adam.py OnebitAdam:14).
+
+    Two phases split at `freeze_step` (the reference's warmup):
+      warmup     — exact Adam; variance (nu) still adapting; gradients
+                   arrive fully reduced (`update`, the plain engine path).
+      compressed — nu FROZEN; each data-parallel worker updates a local
+                   momentum with its own partial gradient and the workers'
+                   momenta are averaged through the error-feedback 1-bit
+                   collective (comm/compressed.py), cutting comm volume
+                   ~4x+ (`compressed_update`, fed worker-major grads from
+                   the engine's shard_map gradient path).
+
+    State = {mu, nu, error_w, error_s}; error buffers are worker-major
+    [dp, ·] leaves sharded over the data axes.
+    """
+
+    name = "onebitadam"
+
+    def __init__(self, betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, freeze_step: int = 100,
+                 dp: int = 1):
+        self.b1, self.b2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.freeze_step = int(freeze_step)
+        self.dp = int(dp)
+        self._inner = adam(betas=betas, eps=eps, weight_decay=weight_decay,
+                           adam_w_mode=False, bias_correction=True)
+
+    def init(self, params):
+        from ..comm.compressed import init_error_buffers
+
+        ew, es = init_error_buffers(params, self.dp)
+        return {
+            "mu": _zeros_like_f32(params),
+            "nu": _zeros_like_f32(params),
+            "error_w": ew,
+            "error_s": es,
+        }
+
+    def update(self, grads, state, params, lr, step):
+        """Warmup phase: exact Adam on fully-reduced grads
+        (ref: adam.py warmup branch — comm_time==0 standard allreduce)."""
+        inner_state = {"mu": state["mu"], "nu": state["nu"]}
+        new_params, new_inner = self._inner.update(grads, inner_state, params, lr, step)
+        return new_params, {**state, **new_inner}
+
+    def compressed_update(self, worker_grads, state, params, lr, step, mesh):
+        """Compression phase (ref: adam.py:210 — local momentum update then
+        compressed_allreduce; exp_avg_sq frozen)."""
+        from ..comm.compressed import compressed_mean_tree
+
+        b1, b2, eps, wd = self.b1, self.b2, self.eps, self.weight_decay
+        step_f = step.astype(jnp.float32)
+        c1 = 1.0 - b1**step_f
+        c2 = 1.0 - b2 ** jnp.float32(self.freeze_step)  # nu frozen here
+
+        m_part = _tmap(
+            lambda mu, gw: b1 * mu[None] + (1.0 - b1) * gw.astype(jnp.float32),
+            state["mu"], worker_grads,
+        )
+        mu_new, ew, es = compressed_mean_tree(
+            m_part, state["error_w"], state["error_s"], mesh
+        )
+
+        def leaf(m, v, p):
+            upd = (m / c1) / (jnp.sqrt(v / c2) + eps)
+            if wd != 0.0:
+                upd = upd + wd * p
+            return p - lr * upd
+
+        new_params = _tmap(leaf, mu_new, state["nu"], params)
+        return new_params, {"mu": mu_new, "nu": state["nu"],
+                            "error_w": ew, "error_s": es}
+
+
 _REGISTRY: Dict[str, Callable[..., Optimizer]] = {
     "adam": lambda **kw: adam(adam_w_mode=False, **kw),
     "adamw": lambda **kw: adam(adam_w_mode=True, **kw),
@@ -195,6 +272,7 @@ _REGISTRY: Dict[str, Callable[..., Optimizer]] = {
     "lion": lion,
     "adagrad": adagrad,
     "sgd": sgd,
+    "onebitadam": OnebitAdam,
 }
 
 
@@ -208,6 +286,8 @@ def build_optimizer(type_name: str, params: Optional[Dict[str, Any]] = None) -> 
     kwargs = dict(params or {})
     kwargs.pop("lr", None)
     kwargs.pop("torch_adam", None)  # reference-compat noise
+    kwargs.pop("cuda_aware", None)  # 1-bit reference knob, no TPU meaning
+    kwargs.pop("comm_backend_name", None)
     if "betas" in kwargs:
         kwargs["betas"] = tuple(kwargs["betas"])
     return _REGISTRY[key](**kwargs)

@@ -27,7 +27,7 @@ from ..utils.logging import logger
 from .hlo import collective_volumes
 
 
-def get_step_profile(compiled, n_devices: int = 1) -> Dict[str, Any]:
+def get_step_profile(compiled) -> Dict[str, Any]:
     """Raw numbers for one compiled step (per device)."""
     cost = compiled.cost_analysis()
     if isinstance(cost, (list, tuple)):  # older jax returns [dict]

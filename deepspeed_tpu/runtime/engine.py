@@ -184,10 +184,49 @@ class DeepSpeedTPUEngine:
             if zcfg.zero_quantized_weights
             else None
         )
+        # ZeRO++ qgZ: per-worker grads reduced through the int8 two-hop
+        # quantized exchange (ref: coalesced_collectives.py:31).
+        self._qgz = zcfg.zero_quantized_gradients
+        if self._qgz:
+            if zcfg.stage > 2:
+                raise NotImplementedError(
+                    "zero_quantized_gradients needs params replicated over "
+                    "the data axes (zero stage <= 2)"
+                )
+            if config.fp16.enabled or pipelined or self.mesh.shape.get("expert", 1) > 1:
+                raise NotImplementedError(
+                    "zero_quantized_gradients does not compose with "
+                    "fp16/pipeline/expert axes yet"
+                )
 
         # --- optimizer / schedule / scaler ------------------------------
         opt_block = config.optimizer
-        self.optimizer: Optimizer = build_optimizer(opt_block.type, opt_block.params)
+        opt_params = dict(opt_block.params)
+        self._onebit = opt_block.type.lower().replace("_", "") == "onebitadam"
+        if self._onebit:
+            # 1-bit Adam needs per-worker partial gradients (params
+            # replicated over the data axes) — ref: onebit/adam.py is
+            # likewise an FP16_Optimizer-path feature, not a ZeRO one.
+            if config.zero_stage > 0:
+                raise NotImplementedError("1-bit Adam requires zero stage 0")
+            if config.fp16.enabled:
+                raise NotImplementedError("1-bit Adam: use bf16, not fp16")
+            if pipelined or self.mesh.shape.get("expert", 1) > 1:
+                raise NotImplementedError(
+                    "1-bit Adam does not compose with pipeline/expert axes yet"
+                )
+            if config.gradient_clipping > 0:
+                # clipping needs the exact global grad norm, whose reduction
+                # the compression phase exists to avoid (the reference 1-bit
+                # optimizers don't clip either) — raise, don't silently stop
+                # clipping at freeze_step
+                raise NotImplementedError(
+                    "gradient_clipping is not supported with 1-bit Adam"
+                )
+            opt_params["dp"] = int(
+                self.mesh.shape["data"] * self.mesh.shape["zero"]
+            )
+        self.optimizer: Optimizer = build_optimizer(opt_block.type, opt_params)
         base_lr = float(opt_block.params.get("lr", 1e-3))
         self.lr_schedule = build_schedule(
             config.scheduler.type, config.scheduler.params, base_lr=base_lr
@@ -283,7 +322,16 @@ class DeepSpeedTPUEngine:
             lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params
         )
         opt_struct = jax.eval_shape(lambda p: self.optimizer.init(p), abstract_params)
-        opt_shardings = {k: o_shd for k in opt_struct.keys()}
+        opt_shardings = {}
+        for k in opt_struct.keys():
+            if k.startswith("error_"):
+                # 1-bit error memories are worker-major [dp, ·] leaves
+                opt_shardings[k] = jax.tree.map(
+                    lambda _: NamedSharding(mesh, P(("data", "zero"))),
+                    opt_struct[k],
+                )
+            else:
+                opt_shardings[k] = o_shd
         out_shardings = TrainState(
             step=NamedSharding(mesh, P()),
             params=p_shd,
@@ -342,6 +390,26 @@ class DeepSpeedTPUEngine:
     # ------------------------------------------------------------------
     # the compiled train step
     # ------------------------------------------------------------------
+    def _remat_wrapped_loss_fn(self):
+        """The user loss_fn with the config-driven remat policy applied.
+
+        Activation checkpointing (ref: runtime/activation_checkpointing/
+        checkpointing.py:989 — there a wrapper around user-chosen module
+        calls; here a policy on the whole compiled micro-step, composing
+        with any model-internal per-layer remat). Shared by every
+        gradient path: fused, offload, and the per-worker (qgZ/1-bit)
+        accumulators."""
+        loss_fn = self.loss_fn
+        policy_name = self.config.activation_checkpointing.policy
+        if policy_name != "none":
+            remat_policy = {
+                "full": None,
+                "dots": jax.checkpoint_policies.checkpoint_dots,
+                "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            }[policy_name]
+            loss_fn = jax.checkpoint(loss_fn, policy=remat_policy, static_argnums=())
+        return loss_fn
+
     def _make_accumulator(self):
         """(master_f32, batch, base_rng, scale) -> (mean grads, mean loss).
 
@@ -353,24 +421,25 @@ class DeepSpeedTPUEngine:
         mesh = self.mesh
         grad_specs = self.grad_specs
         compute_dtype = self.compute_dtype
-        loss_fn = self.loss_fn
+        loss_fn = self._remat_wrapped_loss_fn()
         has_aux = self.has_aux
         pipelined = self.pipelined
         qwz_apply = self._qwz_apply
 
-        # activation checkpointing: remat policy around the micro-step loss
-        # (ref: runtime/activation_checkpointing/checkpointing.py:989 —
-        # there a wrapper around user-chosen module calls; here a policy on
-        # the whole compiled micro-step, composing with any model-internal
-        # per-layer remat)
-        policy_name = cfg.activation_checkpointing.policy
-        if policy_name != "none":
-            remat_policy = {
-                "full": None,
-                "dots": jax.checkpoint_policies.checkpoint_dots,
-                "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            }[policy_name]
-            loss_fn = jax.checkpoint(loss_fn, policy=remat_policy, static_argnums=())
+        if self._qgz:
+            worker_acc = self._make_worker_accumulator()
+
+            def accumulate_qgz(master, batch, base_rng, scale):
+                from ..comm.compressed import quantized_mean_tree
+
+                wgrads, losses = worker_acc(master, batch, base_rng)
+                grads = quantized_mean_tree(wgrads, mesh)
+                grads = jax.tree.map(
+                    lambda g, s: shd.constraint(g, s, mesh), grads, grad_specs
+                )
+                return grads, jnp.mean(losses)
+
+            return accumulate_qgz
 
         def accumulate(master, batch, base_rng, scale):
             def to_model_params(m):
@@ -502,6 +571,108 @@ class DeepSpeedTPUEngine:
 
         return jax.jit(step_fn, donate_argnums=(0,))
 
+    def _make_worker_accumulator(self):
+        """(master, batch, base_rng) -> (worker grads [dp, ·], mean loss).
+
+        The per-worker partial-gradient path: shard_map maps over the
+        data axes only (model/seq stay auto, so TP/Ulysses constraints
+        inside the model still apply), each worker runs the GAS scan on
+        its local batch shard WITHOUT any cross-worker reduction — the
+        reduction is the caller's (compressed) job.
+        (ref: the implicit per-rank grads of torch DDP that
+        runtime/comm/nccl.py compressed_allreduce consumes)."""
+        cfg = self.config
+        gas = cfg.gradient_accumulation_steps
+        mesh = self.mesh
+        compute_dtype = self.compute_dtype
+        loss_fn = self._remat_wrapped_loss_fn()
+        has_aux = self.has_aux
+        manual = tuple(a for a in ("data", "zero") if mesh.shape.get(a, 1) > 1)
+
+        def body(master, batch, base_rng):
+            def micro(carry, xs):
+                acc, loss_sum = carry
+                idx, micro_batch = xs
+                rng = jax.random.fold_in(base_rng, idx)
+
+                def local_loss(m):
+                    p = cast_params(m, compute_dtype)
+                    out = loss_fn(p, micro_batch, rng)
+                    return out[0] if has_aux else out
+
+                loss, grads = jax.value_and_grad(local_loss)(master)
+                acc = jax.tree.map(jnp.add, acc, grads)
+                return (acc, loss_sum + loss), None
+
+            zeros = jax.tree.map(lambda m: jnp.zeros(m.shape, jnp.float32), master)
+            (grads, loss_sum), _ = jax.lax.scan(
+                micro, (zeros, jnp.float32(0.0)), (jnp.arange(gas), batch)
+            )
+            grads = jax.tree.map(lambda g: (g / gas)[None], grads)
+            return grads, (loss_sum / gas)[None]
+
+        if not manual:
+            return body  # dp=1: worker dim is trivially [1, ...]
+
+        # pytree-prefix specs: master replicated over the manual axes,
+        # batch leaves [gas, batch, ...] sharded on the batch dim
+        wrapped = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), P(None, manual), P()),
+            out_specs=(P(manual), P(manual)),
+            axis_names=set(manual),
+            check_vma=False,
+        )
+        return wrapped
+
+    def _build_onebit_step(self):
+        """Compression-phase step for 1-bit Adam: per-worker grads →
+        local momentum → error-feedback 1-bit averaged momentum → frozen-
+        variance Adam update (ref: runtime/fp16/onebit/adam.py:210)."""
+        optimizer = self.optimizer
+        schedule = self.lr_schedule
+        mesh = self.mesh
+        param_specs = self.param_specs
+        compute_dtype = self.compute_dtype
+        use_master = self._use_master
+        seed = self._rng_seed
+        worker_acc = self._make_worker_accumulator()
+
+        def step_fn(state: TrainState, batch):
+            master = state.master if use_master else cast_params(state.params, jnp.float32)
+            base_rng = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
+            wgrads, losses = worker_acc(master, batch, base_rng)
+            loss = jnp.mean(losses)
+            new_step = state.step + 1
+            lr = schedule(state.step)
+            new_master, new_opt = optimizer.compressed_update(
+                wgrads, state.opt, master, lr, new_step, mesh
+            )
+            new_params = jax.tree.map(
+                lambda m, s: shd.constraint(m.astype(compute_dtype), s, mesh),
+                new_master,
+                param_specs,
+            )
+            new_state = TrainState(
+                step=new_step,
+                params=new_params,
+                master=new_master if use_master else None,
+                opt=new_opt,
+                loss_scale=state.loss_scale,
+            )
+            metrics = {
+                "loss": loss,
+                # post-compression momentum norm (true grad norm would need
+                # the uncompressed reduction this phase exists to avoid)
+                "grad_norm": global_grad_norm(new_opt["mu"]),
+                "lr": lr,
+                "skipped": jnp.zeros((), jnp.int32),
+            }
+            return new_state, metrics
+
+        return jax.jit(step_fn, donate_argnums=(0,))
+
     def _build_grad_step(self):
         """Device half of the offloaded step: grads + loss + global norm.
         The optimizer update runs on the host (runtime/offload.py —
@@ -612,13 +783,24 @@ class DeepSpeedTPUEngine:
     def _dispatch_step(self, batch) -> Dict[str, Any]:
         if self._offload:
             return self._dispatch_offload_step(batch)
-        if self._train_step_fn is None:
-            self._train_step_fn = self._build_train_step()
+        # 1-bit Adam: switch to the compressed-momentum program once the
+        # warmup window ends (one extra compile at the phase boundary)
+        compressed_phase = (
+            self._onebit and self.global_steps >= self.optimizer.freeze_step
+        )
+        if compressed_phase:
+            if getattr(self, "_onebit_step_fn", None) is None:
+                self._onebit_step_fn = self._build_onebit_step()
+            step_fn = self._onebit_step_fn
+        else:
+            if self._train_step_fn is None:
+                self._train_step_fn = self._build_train_step()
+            step_fn = self._train_step_fn
         batch = self._reshape_gas(batch)
         batch = self.shard_batch(batch, leading_accum_dim=True)
         # Mesh context makes bare-PartitionSpec constraints inside the model
         # (Ulysses/TP activation specs) resolve against our mesh.
-        shape_key = tuple(
+        shape_key = (compressed_phase,) + tuple(
             (jax.tree_util.keystr(p), tuple(l.shape), str(l.dtype))
             for p, l in jax.tree_util.tree_flatten_with_path(batch)[0]
         )
@@ -630,7 +812,7 @@ class DeepSpeedTPUEngine:
                 # flops/comm accounting reads the program actually executed.
                 from ..profiling.hlo import collective_volumes
 
-                compiled = self._train_step_fn.lower(self.state, batch).compile()
+                compiled = step_fn.lower(self.state, batch).compile()
                 self._train_compiled_cache[shape_key] = compiled
                 comms_logger.record_compiled(collective_volumes(compiled))
             self._train_compiled = compiled

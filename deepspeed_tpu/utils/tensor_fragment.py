@@ -1,0 +1,134 @@
+"""Safe access to full fp32 params and optimizer state on a live engine.
+
+TPU-native analog of the reference fragment API
+(ref: deepspeed/utils/tensor_fragment.py safe_get_full_fp32_param /
+safe_set_full_fp32_param / safe_get_full_optimizer_state /
+safe_set_full_optimizer_state:108-140). There, low-precision partitioned
+params map onto fp32 master *fragments* scattered across ranks and the
+API gathers/scatters them; here state lives as global sharded arrays, so
+get = device_get of the leaf and set = device_put back with the leaf's
+sharding — plus tier awareness: host-DRAM offload leaves resolve on the
+host, NVMe leaves resolve through the swapper's files.
+
+Leaves are addressed by path: "layers/w_in" or ("layers", "w_in").
+"""
+
+from typing import Any, Optional, Tuple, Union
+
+import jax
+import numpy as np
+
+PathLike = Union[str, Tuple[Any, ...]]
+
+
+def _path_tuple(path: PathLike) -> Tuple[str, ...]:
+    if isinstance(path, str):
+        return tuple(p for p in path.replace(".", "/").split("/") if p)
+    return tuple(path)
+
+
+def _get_leaf(tree, path: Tuple[str, ...]):
+    node = tree
+    for p in path:
+        node = node[p]
+    return node
+
+
+def _set_leaf(tree, path: Tuple[str, ...], value):
+    """Functional leaf replacement (params trees are plain nested dicts)."""
+    if not path:
+        return value
+    new = dict(tree)
+    new[path[0]] = _set_leaf(tree[path[0]], path[1:], value) if len(path) > 1 else value
+    return new
+
+
+def safe_get_full_fp32_param(engine, path: PathLike) -> np.ndarray:
+    """The authoritative fp32 value of one parameter
+    (ref: tensor_fragment.py safe_get_full_fp32_param:108)."""
+    pt = _path_tuple(path)
+    if getattr(engine, "_offload_nvme", False):
+        master, _ = engine.swapper.export_state()
+        return np.asarray(_get_leaf(master, pt), np.float32)
+    src = engine.state.master if engine.state.master is not None else engine.state.params
+    return np.asarray(jax.device_get(_get_leaf(src, pt)), np.float32)
+
+
+def safe_set_full_fp32_param(engine, path: PathLike, value) -> None:
+    """Overwrite one parameter's fp32 master AND its compute-dtype copy,
+    so the change is live in the next step
+    (ref: tensor_fragment.py safe_set_full_fp32_param:124)."""
+    import dataclasses
+
+    from jax.sharding import NamedSharding
+
+    pt = _path_tuple(path)
+    value = np.asarray(value, np.float32)
+    state = engine.state
+
+    if getattr(engine, "_offload_nvme", False):
+        master, opt = engine.swapper.export_state()
+        cur = _get_leaf(master, pt)
+        if tuple(cur.shape) != tuple(value.shape):
+            raise ValueError(f"shape mismatch {cur.shape} vs {value.shape}")
+        engine.swapper.import_state(_set_leaf(master, pt, value), opt)
+    elif state.master is not None:
+        cur = _get_leaf(state.master, pt)
+        if tuple(cur.shape) != tuple(value.shape):
+            raise ValueError(f"shape mismatch {cur.shape} vs {value.shape}")
+        new_val = jax.device_put(value, cur.sharding)
+        state = dataclasses.replace(
+            state, master=_set_leaf(state.master, pt, new_val)
+        )
+
+    # the compute-dtype copy the model actually consumes
+    cur_p = _get_leaf(state.params, pt)
+    if tuple(cur_p.shape) != tuple(value.shape):
+        raise ValueError(f"shape mismatch {cur_p.shape} vs {value.shape}")
+    spec = _get_leaf(engine.param_specs, pt)
+    new_p = jax.device_put(
+        value.astype(cur_p.dtype), NamedSharding(engine.mesh, spec)
+    )
+    engine.state = dataclasses.replace(
+        state, params=_set_leaf(state.params, pt, new_p)
+    )
+
+
+def safe_get_full_optimizer_state(
+    engine, path: PathLike, state_key: str
+) -> np.ndarray:
+    """One moment buffer (e.g. 'mu', 'nu') for one parameter
+    (ref: tensor_fragment.py safe_get_full_optimizer_state:116)."""
+    pt = _path_tuple(path)
+    if getattr(engine, "_offload_nvme", False):
+        _, opt = engine.swapper.export_state()
+        return np.asarray(_get_leaf(opt[state_key], pt), np.float32)
+    return np.asarray(
+        jax.device_get(_get_leaf(engine.state.opt[state_key], pt)), np.float32
+    )
+
+
+def safe_set_full_optimizer_state(
+    engine, path: PathLike, state_key: str, value
+) -> None:
+    """ref: tensor_fragment.py safe_set_full_optimizer_state:132."""
+    import dataclasses
+
+    pt = _path_tuple(path)
+    value = np.asarray(value, np.float32)
+    if getattr(engine, "_offload_nvme", False):
+        master, opt = engine.swapper.export_state()
+        cur = _get_leaf(opt[state_key], pt)
+        if tuple(cur.shape) != tuple(value.shape):
+            raise ValueError(f"shape mismatch {cur.shape} vs {value.shape}")
+        opt = dict(opt)
+        opt[state_key] = _set_leaf(opt[state_key], pt, value)
+        engine.swapper.import_state(master, opt)
+        return
+    cur = _get_leaf(engine.state.opt[state_key], pt)
+    if tuple(cur.shape) != tuple(value.shape):
+        raise ValueError(f"shape mismatch {cur.shape} vs {value.shape}")
+    new_val = jax.device_put(value, cur.sharding)
+    new_opt = dict(engine.state.opt)
+    new_opt[state_key] = _set_leaf(engine.state.opt[state_key], pt, new_val)
+    engine.state = dataclasses.replace(engine.state, opt=new_opt)

@@ -1,0 +1,101 @@
+"""Offline consolidation of a checkpoint into a single fp32 state dict.
+
+TPU-native analog of the reference tool (ref: deepspeed/utils/
+zero_to_fp32.py — _get_fp32_state_dict_from_zero3_checkpoint:451 merges
+per-rank ZeRO shard files; convert_zero_checkpoint_to_fp32_state_dict
+:524 writes a consolidated torch state_dict). Orbax checkpoints store
+logical/global arrays, so there are no rank shards to merge — this tool
+restores the tree host-side WITHOUT an engine or mesh, picks the fp32
+master (falling back to stored params), and flattens to plain
+numpy — loadable anywhere ("reload in plain JAX/numpy" contract).
+
+Usage (mirrors `python zero_to_fp32.py checkpoint_dir output_file`):
+    python -m deepspeed_tpu.utils.zero_to_fp32 <ckpt_dir> <out.npz> [--tag TAG]
+"""
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def _resolve_tag(ckpt_dir: str, tag: Optional[str]) -> str:
+    if tag is None:
+        latest = os.path.join(ckpt_dir, "latest")
+        if not os.path.exists(latest):
+            raise FileNotFoundError(
+                f"no 'latest' file in {ckpt_dir}; pass tag explicitly"
+            )
+        with open(latest) as f:
+            tag = f.read().strip()
+    return tag
+
+
+def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}."))
+    else:
+        out[prefix[:-1]] = np.asarray(tree)
+    return out
+
+
+def get_fp32_state_dict_from_checkpoint(
+    ckpt_dir: str, tag: Optional[str] = None
+) -> Dict[str, Any]:
+    """Checkpoint dir → nested dict of fp32 numpy parameter arrays.
+
+    (ref: zero_to_fp32.py get_fp32_state_dict_from_zero_checkpoint —
+    the returned tree is the model's parameter pytree, master-precision.)
+    """
+    import orbax.checkpoint as ocp
+
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    tag = _resolve_tag(ckpt_dir, tag)
+    state_path = os.path.join(ckpt_dir, tag, "state")
+    meta_path = os.path.join(ckpt_dir, tag, "meta.json")
+    meta = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+
+    raw = ocp.Checkpointer(ocp.PyTreeCheckpointHandler()).restore(state_path)
+    has_master = meta.get("has_master", raw.get("master") is not None)
+    src = raw["master"] if has_master and raw.get("master") is not None else raw["params"]
+    import jax
+
+    return jax.tree.map(lambda x: np.asarray(x, dtype=np.float32), src)
+
+
+def convert_checkpoint_to_fp32_state_dict(
+    ckpt_dir: str, output_file: str, tag: Optional[str] = None
+) -> Dict[str, np.ndarray]:
+    """Write a consolidated .npz of fp32 params (flat dot-joined keys).
+
+    (ref: zero_to_fp32.py convert_zero_checkpoint_to_fp32_state_dict:524)
+    """
+    tree = get_fp32_state_dict_from_checkpoint(ckpt_dir, tag)
+    flat = _flatten(tree)
+    np.savez(output_file, **flat)
+    return flat
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_file")
+    p.add_argument("--tag", default=None)
+    args = p.parse_args(argv)
+    flat = convert_checkpoint_to_fp32_state_dict(
+        args.checkpoint_dir, args.output_file, args.tag
+    )
+    total = sum(v.size for v in flat.values())
+    print(f"wrote {len(flat)} tensors / {total:,} fp32 params to {args.output_file}")
+
+
+if __name__ == "__main__":
+    main()

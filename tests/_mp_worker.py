@@ -1,0 +1,87 @@
+"""Worker for the 2-process distributed test lane (test_multiprocess.py).
+
+The analog of one rank's body under the reference's DistributedTest
+(ref: tests/unit/common.py:358 — forkserver procs + env:// rendezvous).
+Args: <rank> <port> <ckpt_dir>
+"""
+
+import os
+import sys
+
+
+def main():
+    rank, port, ckpt_dir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=4"
+    os.environ["MASTER_ADDR"] = "127.0.0.1"
+    os.environ["MASTER_PORT"] = port
+    os.environ["WORLD_SIZE"] = "2"
+    os.environ["RANK"] = str(rank)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_threefry_partitionable", True)
+
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import transformer as T
+
+    # env:// discovery path of init_distributed (ref: comm.py:604)
+    ds.comm.init_distributed()
+    assert ds.comm.is_initialized()
+    assert ds.comm.get_process_count() == 2, ds.comm.get_process_count()
+    assert ds.comm.get_world_size() == 8, ds.comm.get_world_size()
+    assert ds.comm.get_rank() == rank
+
+    # host-side control plane: broadcast + barrier (ref: comm.py barrier)
+    v = ds.comm.broadcast_host(np.int32(123 if rank == 0 else 999), src=0)
+    assert int(v) == 123, v
+    ds.comm.barrier("post-broadcast")
+
+    mcfg = T.TransformerConfig(vocab_size=128, n_layers=2, n_heads=4,
+                               d_model=64, max_seq=32, variant="llama",
+                               use_flash=False)
+    engine = ds.initialize(
+        {
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "mesh": {"data": -1},
+            "seed": 7,
+            "steps_per_print": 1000,
+        },
+        loss_fn=T.make_loss_fn(mcfg),
+        param_init_fn=lambda k: T.init(mcfg, k),
+        param_logical_specs=T.logical_specs(mcfg),
+    )
+
+    r = np.random.default_rng(0)  # same data on every process (SPMD contract)
+    batches = [
+        {"tokens": r.integers(0, 128, (16, 33)).astype(np.int32)}
+        for _ in range(4)
+    ]
+    l0 = engine.train_batch(batches[0])["loss"]
+    l1 = engine.train_batch(batches[1])["loss"]
+
+    # multi-host checkpoint: every process writes its shards; 'latest' is
+    # published by rank 0 only after the data is committed
+    engine.save_checkpoint(ckpt_dir)
+    ds.comm.barrier("post-save")
+    assert os.path.exists(os.path.join(ckpt_dir, "latest"))
+
+    l2_before = engine.train_batch(batches[2])["loss"]
+    tag, _ = engine.load_checkpoint(ckpt_dir)
+    l2_after = engine.train_batch(batches[2])["loss"]
+    assert abs(l2_before - l2_after) < 1e-4, (l2_before, l2_after)
+
+    ds.comm.barrier("end")
+    print(f"WORKER-OK rank={rank} losses={l0:.6f},{l1:.6f},{l2_after:.6f} tag={tag}")
+
+
+if __name__ == "__main__":
+    main()

@@ -134,7 +134,6 @@ def test_unimplemented_knobs_raise():
     import pytest as _pytest
     base = {"train_micro_batch_size_per_gpu": 1}
     for extra in (
-        {"zero_optimization": {"zero_quantized_gradients": True}},
         {"zero_optimization": {"offload_param": {"device": "cpu"}}},
         {"checkpoint": {"load_universal": True}},
         {"prescale_gradients": True},

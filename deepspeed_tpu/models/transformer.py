@@ -61,6 +61,11 @@ class TransformerConfig:
     # >1 stores layers stage-partitioned [P, L/P, ...] and routes the
     # forward through runtime/pipe.pipeline_apply.
     pipeline_stages: int = 1
+    # Random-LTD (ref: data_pipeline/data_routing/basic_layer.py
+    # RandomLayerTokenDrop:107): layers in [start, end) process only the
+    # batch-supplied 'random_ltd' token subset; dropped tokens skip them
+    # and are re-inserted in order. None disables.
+    random_ltd_layer_range: Optional[Tuple[int, int]] = None
 
     @property
     def kv_heads(self) -> int:
@@ -214,20 +219,26 @@ def _norm(x, scale, bias, cfg: TransformerConfig):
     return out.astype(x.dtype)
 
 
-def _rope(q, k, cfg: TransformerConfig, offset: int = 0):
+def _rope(q, k, cfg: TransformerConfig, offset: int = 0, positions=None):
     """Rotary embeddings (ref kernel: csrc/transformer/inference/csrc/
-    apply_rotary_pos_emb.cu — on TPU this is pure VPU code XLA fuses)."""
+    apply_rotary_pos_emb.cu — on TPU this is pure VPU code XLA fuses).
+
+    positions: optional [B, S] token positions (random-LTD subsets keep
+    their ORIGINAL positions, ref: basic_layer.py position handling)."""
     D = cfg.head_dim
     S = q.shape[1]
-    pos = jnp.arange(offset, offset + S, dtype=jnp.float32)
+    if positions is None:
+        pos = jnp.arange(offset, offset + S, dtype=jnp.float32)[None, :]  # [1,S]
+    else:
+        pos = positions.astype(jnp.float32)  # [B,S]
     freqs = cfg.rope_theta ** (-jnp.arange(0, D // 2, dtype=jnp.float32) / (D // 2))
-    angles = pos[:, None] * freqs[None, :]  # [S, D/2]
+    angles = pos[..., None] * freqs[None, None, :]  # [B|1, S, D/2]
     cos, sin = jnp.cos(angles), jnp.sin(angles)
 
     def rot(x):
         x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-        c = cos[None, :, None, :]
-        s = sin[None, :, None, :]
+        c = cos[:, :, None, :]
+        s = sin[:, :, None, :]
         return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
 
     return rot(q), rot(k)
@@ -269,7 +280,7 @@ def _dropout(x, rate: float, rng):
     return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
 
 
-def _attention_block(x, lp, cfg: TransformerConfig, rng=None):
+def _attention_block(x, lp, cfg: TransformerConfig, rng=None, positions=None):
     B, S, E = x.shape
     h = _norm(x, lp["ln1_scale"], lp.get("ln1_bias"), cfg)
     q = jnp.einsum("bse,ehd->bshd", h, lp["wq"].astype(x.dtype))
@@ -280,7 +291,7 @@ def _attention_block(x, lp, cfg: TransformerConfig, rng=None):
         k = k + lp["bk"].astype(x.dtype)
         v = v + lp["bv"].astype(x.dtype)
     else:
-        q, k = _rope(q, k, cfg)
+        q, k = _rope(q, k, cfg, positions=positions)
 
     # Ulysses: re-shard seq→heads around attention; XLA emits the
     # all-to-all pair (ref: sequence/layer.py single_all_to_all:15).
@@ -381,9 +392,11 @@ def _wants_rng(cfg: TransformerConfig) -> bool:
     )
 
 
-def _make_layer_body(cfg: TransformerConfig, use_rng: bool):
+def _make_layer_body(cfg: TransformerConfig, use_rng: bool, positions=None):
     """One transformer layer as a scan body (shared by the flat
-    scan-over-layers path and the pipelined per-stage path)."""
+    scan-over-layers path, the pipelined per-stage path, and the
+    random-LTD subset segment — which passes the subset's original
+    `positions`)."""
 
     def layer_body(carry, xs):
         if use_rng:
@@ -392,7 +405,7 @@ def _make_layer_body(cfg: TransformerConfig, use_rng: bool):
         else:
             h0, lp = carry, xs
             r1 = r2 = None
-        h = _attention_block(h0, lp, cfg, r1)
+        h = _attention_block(h0, lp, cfg, r1, positions=positions)
         h, l_aux = _mlp_block(h, lp, cfg, r2)
         h = _shard(h, DP, "seq", None)
         return h, l_aux
@@ -407,12 +420,15 @@ def _make_layer_body(cfg: TransformerConfig, use_rng: bool):
 
 
 def forward_hidden(
-    params: Dict[str, Any], tokens, cfg: TransformerConfig, rng=None, with_aux: bool = False
+    params: Dict[str, Any], tokens, cfg: TransformerConfig, rng=None,
+    with_aux: bool = False, ltd_idx=None,
 ):
     """tokens [B, S] int32 → final hidden states [B, S, E] (post ln_f).
 
     with_aux=True additionally returns {"moe_aux_loss": scalar} (sum of
-    per-layer load-balancing losses; 0 for dense models)."""
+    per-layer load-balancing losses; 0 for dense models).
+    ltd_idx [B, K] (with cfg.random_ltd_layer_range set) routes the LTD
+    layer segment over the kept-token subset only."""
     x = params["embed"][tokens]
     x = _shard(x, DP, "seq", None)
     if cfg.variant == "gpt2":
@@ -430,14 +446,35 @@ def forward_hidden(
 
         layers = unpartition_layers(layers)
 
-    if use_rng:
-        layer_rngs = jax.random.split(rng, cfg.n_layers)
-        x, aux = jax.lax.scan(layer_body, x, (layers, layer_rngs))
+    layer_rngs = jax.random.split(rng, cfg.n_layers) if use_rng else None
+
+    def seg(x_in, lo, hi, body):
+        lp = jax.tree.map(lambda t: t[lo:hi], layers)
+        xs = (lp, layer_rngs[lo:hi]) if use_rng else lp
+        return jax.lax.scan(body, x_in, xs)
+
+    if ltd_idx is not None and cfg.random_ltd_layer_range is not None:
+        # Random-LTD: layers in [a, b) see only the kept tokens (at their
+        # original positions); dropped tokens skip the segment and are
+        # re-inserted in order (ref: basic_layer.py fwd gather/scatter,
+        # csrc/random_ltd gather_scatter.cu → XLA take/scatter).
+        if cfg.pipeline_stages > 1:
+            raise NotImplementedError("random-LTD with pipeline_stages > 1")
+        a, b = cfg.random_ltd_layer_range
+        B = x.shape[0]
+        x, aux1 = seg(x, 0, a, layer_body)
+        h_sub = jnp.take_along_axis(x, ltd_idx[..., None], axis=1)
+        sub_body = _make_layer_body(cfg, use_rng, positions=ltd_idx)
+        h_sub, aux2 = seg(h_sub, a, b, sub_body)
+        x = x.at[jnp.arange(B)[:, None], ltd_idx].set(h_sub)
+        x, aux3 = seg(x, b, cfg.n_layers, layer_body)
+        aux_sum = jnp.sum(aux1) + jnp.sum(aux2) + jnp.sum(aux3)
     else:
-        x, aux = jax.lax.scan(layer_body, x, layers)
+        x, aux = seg(x, 0, cfg.n_layers, layer_body)
+        aux_sum = jnp.sum(aux)
     out = _norm(x, params["ln_f_scale"], params.get("ln_f_bias"), cfg)
     if with_aux:
-        return out, {"moe_aux_loss": jnp.sum(aux)}
+        return out, {"moe_aux_loss": aux_sum}
     return out
 
 
@@ -517,7 +554,10 @@ def make_loss_fn(cfg: TransformerConfig, loss_chunks: int = 8):
     def loss_fn(params, batch, rng):
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        x, aux = forward_hidden(params, inputs, cfg, rng, with_aux=True)
+        x, aux = forward_hidden(
+            params, inputs, cfg, rng, with_aux=True,
+            ltd_idx=batch.get("random_ltd"),
+        )
         n = _ce_chunk_count(inputs.shape[1], loss_chunks)
         loss = _token_mean_ce(x, _lm_head(params, cfg), targets, _shift_mask(batch, targets), n)
         if cfg.n_experts > 0:

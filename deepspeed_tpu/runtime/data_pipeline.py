@@ -1,0 +1,152 @@
+"""Data-efficiency pipeline: curriculum learning + random-LTD.
+
+TPU-native analog of the reference data pipeline
+(ref: runtime/data_pipeline/curriculum_scheduler.py CurriculumScheduler
+:13 — fixed_discrete/fixed_linear/fixed_root/custom difficulty
+schedules; data_routing/basic_layer.py RandomLayerTokenDrop:107 +
+scheduler.py — per-layer random token dropping with a scheduled
+reserved-token count; CUDA gather/scatter in csrc/random_ltd → jnp
+take/scatter here, per SURVEY §2.2 'perf-noncritical').
+
+Shape dynamics under jit: difficulty changes change tensor shapes, which
+the engine's per-shape AOT cache turns into one recompile per difficulty
+level (choose difficulty_step / fixed_discrete granularity accordingly —
+the TPU analog of the reference's tensor-core-alignment warning).
+"""
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+
+class CurriculumScheduler:
+    """Difficulty schedule over global steps
+    (ref: curriculum_scheduler.py:13; same schedule math)."""
+
+    def __init__(self, config: Dict[str, Any]):
+        self.min = int(config["min_difficulty"])
+        self.max = int(config["max_difficulty"])
+        self.schedule_type = config["schedule_type"]
+        self.cfg = dict(config.get("schedule_config", {}))
+        self.current = self.min
+        self.custom_get_difficulty: Optional[Callable[[int], int]] = None
+        if self.schedule_type == "fixed_discrete":
+            need = ("difficulty", "max_step")
+        elif self.schedule_type in ("fixed_linear", "fixed_root"):
+            need = ("total_curriculum_step", "difficulty_step")
+            if self.schedule_type == "fixed_root":
+                need += ("root_degree",)
+        elif self.schedule_type == "custom":
+            need = ()
+        else:
+            raise ValueError(f"unsupported curriculum schedule {self.schedule_type}")
+        for k in need:
+            if k not in self.cfg:
+                raise ValueError(f"curriculum schedule_config requires '{k}'")
+
+    def _fixed_root(self, step: int, degree: float) -> int:
+        frac = (float(step) / self.cfg["total_curriculum_step"]) ** (1.0 / degree)
+        d = math.floor(frac * (self.max - self.min) + self.min)
+        d -= d % self.cfg["difficulty_step"]
+        # step-rounding may undershoot min_difficulty (e.g. min=8, step=16
+        # → 0): clamp BOTH ends so early steps never produce a degenerate
+        # (or empty) sequence length
+        return min(max(d, self.min), self.max)
+
+    def get_difficulty(self, step: int) -> int:
+        if self.schedule_type == "fixed_discrete":
+            steps, diffs = self.cfg["max_step"], self.cfg["difficulty"]
+            if step > steps[-1]:
+                return diffs[-1]
+            for s, d in zip(steps, diffs):
+                if step <= s:
+                    return d
+        if self.schedule_type == "fixed_linear":
+            return self._fixed_root(step, 1.0)
+        if self.schedule_type == "fixed_root":
+            return self._fixed_root(step, float(self.cfg["root_degree"]))
+        if self.custom_get_difficulty is None:
+            raise ValueError("custom curriculum needs set_custom_get_difficulty")
+        return self.custom_get_difficulty(step)
+
+    def update_difficulty(self, step: int) -> int:
+        if self.current < self.max:
+            self.current = self.get_difficulty(step)
+        return self.current
+
+    def set_custom_get_difficulty(self, fn: Callable[[int], int]) -> None:
+        self.custom_get_difficulty = fn
+
+    # checkpointable state (ref: get_state/set_state)
+    def get_state(self) -> Dict[str, Any]:
+        return {"current": self.current}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.current = int(state["current"])
+
+
+def truncate_to_seqlen(batch: Dict[str, Any], seqlen: int) -> Dict[str, Any]:
+    """Seqlen-metric curriculum: truncate every [B, S(+1), ...] leaf's
+    token dim (the Megatron-side truncation the reference expects users
+    to do with engine.curriculum_learning seqlen)."""
+    import jax
+
+    if "random_ltd" in batch:
+        # truncation would cut the index list and leave indices pointing
+        # past the new sequence end — silently corrupting the LTD routing
+        raise NotImplementedError(
+            "seqlen curriculum and random-LTD cannot be combined in one "
+            "batch; sample LTD indices from the truncated length instead"
+        )
+
+    def trunc(x):
+        x = np.asarray(x)
+        if x.ndim >= 2 and x.shape[1] > seqlen + 1:
+            return x[:, : seqlen + 1]
+        return x
+
+    return jax.tree.map(trunc, batch)
+
+
+class RandomLTDScheduler:
+    """Reserved-token-count schedule for random layer-token-drop
+    (ref: data_pipeline/data_routing/scheduler.py — a fixed_linear walk
+    of the reserved token count from min_tokens up to max_tokens, i.e.
+    the full sequence, over total_steps). `step_size` quantizes the
+    count so each distinct value costs exactly one recompile."""
+
+    def __init__(self, min_tokens: int, max_tokens: int,
+                 total_steps: int, step_size: int = 16, seed: int = 1234):
+        self.min_tokens = int(min_tokens)
+        self.max_tokens = int(max_tokens)
+        self.total_steps = int(total_steps)
+        self.step_size = int(step_size)
+        self._rng = np.random.default_rng(seed)
+
+    def reserved_tokens(self, step: int) -> int:
+        frac = min(float(step) / self.total_steps, 1.0)
+        n = math.floor((self.min_tokens + frac * (self.max_tokens - self.min_tokens)))
+        n -= n % self.step_size
+        return int(min(max(n, self.min_tokens), self.max_tokens))
+
+    def sample_batch_indices(self, batch_size: int, seq_len: int, keep: int):
+        """Sorted per-example keep-indices [B, keep] (the token_sort.cu
+        sort: subset preserves original order/causality)."""
+        idx = np.stack([
+            np.sort(self._rng.choice(seq_len, size=keep, replace=False))
+            for _ in range(batch_size)
+        ]).astype(np.int32)
+        return idx
+
+    def apply(self, batch: Dict[str, Any], step: int) -> Dict[str, Any]:
+        """Attach 'random_ltd' indices for the model's LTD layer range.
+        Keep-count changes recompile (one per schedule step)."""
+        tokens = np.asarray(batch["tokens"])
+        seq = tokens.shape[1] - 1  # model consumes S = S_tokens - 1
+        keep = min(self.reserved_tokens(step), seq)
+        if keep >= seq:
+            return batch
+        out = dict(batch)
+        out["random_ltd"] = self.sample_batch_indices(tokens.shape[0], seq, keep)
+        return out

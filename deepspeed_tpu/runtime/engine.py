@@ -129,6 +129,31 @@ class DeepSpeedTPUEngine:
                 "data/model axes"
             )
         self.dp_world_size = data_parallel_size(self.mesh)
+        if config.elasticity.enabled:
+            # derive the batch triangle from the elastic config + current
+            # device count (ref: engine._set_batch_related_parameters under
+            # DEEPSPEED_ELASTICITY_CONFIG; resize = rebuild mesh + reshard
+            # checkpoint, no agent restart needed on TPU)
+            from ..elasticity import compute_elastic_config
+
+            if (
+                not config.elasticity.ignore_non_elastic_batch_info
+                and (config.train_batch_size is not None
+                     or config.train_micro_batch_size_per_gpu is not None
+                     or config.gradient_accumulation_steps is not None)
+            ):
+                raise ValueError(
+                    "elasticity is enabled but the config also pins batch "
+                    "sizes / gradient_accumulation_steps; remove them or "
+                    "set ignore_non_elastic_batch_info"
+                )
+            batch, _valid, micro = compute_elastic_config(
+                {"elasticity": config.elasticity.model_dump()},
+                world_size=self.dp_world_size,
+            )
+            config.train_batch_size = batch
+            config.train_micro_batch_size_per_gpu = micro
+            config.gradient_accumulation_steps = None
         config.resolve_batch_sizes(self.dp_world_size)
         log_dist(
             f"engine: {describe(self.mesh)} | zero stage {config.zero_stage} | "
@@ -223,6 +248,12 @@ class DeepSpeedTPUEngine:
                 raise NotImplementedError(
                     "gradient_clipping is not supported with 1-bit Adam"
                 )
+            if config.zero_optimization.offload_optimizer.device != "none":
+                # the offload dispatch path would bypass the compression
+                # phase entirely — refuse rather than silently run plain Adam
+                raise NotImplementedError(
+                    "1-bit Adam does not compose with offload_optimizer"
+                )
             opt_params["dp"] = int(
                 self.mesh.shape["data"] * self.mesh.shape["zero"]
             )
@@ -288,6 +319,21 @@ class DeepSpeedTPUEngine:
         self._metrics_host: Dict[str, float] = {}
 
         self.checkpoint_engine = CheckpointEngine(async_save=config.checkpoint.async_save)
+
+        # curriculum learning (ref: runtime/data_pipeline/
+        # curriculum_scheduler.py wired at engine.py train-batch level)
+        if config.curriculum_learning.enabled:
+            from .data_pipeline import CurriculumScheduler
+
+            if config.curriculum_learning.curriculum_type != "seqlen":
+                raise NotImplementedError(
+                    "only the 'seqlen' curriculum metric is implemented"
+                )
+            self.curriculum = CurriculumScheduler(
+                config.curriculum_learning.model_dump()
+            )
+        else:
+            self.curriculum = None
 
     # ------------------------------------------------------------------
     # state construction ("zero.Init" analog, functional:
@@ -834,6 +880,11 @@ class DeepSpeedTPUEngine:
         Accepts host arrays shaped [train_batch_size, ...] or
         [gas, train_batch_size/gas, ...]; returns host metrics (synced).
         """
+        if self.curriculum is not None:
+            from .data_pipeline import truncate_to_seqlen
+
+            seqlen = self.curriculum.update_difficulty(self.global_steps + 1)
+            batch = truncate_to_seqlen(batch, seqlen)
         self.tput.start()
         self.timers(BATCH_TIMER).start()
         metrics = self._dispatch_step(batch)

@@ -2,7 +2,9 @@
 
 The analog of one rank's body under the reference's DistributedTest
 (ref: tests/unit/common.py:358 — forkserver procs + env:// rendezvous).
-Args: <rank> <port> <ckpt_dir>
+Spawned by deepspeed_tpu.launcher.launch_local, which provides the
+MASTER_ADDR/PORT + RANK/WORLD_SIZE env contract and the per-process
+device count. Args: <ckpt_dir>
 """
 
 import os
@@ -10,15 +12,8 @@ import sys
 
 
 def main():
-    rank, port, ckpt_dir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=4"
-    os.environ["MASTER_ADDR"] = "127.0.0.1"
-    os.environ["MASTER_PORT"] = port
-    os.environ["WORLD_SIZE"] = "2"
-    os.environ["RANK"] = str(rank)
+    ckpt_dir = sys.argv[1]
+    rank = int(os.environ["RANK"])
 
     import jax
 

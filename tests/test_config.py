@@ -138,7 +138,7 @@ def test_unimplemented_knobs_raise():
         {"checkpoint": {"load_universal": True}},
         {"prescale_gradients": True},
         {"sparse_attention": {"mode": "fixed"}},
-        {"autotuning": {"enabled": True}},
+        {"compression_training": {"weight_quantization": {}}},
     ):
         with _pytest.raises(NotImplementedError):
             parse_config({**base, **extra})
@@ -159,12 +159,12 @@ def test_disabled_unimplemented_blocks_parse():
     cfg = parse_config({
         "train_micro_batch_size_per_gpu": 1,
         "autotuning": {"enabled": False},
-        "curriculum_learning": {"enabled": False},
+        "data_efficiency": {"enabled": False},
     })
     assert cfg.train_micro_batch_size_per_gpu == 1
     with pytest.raises(NotImplementedError):
         parse_config({"train_micro_batch_size_per_gpu": 1,
-                      "autotuning": {"enabled": True}})
+                      "data_efficiency": {"enabled": True}})
 
 
 def test_gradient_predivide_factor_guard():

@@ -1,0 +1,149 @@
+"""Elasticity + autotuning tests.
+
+Ref model: tests/unit/elasticity/test_elastic.py (canonical 10k case →
+batch 9792 with 23 valid counts) and tests/unit/autotuning.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.autotuning import Autotuner
+from deepspeed_tpu.elasticity import (
+    ElasticityIncompatibleWorldSize,
+    compute_elastic_config,
+)
+from deepspeed_tpu.models import transformer as T
+
+VOCAB = 128
+
+
+def elastic_cfg(**kw):
+    base = {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+    base.update(kw)
+    return {"elasticity": base}
+
+
+class TestElasticity:
+    def test_basic_10k(self):
+        """The reference's canonical case (test_elastic.py test_basic_10k)."""
+        batch, valid = compute_elastic_config(elastic_cfg())
+        assert batch == 9792
+        assert len(valid) == 23
+        for n in valid:
+            assert batch % n == 0
+            per = batch // n
+            assert any(per % mb == 0 for mb in (8, 12, 16, 17))
+
+    def test_world_size_micro_batch(self):
+        batch, valid, micro = compute_elastic_config(elastic_cfg(), world_size=64)
+        assert batch == 9792 and micro in (8, 12, 16, 17)
+        assert (batch // 64) % micro == 0
+
+    def test_incompatible_world_size(self):
+        with pytest.raises(ElasticityIncompatibleWorldSize):
+            compute_elastic_config(elastic_cfg(), world_size=147)
+
+    def test_disabled_raises(self):
+        with pytest.raises(Exception, match="disabled"):
+            compute_elastic_config(elastic_cfg(enabled=False))
+
+    def test_engine_derives_batch_from_elastic_config(self):
+        mcfg = T.TransformerConfig(vocab_size=VOCAB, n_layers=2, n_heads=4,
+                                   d_model=64, max_seq=32, variant="llama",
+                                   use_flash=False)
+        engine = ds.initialize(
+            {
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "elasticity": {
+                    "enabled": True,
+                    "max_train_batch_size": 200,
+                    "micro_batch_sizes": [8],
+                    "min_gpus": 1,
+                    "max_gpus": 64,
+                },
+                "steps_per_print": 1000,
+            },
+            loss_fn=T.make_loss_fn(mcfg),
+            param_init_fn=lambda k: T.init(mcfg, k),
+            param_logical_specs=T.logical_specs(mcfg),
+        )
+        cfg = engine.config
+        # dp=8 (virtual mesh): triangle must close on the elastic batch
+        assert cfg.train_batch_size == (
+            cfg.train_micro_batch_size_per_gpu
+            * cfg.gradient_accumulation_steps * 8
+        )
+        r = np.random.default_rng(0)
+        loss = engine.train_batch({"tokens": r.integers(
+            0, VOCAB, (cfg.train_batch_size, 33)).astype(np.int32)})["loss"]
+        assert np.isfinite(loss)
+
+    def test_engine_rejects_pinned_batch_with_elasticity(self):
+        mcfg = T.TransformerConfig(vocab_size=VOCAB, n_layers=2, n_heads=4,
+                                   d_model=64, max_seq=32, variant="llama",
+                                   use_flash=False)
+        with pytest.raises(ValueError, match="elasticity"):
+            ds.initialize(
+                {
+                    "train_batch_size": 64,
+                    "elasticity": {"enabled": True, "max_train_batch_size": 200,
+                                   "micro_batch_sizes": [2, 4]},
+                },
+                loss_fn=T.make_loss_fn(mcfg),
+                param_init_fn=lambda k: T.init(mcfg, k),
+            )
+
+
+class TestAutotuner:
+    def test_tune_picks_feasible_config(self, tmp_path):
+        mcfg = T.TransformerConfig(vocab_size=VOCAB, n_layers=2, n_heads=4,
+                                   d_model=64, max_seq=32, variant="llama",
+                                   use_flash=False)
+        r = np.random.default_rng(0)
+
+        def make_batch(n):
+            return {"tokens": r.integers(0, VOCAB, (n, 33)).astype(np.int32)}
+
+        tuner = Autotuner(
+            {
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "steps_per_print": 10**9,
+                "autotuning": {"enabled": True, "fast": True},
+            },
+            loss_fn=T.make_loss_fn(mcfg),
+            param_init_fn=lambda k: T.init(mcfg, k),
+            param_logical_specs=T.logical_specs(mcfg),
+            make_batch=make_batch,
+            results_dir=str(tmp_path),
+        )
+        info = tuner.model_info()
+        assert info["num_params"] > 0
+        best = tuner.tune(zero_stages=(0, 1), micro_batch_sizes=(1, 2),
+                          steps=2)
+        assert best["zero_optimization"]["stage"] in (0, 1)
+        assert best["train_micro_batch_size_per_gpu"] in (1, 2)
+        # experiment log exists with one record per candidate
+        recs = [json.loads(l) for l in open(os.path.join(tmp_path, "exps.jsonl"))]
+        assert len(recs) == 4
+        assert any(r["ok"] for r in recs)
+        # tuned config actually builds
+        engine = ds.initialize(
+            best,
+            loss_fn=T.make_loss_fn(mcfg),
+            param_init_fn=lambda k: T.init(mcfg, k),
+            param_logical_specs=T.logical_specs(mcfg),
+        )
+        assert np.isfinite(engine.train_batch(
+            make_batch(engine.config.train_batch_size))["loss"])

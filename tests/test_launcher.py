@@ -1,0 +1,48 @@
+"""Launcher + env-report tests (ref: tests/unit/launcher)."""
+
+import os
+import subprocess
+import sys
+
+from deepspeed_tpu.launcher.runner import launch_local
+
+
+def test_env_report_runs():
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.env_report"],
+        capture_output=True, text=True, timeout=180, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr
+    assert "op compatibility" in out.stdout
+    assert "async_io" in out.stdout
+    assert "device count" in out.stdout
+
+
+def test_launch_local_spawns_world(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, jax\n"
+        "jax.config.update('jax_platforms','cpu')\n"
+        "import deepspeed_tpu as ds\n"
+        "ds.comm.init_distributed()\n"
+        "assert ds.comm.get_process_count() == 2\n"
+        "assert ds.comm.get_world_size() == 4\n"
+        "print(f'rank {os.environ[\"RANK\"]} sees world '\n"
+        "      f'{ds.comm.get_world_size()}')\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rc = launch_local(
+        [sys.executable, str(script)], num_procs=2, devices_per_proc=2,
+        env_extra={"PYTHONPATH": repo},
+    )
+    assert rc == 0
+
+
+def test_launch_local_propagates_failure(tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    rc = launch_local([sys.executable, str(script)], num_procs=2)
+    assert rc == 3

@@ -2,57 +2,39 @@
 
 The DistributedTest analog (ref: tests/unit/common.py:358 — N OS
 processes, free MASTER_PORT, env rendezvous, hang timeout with hard
-kill). Two python processes x 4 fake CPU devices each form one 8-device
-world; the worker exercises init_distributed discovery, barrier,
-broadcast_host, SPMD training, and cross-process checkpoint commit
-ordering (VERDICT r1 item 10).
+kill) — driven through the framework's own launcher
+(deepspeed_tpu.launcher.launch_local). Two python processes x 4 fake CPU
+devices each form one 8-device world; the worker exercises
+init_distributed discovery, barrier, broadcast_host, SPMD training, and
+cross-process checkpoint commit ordering (VERDICT r1 item 10).
 """
 
 import os
-import socket
-import subprocess
 import sys
 
-import pytest
+from deepspeed_tpu.launcher.runner import launch_local
 
-TIMEOUT_S = 420  # ref: common.py:26 — 600s hang timeout, hard exit
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+TIMEOUT_S = 420
 
 
-def test_two_process_world(tmp_path):
+def test_two_process_world(tmp_path, capsys):
     worker = os.path.join(os.path.dirname(__file__), "_mp_worker.py")
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(worker)))
-    port = str(_free_port())
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
-    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [
-        subprocess.Popen(
-            [sys.executable, worker, str(rank), port, str(tmp_path / "ckpt")],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
-        )
-        for rank in range(2)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=TIMEOUT_S)
-            outs.append(out)
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            p.kill()
-        pytest.fail("distributed worker hang (ref common.py:165 hard kill)")
-
-    for rank, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
-        assert "WORKER-OK" in out, out
-
+    rc = launch_local(
+        [sys.executable, worker, str(tmp_path / "ckpt")],
+        num_procs=2,
+        devices_per_proc=4,
+        env_extra={
+            "PYTHONPATH": repo_root,
+            "XLA_FLAGS": "",  # drop the parent's 8-device flag
+            "JAX_PLATFORMS": "cpu",
+        },
+        timeout_s=TIMEOUT_S,
+    )
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    lines = sorted(l for l in out.splitlines() if "WORKER-OK" in l)
+    assert len(lines) == 2, out
     # both controllers computed the identical global trajectory
-    line0 = [l for l in outs[0].splitlines() if "WORKER-OK" in l][0]
-    line1 = [l for l in outs[1].splitlines() if "WORKER-OK" in l][0]
-    assert line0.split("rank=0 ")[1] == line1.split("rank=1 ")[1]
+    tail = [l.split("losses=")[1] for l in lines]
+    assert tail[0] == tail[1], lines

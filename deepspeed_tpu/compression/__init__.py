@@ -1,0 +1,1 @@
+from .compress import build_compression, clean_compressed_params, init_compression

@@ -276,6 +276,9 @@ class DeepSpeedTPUConfig(ConfigModel):
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
     autotuning: AutotuningConfig = Field(default_factory=AutotuningConfig)
     curriculum_learning: CurriculumConfig = Field(default_factory=CurriculumConfig)
+    # compression training (ref: compression/config.py — deep free-form
+    # schema validated by compression.init_compression at engine build)
+    compression_training: Optional[Dict[str, Any]] = None
 
     @model_validator(mode="after")
     def _check_precision(self):
@@ -423,8 +426,7 @@ _REFERENCE_RENAMES: Dict[str, Dict[str, str]] = {
 # Whole reference config blocks naming features that do not exist yet —
 # presence raises (silent acceptance would be a lie).
 _UNIMPLEMENTED_BLOCKS = (
-    "sparse_attention", "data_efficiency",
-    "compression_training", "nebula",
+    "data_efficiency", "nebula",
     "hybrid_engine", "zero_quantized_nontrainable_weights",
 )
 
@@ -441,6 +443,14 @@ def _compat_filter(config: Dict[str, Any]) -> Dict[str, Any]:
             return bool(block["enabled"])
         return bool(block)
 
+    if "sparse_attention" in config and _enabled(config.get("sparse_attention")):
+        raise NotImplementedError(
+            "the sparse_attention config block has no engine-level consumer "
+            "(models are functional here); enable it on the model instead: "
+            "TransformerConfig(attention_impl='sparse', sparse_mode=..., "
+            "sparse_block=...)"
+        )
+    config.pop("sparse_attention", None)
     present = [b for b in _UNIMPLEMENTED_BLOCKS
                if b in config and _enabled(config.pop(b))]
     if present:

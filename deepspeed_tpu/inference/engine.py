@@ -68,9 +68,23 @@ class InferenceEngine:
         params: Any,
         config: Optional[InferenceConfig] = None,
         dtype=jnp.bfloat16,
+        quantization: Optional[Dict[str, Any]] = None,
     ):
+        """quantization: ZeRO-Inference weight-only PTQ, e.g.
+        {"bits": 8, "group_size": 128} — weights stay int8/int4 in HBM
+        and dequantize transiently inside each compiled step
+        (ref: deepspeed/inference/quantization/)."""
         self.cfg = model_config
         self.config = config or InferenceConfig()
+        if model_config.attention_impl == "sparse":
+            # serving a sparse-trained model with dense attention would
+            # silently change numerics — refuse until the paged kernels
+            # honor block-sparse layouts
+            raise NotImplementedError(
+                "inference over attention_impl='sparse' models is not "
+                "implemented (ulysses/ring train-time impls are exact "
+                "attention and serve fine)"
+            )
         if model_config.variant == "gpt2":
             # prefill pads prompts up to a power-of-two bucket, and every
             # padded position indexes the learned position table — so the
@@ -86,6 +100,13 @@ class InferenceEngine:
             lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p,
             params,
         )
+        if quantization:
+            from .quantization import dequantize_tree, quantize_for_inference
+
+            self.params = quantize_for_inference(self.params, **quantization)
+            self._dequant = dequantize_tree
+        else:
+            self._dequant = lambda p: p
         self.state = StateManager(
             num_blocks=self.config.num_kv_blocks,
             block_size=self.config.kv_block_size,
@@ -108,20 +129,24 @@ class InferenceEngine:
     # -- compiled-step caches -------------------------------------------
     def _prefill_fn(self, tp: int):
         if tp not in self._prefill_fns:
-            cfg, use_kernel = self.cfg, self._use_kernel
+            cfg, use_kernel, deq = self.cfg, self._use_kernel, self._dequant
 
             def step(params, cache, tokens, n_real, table):
-                return M.prefill_step(params, cache, tokens, n_real, table, cfg, use_kernel)
+                return M.prefill_step(
+                    deq(params), cache, tokens, n_real, table, cfg, use_kernel
+                )
 
             self._prefill_fns[tp] = jax.jit(step, donate_argnums=(1,))
         return self._prefill_fns[tp]
 
     def _decode_fn(self, s: int):
         if s not in self._decode_fns:
-            cfg, use_kernel = self.cfg, self._use_kernel
+            cfg, use_kernel, deq = self.cfg, self._use_kernel, self._dequant
 
             def step(params, cache, tokens, tables, ctx):
-                return M.decode_step(params, cache, tokens, tables, ctx, cfg, use_kernel)
+                return M.decode_step(
+                    deq(params), cache, tokens, tables, ctx, cfg, use_kernel
+                )
 
             self._decode_fns[s] = jax.jit(step, donate_argnums=(1,))
         return self._decode_fns[s]
@@ -283,9 +308,12 @@ def init_inference(
     model_config: T.TransformerConfig,
     config: Optional[Dict[str, Any]] = None,
     dtype=jnp.bfloat16,
+    quantization: Optional[Dict[str, Any]] = None,
 ) -> InferenceEngine:
     """Build the inference engine (ref: deepspeed/__init__.py
     init_inference:268 → InferenceEngine; config keys follow
-    InferenceConfig)."""
+    InferenceConfig). quantization={"bits": 8|4, "group_size": N}
+    enables ZeRO-Inference weight-only PTQ."""
     icfg = InferenceConfig(**(config or {}))
-    return InferenceEngine(model_config, params, icfg, dtype)
+    return InferenceEngine(model_config, params, icfg, dtype,
+                           quantization=quantization)

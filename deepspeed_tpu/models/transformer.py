@@ -43,6 +43,18 @@ class TransformerConfig:
     d_ff: Optional[int] = None  # default: 4x (gpt2) or llama 8/3 rounding
     max_seq: int = 2048
     variant: str = "llama"  # "llama" | "gpt2"
+    # "ulysses": seq↔head all-to-all resharding around local attention
+    # (deepspeed/sequence/layer.py); "ring": KV rotation over the 'seq'
+    # ring with online softmax (parallel/ring_attention.py) — better for
+    # very long sequences or heads < seq-parallel degree; "sparse":
+    # block-sparse layouts (ops/sparse_attention.py, ref
+    # ops/sparse_attention/sparsity_config.py) via the sparse_* knobs.
+    attention_impl: str = "ulysses"
+    sparse_block: int = 64
+    sparse_mode: str = "fixed"  # fixed | bigbird | dense
+    sparse_num_local_blocks: int = 4
+    sparse_num_global_blocks: int = 1
+    sparse_num_random_blocks: int = 2
     dropout: float = 0.0
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
@@ -293,13 +305,35 @@ def _attention_block(x, lp, cfg: TransformerConfig, rng=None, positions=None):
     else:
         q, k = _rope(q, k, cfg, positions=positions)
 
-    # Ulysses: re-shard seq→heads around attention; XLA emits the
-    # all-to-all pair (ref: sequence/layer.py single_all_to_all:15).
-    q = _shard(q, DP, None, ("model", "seq"), None)
-    k = _shard(k, DP, None, ("model", "seq"), None)
-    v = _shard(v, DP, None, ("model", "seq"), None)
+    if cfg.attention_impl == "ring":
+        from ..parallel.ring_attention import ring_causal_attention
 
-    out = causal_attention(q, k, v, use_flash=cfg.use_flash)  # [B,S,H,D]
+        q = _shard(q, DP, "seq", "model", None)
+        k = _shard(k, DP, "seq", None, None)
+        v = _shard(v, DP, "seq", None, None)
+        out = ring_causal_attention(q, k, v)  # [B,S,H,D], seq-sharded
+    elif cfg.attention_impl == "sparse":
+        from ..ops.sparse_attention import SparsityConfig, sparse_causal_attention
+
+        scfg = SparsityConfig(
+            block=cfg.sparse_block, mode=cfg.sparse_mode,
+            num_local_blocks=cfg.sparse_num_local_blocks,
+            num_global_blocks=cfg.sparse_num_global_blocks,
+            num_random_blocks=cfg.sparse_num_random_blocks,
+        )
+        if q.shape[2] != k.shape[2]:  # GQA: repeat KV for the oracle path
+            rep = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        out = sparse_causal_attention(q, k, v, scfg)
+    else:
+        # Ulysses: re-shard seq→heads around attention; XLA emits the
+        # all-to-all pair (ref: sequence/layer.py single_all_to_all:15).
+        q = _shard(q, DP, None, ("model", "seq"), None)
+        k = _shard(k, DP, None, ("model", "seq"), None)
+        v = _shard(v, DP, None, ("model", "seq"), None)
+
+        out = causal_attention(q, k, v, use_flash=cfg.use_flash)  # [B,S,H,D]
 
     out = _shard(out, DP, "seq", "model", None)
     out = jnp.einsum("bshd,hde->bse", out, lp["wo"].astype(x.dtype))

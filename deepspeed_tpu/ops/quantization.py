@@ -106,6 +106,32 @@ def dequantize_per_axis(q: jax.Array, scale: jax.Array, axis: int, dtype=jnp.flo
     return (q.astype(jnp.float32) * scale.reshape(bshape)).astype(dtype)
 
 
+def quantize_groupwise(
+    x: jax.Array, group_size: int = 128, bits: int = 8
+) -> Tuple[jax.Array, jax.Array]:
+    """Group-wise symmetric quantization along the last dim: q same shape
+    as x (int8 storage), scales x.shape[:-1] + [n_groups]
+    (ref: inference/quantization/quantization.py group-wise PTQ — the
+    ZeRO-Inference weight-only scheme)."""
+    qmax = INT8_QMAX if bits == 8 else INT4_QMAX
+    last = x.shape[-1]
+    g = group_size if group_size and last % group_size == 0 else last
+    xg = x.astype(jnp.float32).reshape(x.shape[:-1] + (last // g, g))
+    absmax = jnp.max(jnp.abs(xg), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / qmax, 1.0)
+    q = jnp.clip(jnp.round(xg / scale[..., None]), -qmax, qmax)
+    return q.astype(jnp.int8).reshape(x.shape), scale.astype(jnp.float32)
+
+
+def dequantize_groupwise(
+    q: jax.Array, scale: jax.Array, dtype=jnp.float32
+) -> jax.Array:
+    last = q.shape[-1]
+    g = last // scale.shape[-1]
+    xg = q.astype(jnp.float32).reshape(q.shape[:-1] + (scale.shape[-1], g))
+    return (xg * scale[..., None]).reshape(q.shape).astype(dtype)
+
+
 def quantize_dequantize(x: jax.Array, block: int = 2048, bits: int = 8) -> jax.Array:
     """Fake-quant roundtrip (QAT / convergence experiments,
     ref: fake_quantizer.cu)."""

@@ -1,0 +1,125 @@
+"""Block-sparse attention with static sparsity patterns.
+
+TPU-native redesign of the reference sparse attention
+(ref: deepspeed/ops/sparse_attention/ — Triton matmul.py/softmax.py over
+block-sparse layouts; sparsity_config.py FixedSparsityConfig /
+BigBirdSparsityConfig / BSLongformerSparsityConfig build static
+[heads, nb, nb] block layouts; csrc/sparse_attention/utils.cpp). The
+patterns are identical; the kernel strategy differs: each query block
+GATHERS its active key/value blocks (per-row count padded to the max —
+static shapes), then one dense [bq, K*bk] attention per query block runs
+on the MXU. FLOPs scale with the layout density instead of S².
+
+Causality is enforced at two levels: the layout only contains kv-blocks
+at-or-before the query block, and the diagonal block applies the exact
+in-block causal mask.
+"""
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class SparsityConfig:
+    """Static block layout spec (ref: sparse_attention/sparsity_config.py
+    SparsityConfig:~ — num_local_blocks/num_global_blocks etc.)."""
+
+    block: int = 64
+    # fixed: local window + global prefix; longformer: same layout family
+    # (BSLongformerSparsityConfig = sliding window + global tokens);
+    # bigbird: + random earlier blocks; dense: full causal.
+    mode: str = "fixed"
+    num_local_blocks: int = 4       # sliding window (fixed/longformer)
+    num_global_blocks: int = 1      # leading blocks every row attends to
+    num_random_blocks: int = 2      # bigbird random blocks
+    seed: int = 0
+
+    _MODES = ("fixed", "longformer", "bigbird", "dense")
+
+    def __post_init__(self):
+        if self.mode not in self._MODES:
+            raise ValueError(
+                f"unknown sparsity mode '{self.mode}' (expected {self._MODES})"
+            )
+
+    def layout(self, seq_len: int) -> np.ndarray:
+        """[nb, nb] bool, row q-block -> kv-blocks it may attend to
+        (causal: j <= i only)."""
+        assert seq_len % self.block == 0, (seq_len, self.block)
+        nb = seq_len // self.block
+        lay = np.zeros((nb, nb), bool)
+        rng = np.random.default_rng(self.seed)
+        for i in range(nb):
+            if self.mode == "dense":
+                lay[i, : i + 1] = True
+                continue
+            # local sliding window (ref: Fixed/BSLongformer num_*_blocks)
+            lo = max(0, i - self.num_local_blocks + 1)
+            lay[i, lo : i + 1] = True
+            # global prefix blocks
+            g = min(self.num_global_blocks, i + 1)
+            lay[i, :g] = True
+            if self.mode == "bigbird" and i > 0:
+                # random earlier blocks (ref: BigBirdSparsityConfig)
+                k = min(self.num_random_blocks, i)
+                picks = rng.choice(i, size=k, replace=False)
+                lay[i, picks] = True
+        return lay
+
+
+def layout_density(lay: np.ndarray) -> float:
+    causal_total = lay.shape[0] * (lay.shape[0] + 1) / 2
+    return float(lay.sum()) / causal_total
+
+
+def sparse_causal_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, config: SparsityConfig
+) -> jax.Array:
+    """[B, S, H, D] x3 → [B, S, H, D] under the block-sparse layout.
+
+    The jnp oracle path (Triton-kernel analog): gather active kv blocks
+    per query-block row, dense softmax over the gathered span.
+    """
+    B, S, H, D = q.shape
+    bs = config.block
+    lay = config.layout(S)
+    nb = lay.shape[0]
+    kmax = int(lay.sum(axis=1).max())
+
+    # static gather tables: [nb, kmax] kv-block ids (padded with 0 + mask)
+    idx = np.zeros((nb, kmax), np.int32)
+    valid = np.zeros((nb, kmax), bool)
+    for i in range(nb):
+        js = np.nonzero(lay[i])[0]
+        idx[i, : len(js)] = js
+        valid[i, : len(js)] = True
+    idx_j = jnp.asarray(idx)
+    valid_j = jnp.asarray(valid)
+
+    scale = 1.0 / np.sqrt(D)
+    qb = q.reshape(B, nb, bs, H, D)
+    kb = k.reshape(B, nb, bs, H, D)
+    vb = v.reshape(B, nb, bs, H, D)
+
+    def q_block(i, q_i):
+        # q_i: [B, bs, H, D]; gather this row's kv blocks: [B, kmax, bs, H, D]
+        kk = jnp.take(kb, idx_j[i], axis=1)
+        vv = jnp.take(vb, idx_j[i], axis=1)
+        logits = jnp.einsum("bqhd,bkshd->bhqks", q_i, kk) * scale
+        # position mask: token-level causality + padding-block mask
+        q_pos = i * bs + jnp.arange(bs)
+        kv_pos = idx_j[i][:, None] * bs + jnp.arange(bs)[None, :]
+        ok = (kv_pos[None, :, :] <= q_pos[:, None, None]) & valid_j[i][None, :, None]
+        logits = jnp.where(ok[None, None], logits, -jnp.inf)
+        p = jax.nn.softmax(logits.astype(jnp.float32), axis=(-2, -1)).astype(q.dtype)
+        return jnp.einsum("bhqks,bkshd->bqhd", p, vv)
+
+    out = jax.lax.map(
+        lambda args: q_block(args[0], args[1]),
+        (jnp.arange(nb), jnp.moveaxis(qb, 1, 0)),
+    )  # [nb, B, bs, H, D]
+    return jnp.moveaxis(out, 0, 1).reshape(B, S, H, D)

@@ -1,0 +1,117 @@
+"""Ring attention: context parallelism by rotating KV around the seq axis.
+
+The long-context alternative to Ulysses (SURVEY §5: "ring/blockwise
+attention via shard_map collective-permute — noted as extension"; absent
+from the reference snapshot, which only ships Ulysses
+deepspeed/sequence/layer.py). Design follows the blockwise/ring
+attention recipe: queries stay resident on their sequence shard; K/V
+shards rotate around the 'seq' ring with `jax.lax.ppermute`, and each
+hop's partial attention folds into a numerically-stable online softmax
+(the flash-attention accumulator (m, l, acc) — so the full [S, S] score
+matrix never materializes and per-device memory is O(S/n · S/n) per
+hop).
+
+Causality by ring position: a KV shard strictly ahead of the query
+shard contributes nothing (its hop is masked entirely), the diagonal
+hop applies the exact in-shard causal mask, earlier shards attend
+densely. Ulysses moves activations twice per layer (all-to-all) but
+runs LOCAL attention; the ring moves K/V n-1 times but never reshards
+heads — preferable when heads < seq-parallel degree or for very long
+sequences where all-to-all volume dominates.
+"""
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _online_update(m, l, acc, logits, v):
+    """Fold one hop's scores into the running softmax accumulator.
+    m, l: [B,H,Q]; acc: [B,H,Q,D]; logits: [B,H,Q,K]; v: [B,K,H,D]."""
+    m_new = jnp.maximum(m, logits.max(axis=-1))
+    # renormalize previous accumulator to the new max
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(logits - m_new[..., None])
+    l_new = l * corr + p.sum(axis=-1)
+    acc_new = acc * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v)
+    return m_new, l_new, acc_new
+
+
+def ring_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str = "seq"
+) -> jax.Array:
+    """Causal attention over sequence-sharded q/k/v INSIDE a shard_map
+    whose manual axes include `axis_name`.
+
+    q, k, v: [B, S_local, H, D] — this device's sequence shard.
+    Returns [B, S_local, H, D].
+    """
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    B, Sl, H, D = q.shape
+    scale = 1.0 / np.sqrt(D)
+
+    qT = q.transpose(0, 2, 1, 3)  # [B, H, Sl, D]
+    m = jnp.full((B, H, Sl), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, H, Sl), jnp.float32)
+    acc = jnp.zeros((B, H, Sl, D), jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def hop(carry, t):
+        m, l, acc, k_cur, v_cur = carry
+        src = (my - t) % n  # which shard's KV we hold this hop
+        logits = jnp.einsum("bhqd,bkhd->bhqk", qT, k_cur).astype(jnp.float32) * scale
+        q_pos = my * Sl + jnp.arange(Sl)
+        kv_pos = src * Sl + jnp.arange(Sl)
+        mask = kv_pos[None, :] <= q_pos[:, None]  # [Sl, Sl]
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+        hop_live = src <= my  # shards ahead of us contribute nothing
+        m2, l2, acc2 = _online_update(m, l, acc, logits, v_cur.astype(jnp.float32))
+        m, l, acc = jax.tree.map(
+            lambda new, old: jnp.where(hop_live, new, old),
+            (m2, l2, acc2), (m, l, acc),
+        )
+        # rotate KV one step around the ring (ICI neighbour exchange)
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (m, l, acc, k_cur, v_cur), None
+
+    (m, l, acc, _, _), _ = jax.lax.scan(
+        hop, (m, l, acc, k, v), jnp.arange(n)
+    )
+    out = (acc / l[..., None]).transpose(0, 2, 1, 3)  # [B, Sl, H, D]
+    return out.astype(q.dtype)
+
+
+def ring_causal_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, mesh=None
+) -> jax.Array:
+    """SPMD entry: q/k/v [B, S, H, D] sequence-sharded over 'seq'; runs
+    ring_attention under shard_map with every other axis auto."""
+    if mesh is None:
+        mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty or mesh.shape.get("seq", 1) <= 1:
+        # no ring: plain causal attention
+        from ..ops.attention import causal_attention
+
+        return causal_attention(q, k, v, use_flash=False)
+    n_rep = q.shape[2] // k.shape[2]
+    if n_rep > 1:  # GQA: materialize repeated KV (kernel-grade GQA later)
+        k = jnp.repeat(k, n_rep, axis=2)
+        v = jnp.repeat(v, n_rep, axis=2)
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, "seq", None, None)
+    fn = jax.shard_map(
+        partial(ring_attention, axis_name="seq"),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={"seq"},
+        check_vma=False,
+    )
+    return fn(q, k, v)

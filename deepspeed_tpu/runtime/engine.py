@@ -209,6 +209,26 @@ class DeepSpeedTPUEngine:
             if zcfg.zero_quantized_weights
             else None
         )
+        # compression training (ref: compression/compress.py:100
+        # init_compression — here a param transform composed into the loss)
+        if config.compression_training:
+            from ..compression import build_compression
+
+            if config.optimizer.type.lower().replace("_", "") == "onebitadam":
+                raise NotImplementedError(
+                    "compression_training with 1-bit Adam is not supported"
+                )
+            if zcfg.zero_quantized_gradients:
+                # the qgZ worker-gradient path bypasses the compression
+                # transform — refuse rather than silently train uncompressed
+                raise NotImplementedError(
+                    "compression_training with zero_quantized_gradients is "
+                    "not supported"
+                )
+            self._compression = build_compression(config.compression_training)
+        else:
+            self._compression = None
+
         # ZeRO++ qgZ: per-worker grads reduced through the int8 two-hop
         # quantized exchange (ref: coalesced_collectives.py:31).
         self._qgz = zcfg.zero_quantized_gradients
@@ -457,7 +477,7 @@ class DeepSpeedTPUEngine:
         return loss_fn
 
     def _make_accumulator(self):
-        """(master_f32, batch, base_rng, scale) -> (mean grads, mean loss).
+        """(master_f32, batch, base_rng, scale, step) -> (mean grads, loss).
 
         The shared gradient path: GAS micro-scan with ZeRO grad-layout
         constraints (or one pipelined whole-batch call). Used by the
@@ -471,11 +491,12 @@ class DeepSpeedTPUEngine:
         has_aux = self.has_aux
         pipelined = self.pipelined
         qwz_apply = self._qwz_apply
+        compression = self._compression
 
         if self._qgz:
             worker_acc = self._make_worker_accumulator()
 
-            def accumulate_qgz(master, batch, base_rng, scale):
+            def accumulate_qgz(master, batch, base_rng, scale, step):
                 from ..comm.compressed import quantized_mean_tree
 
                 wgrads, losses = worker_acc(master, batch, base_rng)
@@ -487,11 +508,13 @@ class DeepSpeedTPUEngine:
 
             return accumulate_qgz
 
-        def accumulate(master, batch, base_rng, scale):
+        def accumulate(master, batch, base_rng, scale, step):
             def to_model_params(m):
                 p = cast_params(m, compute_dtype)
                 if qwz_apply is not None:
                     p = qwz_apply(p)
+                if compression is not None:
+                    p = compression(p, step)
                 return p
 
             if pipelined:
@@ -565,7 +588,7 @@ class DeepSpeedTPUEngine:
             scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
             base_rng = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
 
-            grads, loss = accumulate(master, batch, base_rng, scale)
+            grads, loss = accumulate(master, batch, base_rng, scale, state.step)
 
             grad_norm = global_grad_norm(grads)
             if fp16:
@@ -729,7 +752,7 @@ class DeepSpeedTPUEngine:
         def grad_fn(params, step, batch):
             master = cast_params(params, jnp.float32)
             base_rng = jax.random.fold_in(jax.random.PRNGKey(seed), step)
-            grads, loss = accumulate(master, batch, base_rng, jnp.float32(1.0))
+            grads, loss = accumulate(master, batch, base_rng, jnp.float32(1.0), step)
             return grads, loss, global_grad_norm(grads)
 
         return jax.jit(grad_fn)

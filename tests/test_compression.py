@@ -1,0 +1,147 @@
+"""Compression training tests (QAT + pruning).
+
+Ref model: tests/unit/compression — the reference checks substituted
+layers quantize/prune; here the invariants are on the param transform
+and end-to-end training.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.compression import build_compression, clean_compressed_params
+from deepspeed_tpu.models import transformer as T
+
+VOCAB = 128
+
+
+def model_cfg():
+    return T.TransformerConfig(vocab_size=VOCAB, n_layers=2, n_heads=4,
+                               d_model=64, max_seq=32, variant="llama",
+                               use_flash=False)
+
+
+QAT_CFG = {
+    "weight_quantization": {
+        "shared_parameters": {"schedule_offset": 0},
+        "different_groups": {
+            "wq1": {"params": {"target_bits": 8},
+                    "modules": ["layers/w_*", "layers/wq", "layers/wk",
+                                "layers/wv", "layers/wo"]},
+        },
+    },
+}
+
+SPARSE_CFG = {
+    "sparse_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 2,
+                              "method": "l1"},
+        "different_groups": {
+            "sp1": {"params": {"dense_ratio": 0.5},
+                    "modules": ["layers/w_in", "layers/w_out"]},
+        },
+    },
+}
+
+
+class TestTransforms:
+    def test_qat_quantizes_forward_values(self):
+        apply = build_compression(QAT_CFG)
+        params = T.init(model_cfg(), jax.random.PRNGKey(0))
+        out = apply(params, jnp.int32(5))
+        w = np.asarray(out["layers"]["w_in"])
+        orig = np.asarray(params["layers"]["w_in"])
+        assert not np.array_equal(w, orig)
+        # 8-bit symmetric: at most 255 distinct values per layer slice
+        assert len(np.unique(w[0])) <= 255
+        # embed not matched → untouched
+        np.testing.assert_array_equal(np.asarray(out["embed"]),
+                                      np.asarray(params["embed"]))
+
+    def test_qat_gradient_is_straight_through(self):
+        apply = build_compression(QAT_CFG)
+        params = T.init(model_cfg(), jax.random.PRNGKey(0))
+        g = jax.grad(lambda p: jnp.sum(apply(p, jnp.int32(5))["layers"]["w_in"]))(params)
+        np.testing.assert_allclose(np.asarray(g["layers"]["w_in"]), 1.0)
+
+    def test_sparse_pruning_after_offset(self):
+        apply = build_compression(SPARSE_CFG)
+        params = T.init(model_cfg(), jax.random.PRNGKey(0))
+        before = apply(params, jnp.int32(1))  # offset=2: inactive
+        np.testing.assert_array_equal(np.asarray(before["layers"]["w_in"]),
+                                      np.asarray(params["layers"]["w_in"]))
+        after = np.asarray(apply(params, jnp.int32(2))["layers"]["w_in"])
+        sparsity = (after == 0).mean()
+        assert 0.4 < sparsity < 0.6  # dense_ratio 0.5
+
+    def test_row_and_head_pruning(self):
+        cfgs = {
+            "row_pruning": {"shared_parameters": {"enabled": True,
+                                                  "schedule_offset": 0},
+                            "different_groups": {
+                                "r": {"params": {"dense_ratio": 0.75},
+                                      "modules": ["layers/w_in"]}}},
+            "head_pruning": {"shared_parameters": {"enabled": True,
+                                                   "schedule_offset": 0},
+                             "different_groups": {
+                                 "h": {"params": {"dense_ratio": 0.5},
+                                       "modules": ["layers/wo"]}}},
+        }
+        apply = build_compression(cfgs)
+        params = T.init(model_cfg(), jax.random.PRNGKey(0))
+        out = apply(params, jnp.int32(0))
+        w_in = np.asarray(out["layers"]["w_in"])  # [L, E, F]
+        zero_cols = (np.abs(w_in[0]).sum(axis=0) == 0).mean()
+        assert 0.2 <= zero_cols <= 0.3  # 25% of output rows pruned
+        wo = np.asarray(out["layers"]["wo"])  # [L, H, D, E]
+        dead_heads = (np.abs(wo[0]).sum(axis=(1, 2)) == 0).sum()
+        assert dead_heads == 2  # half of 4 heads
+
+    def test_activation_quant_raises(self):
+        with pytest.raises(NotImplementedError, match="activation"):
+            build_compression({"activation_quantization": {
+                "different_groups": {"a": {}}}})
+
+    def test_clean_exports_numpy(self):
+        params = T.init(model_cfg(), jax.random.PRNGKey(0))
+        out = clean_compressed_params(params, SPARSE_CFG)
+        w = out["layers"]["w_in"]
+        assert isinstance(w, np.ndarray)
+        assert (w == 0).mean() > 0.4
+
+
+class TestCompressionTraining:
+    def test_qat_engine_trains(self):
+        mcfg = model_cfg()
+        engine = ds.initialize(
+            {"train_micro_batch_size_per_gpu": 2,
+             "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+             "compression_training": QAT_CFG,
+             "steps_per_print": 1000},
+            loss_fn=T.make_loss_fn(mcfg),
+            param_init_fn=lambda k: T.init(mcfg, k),
+            param_logical_specs=T.logical_specs(mcfg))
+        r = np.random.default_rng(0)
+        batch = {"tokens": r.integers(0, VOCAB, (16, 33)).astype(np.int32)}
+        ls = [engine.train_batch(batch)["loss"] for _ in range(6)]
+        assert ls[-1] < ls[0]
+
+    def test_pruning_schedule_kicks_in(self):
+        mcfg = model_cfg()
+        engine = ds.initialize(
+            {"train_micro_batch_size_per_gpu": 2,
+             "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+             "compression_training": SPARSE_CFG,
+             "steps_per_print": 1000},
+            loss_fn=T.make_loss_fn(mcfg),
+            param_init_fn=lambda k: T.init(mcfg, k),
+            param_logical_specs=T.logical_specs(mcfg))
+        r = np.random.default_rng(0)
+        batch = {"tokens": r.integers(0, VOCAB, (16, 33)).astype(np.int32)}
+        for _ in range(4):
+            assert np.isfinite(engine.train_batch(batch)["loss"])
+        cleaned = clean_compressed_params(
+            jax.device_get(engine.state.params), SPARSE_CFG)
+        assert (np.asarray(cleaned["layers"]["w_in"]) == 0).mean() > 0.4

@@ -138,7 +138,7 @@ def test_unimplemented_knobs_raise():
         {"checkpoint": {"load_universal": True}},
         {"prescale_gradients": True},
         {"sparse_attention": {"mode": "fixed"}},
-        {"compression_training": {"weight_quantization": {}}},
+        {"data_efficiency": {"enabled": True}},
     ):
         with _pytest.raises(NotImplementedError):
             parse_config({**base, **extra})

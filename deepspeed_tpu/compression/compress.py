@@ -53,6 +53,14 @@ def _sparse_mask(w, dense_ratio: float):
     return (jnp.abs(w) >= thresh).astype(w.dtype)
 
 
+def _rank_keep(norms, k: int):
+    """Keep mask dropping exactly the k smallest (rank-based, so ties /
+    all-equal norms — e.g. zero-init weights — prune exactly k, never
+    the whole tensor)."""
+    ranks = jnp.argsort(jnp.argsort(norms, axis=-1), axis=-1)
+    return ranks >= k
+
+
 def _row_mask(w, dense_ratio: float):
     """Zero the lowest-norm output features (last dim), decided PER
     LEADING INDEX — a scanned [L, E, F] stack prunes each layer
@@ -65,8 +73,7 @@ def _row_mask(w, dense_ratio: float):
     k = max(int(C * (1.0 - dense_ratio)), 0)
     if k == 0:
         return jnp.ones_like(w)
-    thresh = jnp.sort(norms, axis=-1)[..., k - 1 : k]
-    keep = (norms > thresh).astype(w.dtype)  # [..., C]
+    keep = _rank_keep(norms, k).astype(w.dtype)  # [..., C]
     return jnp.broadcast_to(keep[..., None, :], w.shape)
 
 
@@ -84,8 +91,7 @@ def _head_mask(w, dense_ratio: float):
     k = max(int(H * (1.0 - dense_ratio)), 0)
     if k == 0:
         return jnp.ones_like(w)
-    thresh = jnp.sort(norms, axis=-1)[..., k - 1]
-    keep = (norms > thresh[..., None]).astype(w.dtype)
+    keep = _rank_keep(norms, k).astype(w.dtype)
     return keep[..., None, None]
 
 
@@ -95,6 +101,8 @@ def init_compression(config: Dict[str, Any]):
     — there it rewires modules; here it compiles a rule table)."""
     rules: List[Tuple[str, Tuple[str, ...], Dict[str, Any]]] = []
     wq = config.get("weight_quantization") or {}
+    if wq.get("shared_parameters", {}).get("enabled", True) is False:
+        wq = {}  # explicitly disabled: groups present or not, no-op
     for gname, group in (wq.get("different_groups") or {}).items():
         params = group.get("params", {})
         bits = int(params.get("target_bits", params.get("bits", 8)))
@@ -114,6 +122,8 @@ def init_compression(config: Dict[str, Any]):
                       ("head", "head_pruning")):
         block = config.get(key) or {}
         shared = block.get("shared_parameters", block)
+        if shared.get("enabled", True) is False:
+            continue  # explicitly disabled overrides any groups
         groups = block.get("different_groups") or {}
         entries = (
             [(g.get("params", {}), tuple(g.get("modules", ["*"])))

@@ -68,19 +68,37 @@ def quantize_for_inference(
     Linear/Embedding coverage)."""
     if bits not in (4, 8):
         raise ValueError(f"bits must be 4 or 8, got {bits}")
+    from ..utils.logging import logger
 
-    def leaf(p):
+    skipped, widened = [], []
+
+    def leaf_with_path(path, p):
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
         if not (hasattr(p, "dtype") and jnp.issubdtype(p.dtype, jnp.floating)
                 and p.ndim >= min_ndim):
             return p
         if bits == 4 and p.shape[-1] % 2:
-            return p  # int4 packing needs an even last dim
+            skipped.append(name)  # int4 packing needs an even last dim
+            return p
+        if group_size and p.shape[-1] % group_size:
+            widened.append(name)  # falls back to one scale per row
         q, s = quantize_groupwise(p, group_size, bits)
         if bits == 4:
             q = pack_int4(q)
         return QuantizedWeight(q=q, scale=s, bits=bits, dtype_name=str(p.dtype))
 
-    return jax.tree.map(leaf, params)
+    out = jax.tree_util.tree_map_with_path(leaf_with_path, params)
+    if skipped:
+        logger.warning(
+            f"int4 PTQ left {len(skipped)} odd-last-dim leaves full precision "
+            f"(resident memory larger than 4x-reduced): {skipped[:5]}..."
+        )
+    if widened:
+        logger.warning(
+            f"PTQ group_size {group_size} does not divide the last dim of "
+            f"{len(widened)} leaves; using one scale per row there: {widened[:5]}"
+        )
+    return out
 
 
 def dequantize_tree(params: Any) -> Any:

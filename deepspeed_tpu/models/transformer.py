@@ -79,6 +79,15 @@ class TransformerConfig:
     # and are re-inserted in order. None disables.
     random_ltd_layer_range: Optional[Tuple[int, int]] = None
 
+    def __post_init__(self):
+        if self.attention_impl not in ("ulysses", "ring", "sparse"):
+            raise ValueError(
+                f"unknown attention_impl '{self.attention_impl}' "
+                "(expected ulysses|ring|sparse)"
+            )
+        if self.variant not in ("llama", "gpt2"):
+            raise ValueError(f"unknown variant '{self.variant}'")
+
     @property
     def kv_heads(self) -> int:
         return self.n_kv_heads or self.n_heads
@@ -311,7 +320,7 @@ def _attention_block(x, lp, cfg: TransformerConfig, rng=None, positions=None):
         q = _shard(q, DP, "seq", "model", None)
         k = _shard(k, DP, "seq", None, None)
         v = _shard(v, DP, "seq", None, None)
-        out = ring_causal_attention(q, k, v)  # [B,S,H,D], seq-sharded
+        out = ring_causal_attention(q, k, v, use_flash=cfg.use_flash)
     elif cfg.attention_impl == "sparse":
         from ..ops.sparse_attention import SparsityConfig, sparse_causal_attention
 

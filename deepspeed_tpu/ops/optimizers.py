@@ -264,6 +264,60 @@ class OnebitAdam:
                             "error_w": ew, "error_s": es}
 
 
+class OnebitLamb(OnebitAdam):
+    """1-bit LAMB (ref: runtime/fp16/onebit/lamb.py OnebitLamb) — the
+    momentum exchange is the same error-feedback 1-bit collective as
+    1-bit Adam; the update applies LAMB's layerwise trust ratio on top.
+    Where the reference freezes per-chunk scaling coefficients at
+    freeze_step (an artifact of its fused flat buffers), the trust ratio
+    here is recomputed exactly per step from local state — no extra comm
+    either way."""
+
+    name = "onebitlamb"
+
+    def __init__(self, betas=(0.9, 0.999), eps: float = 1e-6,
+                 weight_decay: float = 0.0, freeze_step: int = 100,
+                 max_coeff: float = 10.0, min_coeff: float = 0.01,
+                 dp: int = 1):
+        super().__init__(betas=betas, eps=eps, weight_decay=weight_decay,
+                         freeze_step=freeze_step, dp=dp)
+        self.max_coeff = float(max_coeff)
+        self.min_coeff = float(min_coeff)
+        self._inner = lamb(betas=betas, eps=eps, weight_decay=weight_decay,
+                           max_trust_ratio=max_coeff)
+
+    def compressed_update(self, worker_grads, state, params, lr, step, mesh):
+        from ..comm.compressed import compressed_mean_tree
+
+        b1, b2, eps, wd = self.b1, self.b2, self.eps, self.weight_decay
+        step_f = step.astype(jnp.float32)
+        c1 = 1.0 - b1**step_f
+        c2 = 1.0 - b2 ** jnp.float32(self.freeze_step)  # nu frozen
+
+        m_part = _tmap(
+            lambda mu, gw: b1 * mu[None] + (1.0 - b1) * gw.astype(jnp.float32),
+            state["mu"], worker_grads,
+        )
+        mu_new, ew, es = compressed_mean_tree(
+            m_part, state["error_w"], state["error_s"], mesh
+        )
+
+        def leaf(m, v, p):
+            upd = (m / c1) / (jnp.sqrt(v / c2) + eps) + wd * p
+            w_norm = jnp.linalg.norm(p.reshape(-1))
+            u_norm = jnp.linalg.norm(upd.reshape(-1))
+            trust = jnp.where(
+                (w_norm > 0) & (u_norm > 0),
+                jnp.clip(w_norm / u_norm, self.min_coeff, self.max_coeff),
+                1.0,
+            )
+            return p - lr * trust * upd
+
+        new_params = _tmap(leaf, mu_new, state["nu"], params)
+        return new_params, {"mu": mu_new, "nu": state["nu"],
+                            "error_w": ew, "error_s": es}
+
+
 _REGISTRY: Dict[str, Callable[..., Optimizer]] = {
     "adam": lambda **kw: adam(adam_w_mode=False, **kw),
     "adamw": lambda **kw: adam(adam_w_mode=True, **kw),
@@ -273,6 +327,7 @@ _REGISTRY: Dict[str, Callable[..., Optimizer]] = {
     "adagrad": adagrad,
     "sgd": sgd,
     "onebitadam": OnebitAdam,
+    "onebitlamb": OnebitLamb,
 }
 
 

@@ -88,17 +88,19 @@ def ring_attention(
 
 
 def ring_causal_attention(
-    q: jax.Array, k: jax.Array, v: jax.Array, mesh=None
+    q: jax.Array, k: jax.Array, v: jax.Array, mesh=None, use_flash: bool = False
 ) -> jax.Array:
     """SPMD entry: q/k/v [B, S, H, D] sequence-sharded over 'seq'; runs
-    ring_attention under shard_map with every other axis auto."""
+    ring_attention under shard_map with every other axis auto.
+    use_flash only affects the degenerate no-ring fallback (seq axis
+    absent), which dispatches to the model's configured attention."""
     if mesh is None:
         mesh = jax.sharding.get_abstract_mesh()
     if mesh is None or mesh.empty or mesh.shape.get("seq", 1) <= 1:
-        # no ring: plain causal attention
+        # no ring: plain causal attention (honoring the flash setting)
         from ..ops.attention import causal_attention
 
-        return causal_attention(q, k, v, use_flash=False)
+        return causal_attention(q, k, v, use_flash=use_flash)
     n_rep = q.shape[2] // k.shape[2]
     if n_rep > 1:  # GQA: materialize repeated KV (kernel-grade GQA later)
         k = jnp.repeat(k, n_rep, axis=2)

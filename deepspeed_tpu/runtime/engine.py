@@ -214,9 +214,11 @@ class DeepSpeedTPUEngine:
         if config.compression_training:
             from ..compression import build_compression
 
-            if config.optimizer.type.lower().replace("_", "") == "onebitadam":
+            if config.optimizer.type.lower().replace("_", "") in (
+                "onebitadam", "onebitlamb",
+            ):
                 raise NotImplementedError(
-                    "compression_training with 1-bit Adam is not supported"
+                    "compression_training with 1-bit optimizers is not supported"
                 )
             if zcfg.zero_quantized_gradients:
                 # the qgZ worker-gradient path bypasses the compression
@@ -247,7 +249,9 @@ class DeepSpeedTPUEngine:
         # --- optimizer / schedule / scaler ------------------------------
         opt_block = config.optimizer
         opt_params = dict(opt_block.params)
-        self._onebit = opt_block.type.lower().replace("_", "") == "onebitadam"
+        self._onebit = opt_block.type.lower().replace("_", "") in (
+            "onebitadam", "onebitlamb",
+        )
         if self._onebit:
             # 1-bit Adam needs per-worker partial gradients (params
             # replicated over the data axes) — ref: onebit/adam.py is

@@ -1,0 +1,56 @@
+"""jax.profiler trace capture + named ranges.
+
+The tracing half of the reference's observability stack
+(ref: deepspeed/utils/nvtx.py instrument_w_nvtx + accelerator
+range_push/pop abstract_accelerator.py:189-193; SURVEY §5 'TPU
+equivalent: jax.profiler traces (xplane→tensorboard)'). Traces are
+XPlane protobufs viewable in TensorBoard's profile plugin or Perfetto.
+"""
+
+import contextlib
+import functools
+import os
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(output_dir: str) -> Iterator[None]:
+    """Capture a device+host trace for the enclosed steps
+    (ref: torch.profiler usage; xplane output for tensorboard)."""
+    os.makedirs(output_dir, exist_ok=True)
+    jax.profiler.start_trace(output_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: Optional[str] = None):
+    """Decorator: name a host-side region in the trace
+    (ref: utils/nvtx.py instrument_w_nvtx)."""
+
+    def deco(fn):
+        label = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapped(*a, **kw):
+            with jax.profiler.TraceAnnotation(label):
+                return fn(*a, **kw)
+
+        return wrapped
+
+    return deco
+
+
+def capture_step_trace(engine, batch, output_dir: str, steps: int = 3) -> str:
+    """Profile `steps` engine steps (first call compiles OUTSIDE the
+    trace so the capture shows steady-state execution). Returns the
+    trace directory for `tensorboard --logdir`."""
+    engine.train_batch(batch)  # compile + warmup outside the trace
+    with trace(output_dir):
+        for i in range(steps):
+            with jax.profiler.StepTraceAnnotation("train", step_num=i):
+                engine.train_batch(batch)
+    return output_dir

@@ -69,6 +69,12 @@ def main():
     ds.comm.barrier("post-save")
     assert os.path.exists(os.path.join(ckpt_dir, "latest"))
 
+    # cross-host divergence hash: every controller must hold identical
+    # replicated state (runtime/debug.py; SURVEY §5 sanitizer note)
+    from deepspeed_tpu.runtime.debug import check_cross_host_divergence
+
+    check_cross_host_divergence(engine.state.params)
+
     l2_before = engine.train_batch(batches[2])["loss"]
     tag, _ = engine.load_checkpoint(ckpt_dir)
     l2_after = engine.train_batch(batches[2])["loss"]

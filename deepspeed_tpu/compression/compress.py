@@ -101,8 +101,11 @@ def init_compression(config: Dict[str, Any]):
     — there it rewires modules; here it compiles a rule table)."""
     rules: List[Tuple[str, Tuple[str, ...], Dict[str, Any]]] = []
     wq = config.get("weight_quantization") or {}
-    if wq.get("shared_parameters", {}).get("enabled", True) is False:
-        wq = {}  # explicitly disabled: groups present or not, no-op
+    # reference default: every technique is DISABLED unless
+    # shared_parameters.enabled is true (ref: compression/constants.py
+    # WEIGHT_QUANTIZE_ENABLED_DEFAULT = False etc.)
+    if not wq.get("shared_parameters", {}).get("enabled", False):
+        wq = {}
     for gname, group in (wq.get("different_groups") or {}).items():
         params = group.get("params", {})
         bits = int(params.get("target_bits", params.get("bits", 8)))
@@ -122,14 +125,13 @@ def init_compression(config: Dict[str, Any]):
                       ("head", "head_pruning")):
         block = config.get(key) or {}
         shared = block.get("shared_parameters", block)
-        if shared.get("enabled", True) is False:
-            continue  # explicitly disabled overrides any groups
+        if not shared.get("enabled", False):
+            continue  # reference default: disabled unless explicitly enabled
         groups = block.get("different_groups") or {}
         entries = (
             [(g.get("params", {}), tuple(g.get("modules", ["*"])))
              for g in groups.values()]
-            if groups else
-            ([(shared, ("*",))] if shared.get("enabled", bool(block) and not groups) else [])
+            if groups else [(shared, ("*",))]
         )
         for params, mods in entries:
             if kind == "head" and any(p == "*" for p in mods):

@@ -235,12 +235,20 @@ class OnebitAdam:
         new_params, new_inner = self._inner.update(grads, inner_state, params, lr, step)
         return new_params, {**state, **new_inner}
 
+    def _apply_update(self, m, v, p, lr, c1, c2):
+        """Per-leaf parameter update from the (compressed-averaged)
+        momentum — the only piece 1-bit variants override."""
+        upd = (m / c1) / (jnp.sqrt(v / c2) + self.eps)
+        if self.weight_decay != 0.0:
+            upd = upd + self.weight_decay * p
+        return p - lr * upd
+
     def compressed_update(self, worker_grads, state, params, lr, step, mesh):
         """Compression phase (ref: adam.py:210 — local momentum update then
         compressed_allreduce; exp_avg_sq frozen)."""
         from ..comm.compressed import compressed_mean_tree
 
-        b1, b2, eps, wd = self.b1, self.b2, self.eps, self.weight_decay
+        b1, b2 = self.b1, self.b2
         step_f = step.astype(jnp.float32)
         c1 = 1.0 - b1**step_f
         c2 = 1.0 - b2 ** jnp.float32(self.freeze_step)  # nu frozen here
@@ -252,14 +260,10 @@ class OnebitAdam:
         mu_new, ew, es = compressed_mean_tree(
             m_part, state["error_w"], state["error_s"], mesh
         )
-
-        def leaf(m, v, p):
-            upd = (m / c1) / (jnp.sqrt(v / c2) + eps)
-            if wd != 0.0:
-                upd = upd + wd * p
-            return p - lr * upd
-
-        new_params = _tmap(leaf, mu_new, state["nu"], params)
+        new_params = _tmap(
+            lambda m, v, p: self._apply_update(m, v, p, lr, c1, c2),
+            mu_new, state["nu"], params,
+        )
         return new_params, {"mu": mu_new, "nu": state["nu"],
                             "error_w": ew, "error_s": es}
 
@@ -286,36 +290,16 @@ class OnebitLamb(OnebitAdam):
         self._inner = lamb(betas=betas, eps=eps, weight_decay=weight_decay,
                            max_trust_ratio=max_coeff)
 
-    def compressed_update(self, worker_grads, state, params, lr, step, mesh):
-        from ..comm.compressed import compressed_mean_tree
-
-        b1, b2, eps, wd = self.b1, self.b2, self.eps, self.weight_decay
-        step_f = step.astype(jnp.float32)
-        c1 = 1.0 - b1**step_f
-        c2 = 1.0 - b2 ** jnp.float32(self.freeze_step)  # nu frozen
-
-        m_part = _tmap(
-            lambda mu, gw: b1 * mu[None] + (1.0 - b1) * gw.astype(jnp.float32),
-            state["mu"], worker_grads,
+    def _apply_update(self, m, v, p, lr, c1, c2):
+        upd = (m / c1) / (jnp.sqrt(v / c2) + self.eps) + self.weight_decay * p
+        w_norm = jnp.linalg.norm(p.reshape(-1))
+        u_norm = jnp.linalg.norm(upd.reshape(-1))
+        trust = jnp.where(
+            (w_norm > 0) & (u_norm > 0),
+            jnp.clip(w_norm / u_norm, self.min_coeff, self.max_coeff),
+            1.0,
         )
-        mu_new, ew, es = compressed_mean_tree(
-            m_part, state["error_w"], state["error_s"], mesh
-        )
-
-        def leaf(m, v, p):
-            upd = (m / c1) / (jnp.sqrt(v / c2) + eps) + wd * p
-            w_norm = jnp.linalg.norm(p.reshape(-1))
-            u_norm = jnp.linalg.norm(upd.reshape(-1))
-            trust = jnp.where(
-                (w_norm > 0) & (u_norm > 0),
-                jnp.clip(w_norm / u_norm, self.min_coeff, self.max_coeff),
-                1.0,
-            )
-            return p - lr * trust * upd
-
-        new_params = _tmap(leaf, mu_new, state["nu"], params)
-        return new_params, {"mu": mu_new, "nu": state["nu"],
-                            "error_w": ew, "error_s": es}
+        return p - lr * trust * upd
 
 
 _REGISTRY: Dict[str, Callable[..., Optimizer]] = {

@@ -11,7 +11,7 @@ sharding specs and cross-host divergence (hash of params per step)" —
 this is that hash.
 """
 
-from typing import Any, Tuple
+from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -19,36 +19,48 @@ import numpy as np
 
 from ..comm.comm import broadcast_host, get_rank
 
+# jit cache keyed by tree signature — a per-call @jax.jit closure would
+# retrace the whole-model graph on every fingerprint
+_FP_CACHE: Dict[Any, Any] = {}
+
+
+def _fp_fn(tree):
+    """Per-leaf [n_leaves] uint32 position-weighted bit checksums.
+
+    uint32 end-to-end: exact (mod 2^32) regardless of leaf size — a
+    float accumulator would round away low bits on real-sized leaves and
+    miss single-element divergences."""
+    outs = []
+    for leaf in jax.tree.leaves(tree):
+        if not hasattr(leaf, "dtype"):
+            continue
+        bits = (
+            jax.lax.bitcast_convert_type(
+                leaf, {2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}.get(
+                    leaf.dtype.itemsize, jnp.uint32)
+            ).astype(jnp.uint32)
+            if jnp.issubdtype(leaf.dtype, jnp.floating)
+            else leaf.astype(jnp.uint32)
+        )
+        flat = bits.reshape(-1)
+        # position-weighted: a plain bit-sum is invariant to
+        # permutations/sign swaps across elements
+        w = (jnp.arange(flat.size, dtype=jnp.uint32) % 65521) + 1
+        outs.append(jnp.sum(flat * w, dtype=jnp.uint32))
+    return jnp.stack(outs)
+
 
 def params_fingerprint(params: Any) -> np.ndarray:
-    """Deterministic per-leaf fingerprints [n_leaves, 2]: bit-exact
-    (sum of raw bits) + magnitude (f64 sum of |x|)."""
-
-    @jax.jit
-    def fp(tree):
-        outs = []
-        for leaf in jax.tree.leaves(tree):
-            if not hasattr(leaf, "dtype"):
-                continue
-            bits = (
-                jax.lax.bitcast_convert_type(
-                    leaf, {2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}.get(
-                        leaf.dtype.itemsize, jnp.uint32)
-                ).astype(jnp.uint32)
-                if jnp.issubdtype(leaf.dtype, jnp.floating)
-                else leaf.astype(jnp.uint32)
-            )
-            flat = bits.reshape(-1)
-            # position-weighted checksum: a plain bit-sum is invariant to
-            # permutations/sign swaps across elements
-            w = (jnp.arange(flat.size, dtype=jnp.uint32) % 65521) + 1
-            outs.append(jnp.stack([
-                jnp.sum(flat * w, dtype=jnp.uint32).astype(jnp.float32),
-                jnp.sum(jnp.abs(leaf.astype(jnp.float32))),
-            ]))
-        return jnp.stack(outs)
-
-    return np.asarray(jax.device_get(fp(params)), np.float64)
+    """Deterministic per-leaf bit-exact fingerprints [n_leaves] uint32."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    key = (treedef, tuple(
+        (tuple(l.shape), str(getattr(l, "dtype", ""))) for l in leaves
+    ))
+    fn = _FP_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(_fp_fn)
+        _FP_CACHE[key] = fn
+    return np.asarray(jax.device_get(fn(params)), np.uint32)
 
 
 def check_cross_host_divergence(params: Any, name: str = "params") -> None:
@@ -58,7 +70,7 @@ def check_cross_host_divergence(params: Any, name: str = "params") -> None:
     mine = params_fingerprint(params)
     ref = np.asarray(broadcast_host(mine, src=0))
     if not np.array_equal(mine, ref):
-        bad = np.nonzero(~np.isclose(mine, ref).all(axis=1))[0]
+        bad = np.nonzero(mine != ref)[0]
         raise RuntimeError(
             f"cross-host divergence in {name} on rank {get_rank()}: "
             f"{len(bad)} leaves differ (first indices {bad[:8].tolist()})"

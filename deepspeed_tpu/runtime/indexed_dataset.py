@@ -1,0 +1,115 @@
+"""Megatron-format memory-mapped indexed dataset.
+
+TPU-native home for the reference's pretraining data format
+(ref: runtime/data_pipeline/data_sampling/indexed_dataset.py — the
+Megatron-LM `.bin`/`.idx` mmap format: MMIDIDX magic, dtype code,
+per-document sizes + byte pointers + document index). Format-compatible:
+datasets tokenized for Megatron/DeepSpeed load here unchanged, and
+datasets built here load there.
+
+Reading is zero-copy np.memmap — the host-side feed for
+`runtime/dataloader.py` at pretraining scale.
+"""
+
+import os
+import struct
+from typing import List, Optional, Union
+
+import numpy as np
+
+_MAGIC = b"MMIDIDX\x00\x00"
+# dtype codes per the Megatron format
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+           5: np.int64, 6: np.float64, 7: np.float32, 8: np.uint16}
+_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+class MMapIndexedDataset:
+    """Reader (ref: indexed_dataset.py MMapIndexedDataset)."""
+
+    def __init__(self, prefix: str):
+        with open(index_file_path(prefix), "rb") as f:
+            magic = f.read(9)
+            if magic != _MAGIC:
+                raise ValueError(f"bad index magic in {prefix}.idx: {magic!r}")
+            (version,) = struct.unpack("<Q", f.read(8))
+            if version != 1:
+                raise ValueError(f"unsupported index version {version}")
+            (code,) = struct.unpack("<B", f.read(1))
+            self.dtype = np.dtype(_DTYPES[code])
+            (self._len,) = struct.unpack("<Q", f.read(8))
+            (self._doc_count,) = struct.unpack("<Q", f.read(8))
+            offset = f.tell()
+        idx = np.memmap(index_file_path(prefix), mode="r")
+        self._sizes = np.frombuffer(idx, np.int32, self._len, offset)
+        offset += self._sizes.nbytes
+        self._pointers = np.frombuffer(idx, np.int64, self._len, offset)
+        offset += self._pointers.nbytes
+        self._doc_idx = np.frombuffer(idx, np.int64, self._doc_count, offset)
+        self._data = np.memmap(data_file_path(prefix), mode="r")
+
+    def __len__(self) -> int:
+        return self._len
+
+    @property
+    def sizes(self) -> np.ndarray:
+        return self._sizes
+
+    @property
+    def doc_idx(self) -> np.ndarray:
+        return self._doc_idx
+
+    def get(self, i: int, offset: int = 0, length: Optional[int] = None) -> np.ndarray:
+        if length is None:
+            length = int(self._sizes[i]) - offset
+        ptr = int(self._pointers[i]) + offset * self.dtype.itemsize
+        return np.frombuffer(self._data, self.dtype, length, ptr)
+
+    def __getitem__(self, i: Union[int, slice]) -> np.ndarray:
+        if isinstance(i, slice):
+            return [self.get(j) for j in range(*i.indices(len(self)))]
+        return self.get(i)
+
+
+class MMapIndexedDatasetBuilder:
+    """Writer (ref: indexed_dataset.py MMapIndexedDatasetBuilder)."""
+
+    def __init__(self, prefix: str, dtype=np.int32):
+        self.prefix = prefix
+        self.dtype = np.dtype(dtype)
+        if self.dtype not in _CODES:
+            raise ValueError(f"unsupported dtype {dtype}")
+        self._data = open(data_file_path(prefix), "wb")
+        self._sizes: List[int] = []
+        self._doc_idx: List[int] = [0]
+
+    def add_item(self, arr) -> None:
+        arr = np.asarray(arr, dtype=self.dtype)
+        self._data.write(arr.tobytes(order="C"))
+        self._sizes.append(arr.size)
+
+    def end_document(self) -> None:
+        self._doc_idx.append(len(self._sizes))
+
+    def finalize(self) -> None:
+        self._data.close()
+        sizes = np.asarray(self._sizes, np.int32)
+        pointers = np.zeros(len(sizes), np.int64)
+        np.cumsum(sizes[:-1] * self.dtype.itemsize, out=pointers[1:])
+        with open(index_file_path(self.prefix), "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<Q", 1))
+            f.write(struct.pack("<B", _CODES[self.dtype]))
+            f.write(struct.pack("<Q", len(sizes)))
+            f.write(struct.pack("<Q", len(self._doc_idx)))
+            f.write(sizes.tobytes(order="C"))
+            f.write(pointers.tobytes(order="C"))
+            f.write(np.asarray(self._doc_idx, np.int64).tobytes(order="C"))

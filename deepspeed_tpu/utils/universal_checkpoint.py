@@ -1,0 +1,132 @@
+"""Universal checkpoint: cross-topology layout conversion.
+
+TPU-native analog of the reference's universal-checkpoint tooling
+(ref: deepspeed/checkpoint/ds_to_universal.py — extract zero shards :87,
+merge TP slices :156; universal_checkpoint.py load_hp_checkpoint_state
+:12; reshape_meg_2d.py). Most of that machinery is unnecessary here:
+orbax checkpoints store LOGICAL arrays, so mesh-shape/ZeRO-stage/
+precision changes reshard for free on load (tested in
+tests/test_checkpoint.py). The one change that alters the TREE itself is
+the pipeline-parallel degree: pipelined engines store the layer stack
+stage-partitioned [P, L/P, ...] (runtime/pipe.partition_layers). This
+tool rewrites a checkpoint between pipeline degrees — the
+`ds_to_universal` role reduced to its TPU-remaining core.
+
+Usage:
+    python -m deepspeed_tpu.utils.universal_checkpoint \
+        <ckpt_dir> <out_dir> --source-stages 2 --target-stages 1
+"""
+
+import json
+import os
+import shutil
+from typing import Any, Dict, Optional
+
+
+def _reshape_layer_leaf(leaf, source_stages: int, target_stages: int):
+    import numpy as np
+
+    x = np.asarray(leaf)
+    if source_stages > 1:  # [P1, L/P1, ...] → [L, ...]
+        x = x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
+    if target_stages > 1:
+        L = x.shape[0]
+        if L % target_stages:
+            raise ValueError(
+                f"layer count {L} not divisible by target stages {target_stages}"
+            )
+        x = x.reshape((target_stages, L // target_stages) + x.shape[1:])
+    return x
+
+
+def _convert_tree(tree: Any, params_layers_shapes: Dict, source: int, target: int):
+    """Reshape the 'layers' subtree of a params-shaped tree (params,
+    master, or an optimizer moment). Trees whose layer leaves do NOT
+    match the params layout (e.g. 1-bit error buffers) are rejected by
+    the caller's shape check."""
+    if not isinstance(tree, dict) or "layers" not in tree:
+        return tree
+    out = dict(tree)
+    out["layers"] = {
+        k: _reshape_layer_leaf(v, source, target)
+        for k, v in tree["layers"].items()
+    }
+    return out
+
+
+def convert_pipeline_layout(
+    ckpt_dir: str,
+    out_dir: str,
+    source_stages: int,
+    target_stages: int,
+    tag: Optional[str] = None,
+) -> str:
+    """Rewrite <ckpt_dir>/<tag> into <out_dir>/<tag> with the layer stack
+    re-partitioned from source_stages to target_stages (1 = flat)."""
+    import jax
+    import numpy as np
+    import orbax.checkpoint as ocp
+
+    from .zero_to_fp32 import _resolve_tag
+
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    tag = _resolve_tag(ckpt_dir, tag)
+    raw = ocp.Checkpointer(ocp.PyTreeCheckpointHandler()).restore(
+        os.path.join(ckpt_dir, tag, "state")
+    )
+    raw = jax.tree.map(lambda x: np.asarray(x), raw)
+
+    params = raw["params"]
+    layer_shapes = {k: np.asarray(v).shape for k, v in params["layers"].items()}
+
+    def convert_like_params(tree):
+        if tree is None or not isinstance(tree, dict):
+            return tree
+        if "layers" in tree:
+            shapes = {k: np.asarray(v).shape for k, v in tree["layers"].items()}
+            if shapes != layer_shapes:
+                raise ValueError(
+                    "tree has a 'layers' subtree whose shapes do not match "
+                    "params (e.g. 1-bit error buffers) — conversion of such "
+                    "state is not supported; resume with a fresh optimizer "
+                    "or the original pipeline degree"
+                )
+        return _convert_tree(tree, layer_shapes, source_stages, target_stages)
+
+    out = dict(raw)
+    out["params"] = convert_like_params(params)
+    if raw.get("master") is not None:
+        out["master"] = convert_like_params(raw["master"])
+    if raw.get("opt") is not None:
+        out["opt"] = {k: convert_like_params(v) for k, v in raw["opt"].items()}
+
+    os.makedirs(os.path.join(out_dir, tag), exist_ok=True)
+    ocp.Checkpointer(ocp.PyTreeCheckpointHandler()).save(
+        os.path.join(out_dir, tag, "state"), out, force=True
+    )
+    meta_src = os.path.join(ckpt_dir, tag, "meta.json")
+    if os.path.exists(meta_src):
+        shutil.copy(meta_src, os.path.join(out_dir, tag, "meta.json"))
+    with open(os.path.join(out_dir, "latest"), "w") as f:
+        f.write(tag)
+    return os.path.join(out_dir, tag)
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_dir")
+    p.add_argument("--source-stages", type=int, required=True)
+    p.add_argument("--target-stages", type=int, required=True)
+    p.add_argument("--tag", default=None)
+    a = p.parse_args(argv)
+    out = convert_pipeline_layout(
+        a.checkpoint_dir, a.output_dir, a.source_stages, a.target_stages, a.tag
+    )
+    print(f"wrote converted checkpoint to {out}")
+
+
+if __name__ == "__main__":
+    main()

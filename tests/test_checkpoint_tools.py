@@ -140,3 +140,58 @@ class TestTensorFragment:
         engine = build_engine()
         with pytest.raises(ValueError, match="shape"):
             safe_set_full_fp32_param(engine, "embed", np.zeros((2, 2)))
+
+
+class TestUniversalCheckpoint:
+    """Pipeline-degree conversion (the remaining ds_to_universal core)."""
+
+    def test_pipe2_to_flat_resume(self, tmp_path):
+        from deepspeed_tpu.utils.universal_checkpoint import (
+            convert_pipeline_layout,
+        )
+
+        pcfg = T.TransformerConfig(vocab_size=VOCAB, n_layers=4, n_heads=4,
+                                   d_model=64, max_seq=32, variant="llama",
+                                   use_flash=False, pipeline_stages=2)
+        fcfg = T.TransformerConfig(vocab_size=VOCAB, n_layers=4, n_heads=4,
+                                   d_model=64, max_seq=32, variant="llama",
+                                   use_flash=False)
+        common = {"train_batch_size": 16, "gradient_accumulation_steps": 4,
+                  "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                  "seed": 7, "steps_per_print": 1000}
+        pipe = ds.initialize(
+            {**common, "mesh": {"pipe": 2, "data": 4}},
+            loss_fn=T.make_pipelined_loss_fn(pcfg),
+            param_init_fn=lambda k: T.init(pcfg, k),
+            param_logical_specs=T.logical_specs(pcfg),
+            pipelined=True)
+        r = np.random.default_rng(0)
+        batches = [{"tokens": r.integers(0, VOCAB, (16, 33)).astype(np.int32)}
+                   for _ in range(5)]
+        for b in batches[:3]:
+            pipe.train_batch(b)
+        pipe.save_checkpoint(str(tmp_path / "pipe_ckpt"))
+        rest_pipe = [pipe.train_batch(b)["loss"] for b in batches[3:]]
+
+        convert_pipeline_layout(str(tmp_path / "pipe_ckpt"),
+                                str(tmp_path / "flat_ckpt"),
+                                source_stages=2, target_stages=1)
+
+        flat = ds.initialize(
+            {**common, "mesh": {"data": 4, "model": 2}},
+            loss_fn=T.make_loss_fn(fcfg),
+            param_init_fn=lambda k: T.init(fcfg, k),
+            param_logical_specs=T.logical_specs(fcfg))
+        flat.load_checkpoint(str(tmp_path / "flat_ckpt"))
+        rest_flat = [flat.train_batch(b)["loss"] for b in batches[3:]]
+        np.testing.assert_allclose(rest_flat, rest_pipe, rtol=2e-4)
+
+    def test_cli(self, tmp_path, capsys):
+        from deepspeed_tpu.utils.universal_checkpoint import main
+
+        engine = build_engine()
+        engine.train_batch(data())
+        engine.save_checkpoint(str(tmp_path / "c"))
+        main([str(tmp_path / "c"), str(tmp_path / "o"),
+              "--source-stages", "1", "--target-stages", "2"])
+        assert "wrote converted checkpoint" in capsys.readouterr().out

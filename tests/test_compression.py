@@ -25,7 +25,7 @@ def model_cfg():
 
 QAT_CFG = {
     "weight_quantization": {
-        "shared_parameters": {"schedule_offset": 0},
+        "shared_parameters": {"enabled": True, "schedule_offset": 0},
         "different_groups": {
             "wq1": {"params": {"target_bits": 8},
                     "modules": ["layers/w_*", "layers/wq", "layers/wk",

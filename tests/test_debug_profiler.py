@@ -70,12 +70,20 @@ class TestDivergenceHash:
         assert not np.array_equal(params_fingerprint(p2), f1)
 
     def test_bit_exact_not_just_magnitude(self):
-        # |x| identical but signs differ -> magnitudes match, bits differ
+        # |x| identical but signs swapped -> a magnitude hash would pass;
+        # the position-weighted bit checksum must differ
         p = {"a": jnp.asarray([1.0, -2.0, 3.0])}
         q = {"a": jnp.asarray([-1.0, 2.0, 3.0])}
-        fp, fq = params_fingerprint(p), params_fingerprint(q)
-        assert fp[0, 1] == fq[0, 1]
-        assert fp[0, 0] != fq[0, 0]
+        assert not np.array_equal(params_fingerprint(p), params_fingerprint(q))
+
+    def test_fingerprint_compile_cached(self):
+        from deepspeed_tpu.runtime import debug as D
+
+        p = {"a": jnp.arange(8, dtype=jnp.float32)}
+        D._FP_CACHE.clear()
+        params_fingerprint(p)
+        params_fingerprint(jax.tree.map(lambda x: x * 2, p))
+        assert len(D._FP_CACHE) == 1  # same signature -> one compilation
 
     def test_single_process_check_passes(self):
         engine = build_engine()

@@ -426,8 +426,7 @@ _REFERENCE_RENAMES: Dict[str, Dict[str, str]] = {
 # Whole reference config blocks naming features that do not exist yet —
 # presence raises (silent acceptance would be a lie).
 _UNIMPLEMENTED_BLOCKS = (
-    "data_efficiency", "nebula",
-    "hybrid_engine", "zero_quantized_nontrainable_weights",
+    "data_efficiency", "nebula", "zero_quantized_nontrainable_weights",
 )
 
 
@@ -443,6 +442,14 @@ def _compat_filter(config: Dict[str, Any]) -> Dict[str, Any]:
             return bool(block["enabled"])
         return bool(block)
 
+    if "hybrid_engine" in config and _enabled(config.get("hybrid_engine")):
+        raise NotImplementedError(
+            "the hybrid_engine config block has no engine-level consumer; "
+            "wrap the training engine explicitly: "
+            "deepspeed_tpu.runtime.hybrid_engine.HybridEngine(engine, "
+            "model_config, inference_config)"
+        )
+    config.pop("hybrid_engine", None)
     if "sparse_attention" in config and _enabled(config.get("sparse_attention")):
         raise NotImplementedError(
             "the sparse_attention config block has no engine-level consumer "

@@ -96,17 +96,15 @@ class InferenceEngine:
                     f"shorter than the largest prefill bucket ({worst}); "
                     "lower max_seq_len so its bucket fits"
                 )
-        self.params = jax.tree.map(
-            lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p,
-            params,
-        )
+        self._dtype = dtype
+        self._quantization = quantization
         if quantization:
-            from .quantization import dequantize_tree, quantize_for_inference
+            from .quantization import dequantize_tree
 
-            self.params = quantize_for_inference(self.params, **quantization)
             self._dequant = dequantize_tree
         else:
             self._dequant = lambda p: p
+        self.refresh_params(params)
         self.state = StateManager(
             num_blocks=self.config.num_kv_blocks,
             block_size=self.config.kv_block_size,
@@ -125,6 +123,23 @@ class InferenceEngine:
             f"max_batch {self.config.max_batch_size}",
             ranks=[0],
         )
+
+    def refresh_params(self, params: Any) -> None:
+        """(Re)point the served weight tree — the hybrid-engine shared-
+        weights path (ref: runtime/hybrid_engine.py): after training
+        steps, generation serves the updated arrays without copying
+        (the cast is a no-op when training compute dtype == serve dtype;
+        quantized engines re-quantize)."""
+        cast = jax.tree.map(
+            lambda p: p.astype(self._dtype)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p,
+            params,
+        )
+        if self._quantization:
+            from .quantization import quantize_for_inference
+
+            cast = quantize_for_inference(cast, **self._quantization)
+        self.params = cast
 
     # -- compiled-step caches -------------------------------------------
     def _prefill_fn(self, tp: int):

@@ -48,7 +48,9 @@ def evoformer_attention(
         return jnp.moveaxis(out, -3, -2)
 
     if N % chunk_size:
-        raise ValueError(f"N={N} must divide chunk_size={chunk_size}")
+        raise ValueError(
+            f"chunk_size={chunk_size} must divide N={N} (pick a divisor)"
+        )
     n_chunks = N // chunk_size
 
     def chunk_biases(c):

@@ -19,9 +19,6 @@ import numpy as np
 
 from ..comm.comm import broadcast_host, get_rank
 
-# jit cache keyed by tree signature — a per-call @jax.jit closure would
-# retrace the whole-model graph on every fingerprint
-_FP_CACHE: Dict[Any, Any] = {}
 
 
 def _fp_fn(tree):
@@ -50,17 +47,15 @@ def _fp_fn(tree):
     return jnp.stack(outs)
 
 
+# module-level jit: jax's own cache keys on (treedef, shapes, dtypes),
+# so repeated per-step fingerprints compile once — a per-call @jax.jit
+# closure would retrace the whole-model graph every time
+_FP = jax.jit(_fp_fn)
+
+
 def params_fingerprint(params: Any) -> np.ndarray:
     """Deterministic per-leaf bit-exact fingerprints [n_leaves] uint32."""
-    leaves, treedef = jax.tree_util.tree_flatten(params)
-    key = (treedef, tuple(
-        (tuple(l.shape), str(getattr(l, "dtype", ""))) for l in leaves
-    ))
-    fn = _FP_CACHE.get(key)
-    if fn is None:
-        fn = jax.jit(_fp_fn)
-        _FP_CACHE[key] = fn
-    return np.asarray(jax.device_get(fn(params)), np.uint32)
+    return np.asarray(jax.device_get(_FP(params)), np.uint32)
 
 
 def check_cross_host_divergence(params: Any, name: str = "params") -> None:

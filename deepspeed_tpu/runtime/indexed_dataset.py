@@ -102,8 +102,12 @@ class MMapIndexedDatasetBuilder:
     def finalize(self) -> None:
         self._data.close()
         sizes = np.asarray(self._sizes, np.int32)
+        # int64 BEFORE the multiply: a single >2^31-byte document would
+        # wrap an int32 product (ref: indexed_dataset.py _get_pointers
+        # does this arithmetic in int64)
         pointers = np.zeros(len(sizes), np.int64)
-        np.cumsum(sizes[:-1] * self.dtype.itemsize, out=pointers[1:])
+        np.cumsum(sizes[:-1].astype(np.int64) * self.dtype.itemsize,
+                  out=pointers[1:])
         with open(index_file_path(self.prefix), "wb") as f:
             f.write(_MAGIC)
             f.write(struct.pack("<Q", 1))

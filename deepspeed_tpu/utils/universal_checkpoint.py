@@ -39,7 +39,7 @@ def _reshape_layer_leaf(leaf, source_stages: int, target_stages: int):
     return x
 
 
-def _convert_tree(tree: Any, params_layers_shapes: Dict, source: int, target: int):
+def _convert_tree(tree: Any, source: int, target: int):
     """Reshape the 'layers' subtree of a params-shaped tree (params,
     master, or an optimizer moment). Trees whose layer leaves do NOT
     match the params layout (e.g. 1-bit error buffers) are rejected by
@@ -91,7 +91,7 @@ def convert_pipeline_layout(
                     "state is not supported; resume with a fresh optimizer "
                     "or the original pipeline degree"
                 )
-        return _convert_tree(tree, layer_shapes, source_stages, target_stages)
+        return _convert_tree(tree, source_stages, target_stages)
 
     out = dict(raw)
     out["params"] = convert_like_params(params)

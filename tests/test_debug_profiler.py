@@ -80,10 +80,13 @@ class TestDivergenceHash:
         from deepspeed_tpu.runtime import debug as D
 
         p = {"a": jnp.arange(8, dtype=jnp.float32)}
-        D._FP_CACHE.clear()
+        before = D._FP._cache_size()
         params_fingerprint(p)
+        once = D._FP._cache_size()
         params_fingerprint(jax.tree.map(lambda x: x * 2, p))
-        assert len(D._FP_CACHE) == 1  # same signature -> one compilation
+        assert D._FP._cache_size() == once >= before  # same signature: no retrace
+        # scalar/int leaves tolerated (jit promotes; fp skips dtype-less)
+        params_fingerprint({"w": jnp.ones(3), "step": 3})
 
     def test_single_process_check_passes(self):
         engine = build_engine()

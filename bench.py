@@ -132,9 +132,9 @@ def _decode_bench(mcfg, train_engine):
         if mcfg is None:
             return None  # CPU lane: numbers would be meaningless
         params = train_engine.state.params
-        # prompt_len < kv_block_size so the decode write at position
-        # prompt_len lands inside each sequence's own prefill block (the
-        # timing loop reuses one ctx and never extends allocations)
+        # prompt_len + decode_steps < kv_block_size so every decode write
+        # lands inside each sequence's own prefill block (this lane never
+        # extends allocations; asserted below)
         batch, prompt_len, decode_steps = 32, 96, 24
         eng = init_inference(
             params, mcfg,
@@ -147,19 +147,23 @@ def _decode_bench(mcfg, train_engine):
                    for _ in uids]
         eng.put(uids, prompts)  # prefill populates the paged cache
 
-        # Device decode rate: dispatch the compiled decode step N times
-        # asynchronously with ONE trailing readback — the engine's put()
-        # host loop would measure tunnel round trips, not the chip
-        # (same methodology as the training lane above).
-        fn = eng._decode_fn(batch)
+        # Device decode rate via the FUSED multi-token program: one
+        # dispatch per decode_steps tokens (engine.decode_multi_fn), so
+        # per-dispatch latency (~2-5ms through the axon tunnel; real on
+        # the serving path too) doesn't floor the measurement.
+        # decode_multi ADVANCES ctx internally: all written positions
+        # must stay inside the single prefill block.
+        assert prompt_len + 1 + decode_steps <= eng.config.kv_block_size, (
+            "decode writes would spill past the allocated block"
+        )
+        fn = eng.decode_multi_fn(batch, decode_steps)
         tokens = np.zeros((batch,), np.int32)
         tables = eng.state.block_table(uids, eng.config.blocks_per_seq)
         ctx = np.full((batch,), prompt_len + 1, np.int32)
-        logits, eng.cache = fn(eng.params, eng.cache, tokens, tables, ctx)
+        gen, logits, eng.cache = fn(eng.params, eng.cache, tokens, tables, ctx)
         np.asarray(jax.device_get(logits[0, 0]))  # sync warmup
         t0 = time.perf_counter()
-        for _ in range(decode_steps):
-            logits, eng.cache = fn(eng.params, eng.cache, tokens, tables, ctx)
+        gen, logits, eng.cache = fn(eng.params, eng.cache, tokens, tables, ctx)
         np.asarray(jax.device_get(logits[0, 0]))
         dt = time.perf_counter() - t0
         for u in uids:

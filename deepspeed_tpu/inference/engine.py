@@ -166,6 +166,25 @@ class InferenceEngine:
             self._decode_fns[s] = jax.jit(step, donate_argnums=(1,))
         return self._decode_fns[s]
 
+    def decode_multi_fn(self, s: int, n_steps: int):
+        """Compiled fused greedy decode (model.decode_multi) for batch
+        width `s` — the one construction site that applies the engine's
+        dequant wrapper, mirroring _decode_fn."""
+        key = (s, n_steps)
+        if not hasattr(self, "_decode_multi_fns"):
+            self._decode_multi_fns = {}
+        if key not in self._decode_multi_fns:
+            cfg, use_kernel, deq = self.cfg, self._use_kernel, self._dequant
+
+            def step(params, cache, tokens, tables, ctx):
+                return M.decode_multi(
+                    deq(params), cache, tokens, tables, ctx, cfg,
+                    n_steps=n_steps, use_kernel=use_kernel,
+                )
+
+            self._decode_multi_fns[key] = jax.jit(step, donate_argnums=(1,))
+        return self._decode_multi_fns[key]
+
     # -- scheduling queries (ref: engine_v2.py query:158/can_schedule:184)
     def query(self, uid: int) -> Dict[str, int]:
         seq = self.state.get(uid)

@@ -182,6 +182,39 @@ def decode_step(
     return logits.astype(jnp.float32), PagedCache(k=new_k, v=new_v)
 
 
+def decode_multi(
+    params, cache: PagedCache, tokens, tables, ctx_lens,
+    cfg: T.TransformerConfig, n_steps: int, use_kernel: bool = True,
+):
+    """Fused greedy decode: n_steps tokens per compiled program.
+
+    One `lax.scan` over decode_step with the argmax fed back — the
+    host dispatches once per n_steps instead of per token, amortizing
+    dispatch/scheduling latency (the SplitFuse-era "fixed work per
+    forward" idea applied along time). Block tables must already cover
+    ctx_lens + n_steps positions.
+
+    Returns (generated [n_steps, S] int32, final logits [S, V], cache).
+    """
+
+    S = tokens.shape[0]
+    V = cfg.vocab_size
+
+    def body(carry, _):
+        toks, ctx, _, cache = carry
+        logits, cache = decode_step(params, cache, toks, tables, ctx, cfg, use_kernel)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # logits ride the CARRY (overwritten per step): stacking them in ys
+        # would keep a dead [n_steps, S, V] accumulator live in HBM
+        return (nxt, ctx + 1, logits, cache), nxt
+
+    init = (tokens, ctx_lens, jnp.zeros((S, V), jnp.float32), cache)
+    (_, _, last_logits, cache), gen = jax.lax.scan(
+        body, init, None, length=n_steps
+    )
+    return gen, last_logits, cache
+
+
 # ---------------------------------------------------------------------------
 # prefill: one sequence's whole prompt
 # ---------------------------------------------------------------------------

@@ -310,3 +310,34 @@ class TestZeroInferenceQuantization:
         assert np.abs(lq - lf).max() / denom < 0.1
         outs = quant.generate([prompt], max_new_tokens=4)
         assert len(outs[0]) == 4
+
+
+class TestDecodeMulti:
+    def test_fused_matches_stepwise_greedy(self, rng):
+        """decode_multi == argmax-fed loop of decode_step (exact)."""
+        from functools import partial
+
+        from deepspeed_tpu.inference import model as M
+
+        cfg, params = small_model()
+        eng = engine_for(cfg, params)
+        prompt = list(rng.integers(0, 128, 10))
+        eng.put([0], [np.asarray(prompt)])
+        tables = eng.state.block_table([0], eng.config.blocks_per_seq)
+        ctx = np.asarray([11], np.int32)
+        tok = np.asarray([prompt[-1]], np.int32)
+
+        gen, last_logits, _ = M.decode_multi(
+            eng.params, eng.cache, tok, tables, ctx, cfg, n_steps=4,
+            use_kernel=False)
+
+        cache_b = eng.cache
+        t, c = tok, ctx
+        want = []
+        for _ in range(4):
+            logits, cache_b = M.decode_step(
+                eng.params, cache_b, t, tables, c, cfg, use_kernel=False)
+            t = np.argmax(np.asarray(logits), -1).astype(np.int32)
+            c = c + 1
+            want.append(int(t[0]))
+        assert [int(x) for x in np.asarray(gen)[:, 0]] == want

@@ -133,7 +133,21 @@ class ActivationCheckpointingConfig(ConfigModel):
       'dots'          — save MXU dot/matmul outputs only
       'dots_no_batch' — save dot outputs without batch dims
     Models may additionally carry their own finer-grained remat (e.g.
-    per-scanned-layer); the engine wrap composes around it."""
+    per-scanned-layer); the engine wrap composes around it.
+
+    `cpu_checkpointing` (with policy='dots_no_batch') offloads the saved
+    dot outputs to host DRAM instead of keeping them in HBM
+    (jax.checkpoint_policies.offload_dot_with_no_batch_dims — ref:
+    checkpointing.py:989 cpu_checkpointing).
+
+    `partition_activations` is an accepted no-op BY DESIGN: under XLA
+    SPMD the saved residuals are computed and kept in their sharded
+    layout (the model's TP/Ulysses activation constraints), so saved
+    activations are never replicated across model ranks — which is the
+    entire job of the reference's partition_activations
+    (checkpointing.py partition_activations + gather on backward).
+    tests/test_engine.py asserts the per-device remat footprint shrinks
+    with the model axis."""
 
     partition_activations: bool = False
     cpu_checkpointing: bool = False
@@ -292,12 +306,28 @@ class DeepSpeedTPUConfig(ConfigModel):
         (VERDICT r1 W2: 'dead config knobs are silent lies')."""
         z = self.zero_optimization
         unimpl = []
-        if z.offload_param.device != OffloadDevice.none:
-            unimpl.append("zero_optimization.offload_param")
-        if self.activation_checkpointing.partition_activations:
-            unimpl.append("activation_checkpointing.partition_activations")
-        if self.activation_checkpointing.cpu_checkpointing:
-            unimpl.append("activation_checkpointing.cpu_checkpointing")
+        if z.offload_param.device == OffloadDevice.nvme:
+            unimpl.append("zero_optimization.offload_param.device=nvme")
+        elif z.offload_param.device != OffloadDevice.none:
+            # ZeRO-Infinity param tier (host DRAM) is a stage-3 feature,
+            # matching the reference's assertion (zero/config.py offload_param
+            # is consumed only by stage3.py / parameter_offload.py)
+            if z.stage != 3:
+                raise ValueError(
+                    "zero_optimization.offload_param requires zero stage 3"
+                )
+        if (
+            self.activation_checkpointing.cpu_checkpointing
+            and self.activation_checkpointing.policy != "dots_no_batch"
+        ):
+            # the host tier offloads the saved dot outputs — there must BE a
+            # saveable-dots policy to offload (ref: checkpointing.py:989
+            # cpu_checkpointing moves the checkpointed activations to CPU)
+            raise ValueError(
+                "activation_checkpointing.cpu_checkpointing requires "
+                "policy='dots_no_batch' (the saved dot outputs are what "
+                "moves to host DRAM)"
+            )
         if self.checkpoint.load_universal:
             unimpl.append("checkpoint.load_universal")
         if self.checkpoint.use_node_local_storage:

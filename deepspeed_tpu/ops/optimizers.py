@@ -302,6 +302,229 @@ class OnebitLamb(OnebitAdam):
         return p - lr * trust * upd
 
 
+class ZeroOneSchedule:
+    """Host-side replica of 0/1 Adam's deterministic step schedule
+    (ref: runtime/fp16/onebit/zoadam.py var_interval/var_counter/
+    local_step_interval/local_step_counter bookkeeping :175-181,:265-287).
+
+    Both intervals are pure functions of the step count, so the engine
+    keeps this tiny state machine on the host and picks the compiled
+    program per step; on checkpoint load it is replayed from step 0."""
+
+    def __init__(self, var_freeze_step: int, var_update_scaler: int,
+                 local_step_scaler: int, local_step_clipper: int):
+        self.var_freeze_step = int(var_freeze_step)
+        self.var_update_scaler = int(var_update_scaler)
+        self.local_step_scaler = int(local_step_scaler)
+        self.local_step_clipper = int(local_step_clipper)
+        self.var_interval = 1
+        self.var_counter = 0
+        self.local_interval = 1
+        self.local_counter = 0
+
+    def kind(self, step: int) -> str:
+        """Program for 1-indexed global step `step` (call before advance).
+
+        phase 1 (step <= var_freeze_step):
+          'full'   — exact-sync gradient, update mu AND nu
+          'onebit' — 1-bit error-feedback gradient sync, update mu only
+        phase 2 (step > var_freeze_step):
+          'local'  — no communication at all (local step)
+          'sync'   — local step + 1-bit momentum reconciliation
+        """
+        if step <= self.var_freeze_step:
+            return "full" if step % self.var_interval == 0 else "onebit"
+        return "sync" if step % self.local_interval == 0 else "local"
+
+    def advance(self, step: int) -> None:
+        """Post-step interval bookkeeping (exponential growth rules)."""
+        if step <= self.var_freeze_step:
+            if step % self.var_interval == 0:
+                self.var_counter += 1
+                if self.var_counter == self.var_update_scaler:
+                    self.var_counter = 0
+                    self.var_interval *= 2
+        else:
+            self.local_counter += 1
+            if self.local_counter == self.local_step_scaler:
+                self.local_counter = 0
+                self.local_interval = min(self.local_step_clipper,
+                                          self.local_interval * 2)
+
+    def replay(self, n_steps: int) -> None:
+        """Rebuild interval state after loading a step-n checkpoint."""
+        for s in range(1, n_steps + 1):
+            self.advance(s)
+
+
+class ZeroOneAdam:
+    """0/1 Adam (ref: runtime/fp16/onebit/zoadam.py ZeroOneAdam:14,
+    arxiv 2202.06009).
+
+    Adaptive-frequency variance updates + adaptive-frequency 1-bit
+    synchronization. Update rule is the reference's un-bias-corrected
+    `p -= lr * (mu / (sqrt(nu) + eps) + wd*p)`.
+
+    State (engine opt dict; `worker_*`/`error_*` leaves are worker-major,
+    dim 0 sharded over the data axes):
+      mu         [·]     — replicated momentum, authoritative in phase 1
+                           and at sync points (phase-1 updates touch only
+                           this copy — no cross-worker traffic)
+      worker_mu  [dp, ·] — per-worker momentum, authoritative between
+                           phase-2 syncs (tiled from mu at the freeze
+                           transition by the engine)
+      nu         [·]     — variance, frozen after var_freeze_step
+      worker_u   [dp, ·] — accumulated local parameter delta since the
+                           last sync (the paper's `u`; the reference's
+                           momentum_accumulator). TrainState.params hold
+                           the last-SYNCED weights; the live local
+                           weights are params + worker_u[w], applied
+                           inside the shard_map gradient path.
+      worker_lrs [dp]    — sum of lrs since last sync (rows identical)
+      error_w/error_s    — 1-bit error-feedback memories
+    """
+
+    name = "zerooneadam"
+
+    def __init__(self, betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0,
+                 var_freeze_step: int = 100000,
+                 var_update_scaler: int = 16,
+                 local_step_scaler: int = 32678,
+                 local_step_clipper: int = 16,
+                 dp: int = 1):
+        self.b1, self.b2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.var_freeze_step = int(var_freeze_step)
+        self.var_update_scaler = int(var_update_scaler)
+        self.local_step_scaler = int(local_step_scaler)
+        self.local_step_clipper = int(local_step_clipper)
+        self.dp = int(dp)
+
+    def make_schedule(self) -> ZeroOneSchedule:
+        return ZeroOneSchedule(self.var_freeze_step, self.var_update_scaler,
+                               self.local_step_scaler, self.local_step_clipper)
+
+    def init(self, params):
+        from ..comm.compressed import init_error_buffers
+
+        ew, es = init_error_buffers(params, self.dp)
+        wz = _tmap(
+            lambda p: jnp.zeros((self.dp,) + tuple(p.shape), jnp.float32), params
+        )
+        return {
+            "mu": _zeros_like_f32(params),
+            "worker_mu": wz,
+            "nu": _zeros_like_f32(params),
+            "worker_u": jax.tree.map(jnp.zeros_like, wz),
+            "worker_lrs": jnp.zeros((self.dp,), jnp.float32),
+            "error_w": ew,
+            "error_s": es,
+        }
+
+    def _delta(self, mu, nu, p_local, lr):
+        """-lr * (mu/(sqrt(nu)+eps) + wd*p): the parameter increment."""
+        upd = mu / (jnp.sqrt(nu) + self.eps)
+        if self.weight_decay != 0.0:
+            upd = upd + self.weight_decay * p_local
+        return -lr * upd
+
+    def full_update(self, worker_grads, state, params, lr, mesh):
+        """Variance-update step: exact gradient sync, mu AND nu advance
+        (ref: zoadam.py:207-209 var_interval branch)."""
+        from ..parallel import sharding as shd
+        from jax.sharding import PartitionSpec as P
+
+        b1, b2 = self.b1, self.b2
+
+        def leaf(gw, mu, nu, p):
+            g = jnp.mean(gw.astype(jnp.float32), axis=0)
+            g = shd.constraint(g, P(), mesh)  # exact all-reduce mean
+            nu_new = b2 * nu + (1.0 - b2) * jnp.square(g)
+            mu_new = b1 * mu + (1.0 - b1) * g
+            p_new = p + self._delta(mu_new, nu_new, p, lr)
+            return p_new, mu_new, nu_new
+
+        out = _tmap(leaf, worker_grads, state["mu"], state["nu"], params)
+        pick = lambda i: _tmap(lambda o: o[i], out,
+                               is_leaf=lambda x: isinstance(x, tuple))
+        return pick(0), {**state, "mu": pick(1), "nu": pick(2)}
+
+    def onebit_update(self, worker_grads, state, params, lr, mesh):
+        """Non-variance phase-1 step: gradient travels through the 1-bit
+        error-feedback collective; nu frozen (ref: zoadam.py:210-218)."""
+        from ..comm.compressed import compressed_mean_tree
+
+        b1 = self.b1
+        g1, ew, es = compressed_mean_tree(
+            _tmap(lambda g: g.astype(jnp.float32), worker_grads),
+            state["error_w"], state["error_s"], mesh,
+        )
+
+        def leaf(g, mu, nu, p):
+            mu_new = b1 * mu + (1.0 - b1) * g
+            p_new = p + self._delta(mu_new, nu, p, lr)
+            return p_new, mu_new
+
+        out = _tmap(leaf, g1, state["mu"], state["nu"], params)
+        pick = lambda i: _tmap(lambda o: o[i], out,
+                               is_leaf=lambda x: isinstance(x, tuple))
+        return pick(0), {**state, "mu": pick(1),
+                         "error_w": ew, "error_s": es}
+
+    def local_update(self, worker_grads, state, params, lr, mesh):
+        """Phase-2 local step: NO communication — each worker advances its
+        momentum and its local delta u (ref: zoadam.py:221-223,:239-243).
+        params (the last-synced copy) are returned unchanged."""
+        b1 = self.b1
+
+        def leaf(gw, mu, nu, u, p):
+            mu_new = b1 * mu + (1.0 - b1) * gw.astype(jnp.float32)
+            d = self._delta(mu_new, nu[None], p[None] + u, lr)
+            return mu_new, u + d
+
+        out = _tmap(leaf, worker_grads, state["worker_mu"], state["nu"],
+                    state["worker_u"], params)
+        pick = lambda i: _tmap(lambda o: o[i], out,
+                               is_leaf=lambda x: isinstance(x, tuple))
+        return params, {**state, "worker_mu": pick(0), "worker_u": pick(1),
+                        "worker_lrs": state["worker_lrs"] + lr}
+
+    def sync_update(self, worker_grads, state, params, lr, mesh):
+        """Phase-2 sync step: local step, then reconcile — scale u to
+        momentum units, 1-bit average it, rebuild mu from the average and
+        fold the averaged delta into the synced params
+        (ref: zoadam.py:245-260)."""
+        from ..comm.compressed import compressed_mean_tree
+
+        params, state = self.local_update(worker_grads, state, params, lr, mesh)
+        lrs = jnp.max(state["worker_lrs"])  # rows identical; max is comm-cheap
+
+        u_scaled = _tmap(
+            lambda u, nu: u * (jnp.sqrt(nu)[None] + self.eps),
+            state["worker_u"], state["nu"],
+        )
+        u_avg, ew, es = compressed_mean_tree(
+            u_scaled, state["error_w"], state["error_s"], mesh
+        )
+
+        def leaf(ua, nu, u, p):
+            p_new = p + ua / (jnp.sqrt(nu) + self.eps)
+            mu_new = -ua / lrs
+            wmu_new = jnp.broadcast_to(mu_new[None], u.shape)
+            return p_new, mu_new, wmu_new
+
+        out = _tmap(leaf, u_avg, state["nu"], state["worker_u"], params)
+        pick = lambda i: _tmap(lambda o: o[i], out,
+                               is_leaf=lambda x: isinstance(x, tuple))
+        zeros_u = _tmap(jnp.zeros_like, state["worker_u"])
+        return pick(0), {**state, "mu": pick(1), "worker_mu": pick(2),
+                         "worker_u": zeros_u,
+                         "worker_lrs": jnp.zeros_like(state["worker_lrs"]),
+                         "error_w": ew, "error_s": es}
+
+
 _REGISTRY: Dict[str, Callable[..., Optimizer]] = {
     "adam": lambda **kw: adam(adam_w_mode=False, **kw),
     "adamw": lambda **kw: adam(adam_w_mode=True, **kw),
@@ -312,6 +535,8 @@ _REGISTRY: Dict[str, Callable[..., Optimizer]] = {
     "sgd": sgd,
     "onebitadam": OnebitAdam,
     "onebitlamb": OnebitLamb,
+    "zerooneadam": ZeroOneAdam,
+    "zoadam": ZeroOneAdam,
 }
 
 

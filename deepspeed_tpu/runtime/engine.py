@@ -176,6 +176,15 @@ class DeepSpeedTPUEngine:
         off_device = config.zero_optimization.offload_optimizer.device
         self._offload = off_device in ("cpu", "nvme")
         self._offload_nvme = off_device == "nvme"
+        # ZeRO-Infinity param tier: compute-dtype params parked in host DRAM
+        # between steps (memory_kind='pinned_host') and streamed into HBM
+        # inside the compiled step — XLA's latency-hiding scheduler overlaps
+        # the H2D fetch with compute (ref: runtime/zero/
+        # partitioned_param_coordinator.py fetch/release + aio param swap;
+        # config gate guarantees stage 3).
+        self._offload_param = (
+            config.zero_optimization.offload_param.device == "cpu"
+        )
         if self._offload:
             if config.fp16.enabled:
                 raise NotImplementedError(
@@ -249,10 +258,12 @@ class DeepSpeedTPUEngine:
         # --- optimizer / schedule / scaler ------------------------------
         opt_block = config.optimizer
         opt_params = dict(opt_block.params)
-        self._onebit = opt_block.type.lower().replace("_", "") in (
-            "onebitadam", "onebitlamb",
-        )
-        if self._onebit:
+        opt_key = opt_block.type.lower().replace("_", "")
+        self._onebit = opt_key in ("onebitadam", "onebitlamb")
+        # 0/1 Adam shares the worker-partial-gradient machinery and all of
+        # the 1-bit composition restrictions (ref: onebit/zoadam.py).
+        self._zoadam = opt_key in ("zerooneadam", "zoadam")
+        if self._onebit or self._zoadam:
             # 1-bit Adam needs per-worker partial gradients (params
             # replicated over the data axes) — ref: onebit/adam.py is
             # likewise an FP16_Optimizer-path feature, not a ZeRO one.
@@ -282,6 +293,11 @@ class DeepSpeedTPUEngine:
                 self.mesh.shape["data"] * self.mesh.shape["zero"]
             )
         self.optimizer: Optimizer = build_optimizer(opt_block.type, opt_params)
+        if self._zoadam:
+            # host-side replica of the deterministic 0/1 Adam schedule
+            self._zo_sched = self.optimizer.make_schedule()
+            self._zo_programs: Dict[str, Any] = {}
+            self._zo_transitioned = False
         base_lr = float(opt_block.params.get("lr", 1e-3))
         self.lr_schedule = build_schedule(
             config.scheduler.type, config.scheduler.params, base_lr=base_lr
@@ -360,6 +376,48 @@ class DeepSpeedTPUEngine:
             self.curriculum = None
 
     # ------------------------------------------------------------------
+    # param storage tier helpers (ZeRO-Infinity offload_param)
+    # ------------------------------------------------------------------
+    def _param_storage_sharding(self, spec) -> NamedSharding:
+        """Where state.params live between steps: HBM, or host DRAM when
+        offload_param is on (same PartitionSpec either way — the host tier
+        is still sharded per-process on multihost)."""
+        s = NamedSharding(self.mesh, spec)
+        return s.with_memory_kind("pinned_host") if self._offload_param else s
+
+    def _make_param_fetch(self):
+        """Returns an inside-jit H2D fetch of the host-parked param tree
+        (identity when params already live in HBM)."""
+        if not self._offload_param:
+            return lambda params: params
+        mesh, specs = self.mesh, self.param_specs
+
+        def fetch(params):
+            return jax.tree.map(
+                lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+                params,
+                specs,
+            )
+
+        return fetch
+
+    def _park_params(self, state: TrainState) -> TrainState:
+        """D2H park of updated params back into the host tier, OUTSIDE the
+        compiled step (the XLA SPMD partitioner rejects device→pinned_host
+        placement annotations in-program; the transfer still overlaps the
+        next step's dispatch via JAX async dispatch)."""
+        if not self._offload_param:
+            return state
+        return dataclasses.replace(
+            state,
+            params=jax.tree.map(
+                lambda p, s: jax.device_put(p, self._param_storage_sharding(s)),
+                state.params,
+                self.param_specs,
+            ),
+        )
+
+    # ------------------------------------------------------------------
     # state construction ("zero.Init" analog, functional:
     # ref: partition_parameters.py Init:780 — here params are placed
     # sharded by jit out_shardings instead of patched __init__s)
@@ -394,8 +452,8 @@ class DeepSpeedTPUEngine:
         opt_struct = jax.eval_shape(lambda p: self.optimizer.init(p), abstract_params)
         opt_shardings = {}
         for k in opt_struct.keys():
-            if k.startswith("error_"):
-                # 1-bit error memories are worker-major [dp, ·] leaves
+            if k.startswith(("error_", "worker_")):
+                # 1-bit/0-1 worker-major leaves: dim 0 over the data axes
                 opt_shardings[k] = jax.tree.map(
                     lambda _: NamedSharding(mesh, P(("data", "zero"))),
                     opt_struct[k],
@@ -419,7 +477,11 @@ class DeepSpeedTPUEngine:
         )
         arg = init_rng if param_init_fn is not None else params
         with jax.transfer_guard("allow"), jax.sharding.set_mesh(mesh):
-            return jax.jit(make, out_shardings=out_shardings)(arg)
+            state = jax.jit(make, out_shardings=out_shardings)(arg)
+        # park the freshly initialized params in the host tier (no-op
+        # unless offload_param; steady-state parking happens the same way
+        # after every compiled step — see _park_params)
+        return self._park_params(state)
 
     def _init_state_offload(self, params, param_init_fn, init_rng) -> TrainState:
         """Offload init runs ON the host: the fp32 master materializes in
@@ -442,7 +504,7 @@ class DeepSpeedTPUEngine:
             lambda m: cast_params(m, self.compute_dtype)
         )(master_host)
         params_dev = jax.tree.map(
-            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            lambda x, s: jax.device_put(x, self._param_storage_sharding(s)),
             stored_host,
             self.param_specs,
         )
@@ -470,13 +532,21 @@ class DeepSpeedTPUEngine:
         gradient path: fused, offload, and the per-worker (qgZ/1-bit)
         accumulators."""
         loss_fn = self.loss_fn
-        policy_name = self.config.activation_checkpointing.policy
-        if policy_name != "none":
-            remat_policy = {
-                "full": None,
-                "dots": jax.checkpoint_policies.checkpoint_dots,
-                "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            }[policy_name]
+        ac = self.config.activation_checkpointing
+        if ac.policy != "none":
+            if ac.cpu_checkpointing:
+                # saved dot outputs live in host DRAM between fwd and bwd
+                # (ref: checkpointing.py cpu_checkpointing; config gate
+                # guarantees policy='dots_no_batch')
+                remat_policy = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+                    "device", "pinned_host"
+                )
+            else:
+                remat_policy = {
+                    "full": None,
+                    "dots": jax.checkpoint_policies.checkpoint_dots,
+                    "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                }[ac.policy]
             loss_fn = jax.checkpoint(loss_fn, policy=remat_policy, static_argnums=())
         return loss_fn
 
@@ -586,9 +656,14 @@ class DeepSpeedTPUEngine:
         clip = cfg.gradient_clipping
         seed = self._rng_seed
         accumulate = self._make_accumulator()
+        fetch_params = self._make_param_fetch()
 
         def step_fn(state: TrainState, batch):
-            master = state.master if use_master else cast_params(state.params, jnp.float32)
+            master = (
+                state.master
+                if use_master
+                else cast_params(fetch_params(state.params), jnp.float32)
+            )
             scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
             base_rng = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
 
@@ -644,8 +719,9 @@ class DeepSpeedTPUEngine:
 
         return jax.jit(step_fn, donate_argnums=(0,))
 
-    def _make_worker_accumulator(self):
-        """(master, batch, base_rng) -> (worker grads [dp, ·], mean loss).
+    def _make_worker_accumulator(self, with_delta: bool = False):
+        """(master[, worker_delta], batch, base_rng) ->
+        (worker grads [dp, ·], mean loss).
 
         The per-worker partial-gradient path: shard_map maps over the
         data axes only (model/seq stay auto, so TP/Ulysses constraints
@@ -653,7 +729,11 @@ class DeepSpeedTPUEngine:
         its local batch shard WITHOUT any cross-worker reduction — the
         reduction is the caller's (compressed) job.
         (ref: the implicit per-rank grads of torch DDP that
-        runtime/comm/nccl.py compressed_allreduce consumes)."""
+        runtime/comm/nccl.py compressed_allreduce consumes).
+
+        with_delta: the loss is evaluated at `master + worker_delta[w]`
+        — the 0/1 Adam local-step view, where TrainState.params hold the
+        last-synced weights and worker_delta the per-worker drift."""
         cfg = self.config
         gas = cfg.gradient_accumulation_steps
         mesh = self.mesh
@@ -662,7 +742,12 @@ class DeepSpeedTPUEngine:
         has_aux = self.has_aux
         manual = tuple(a for a in ("data", "zero") if mesh.shape.get(a, 1) > 1)
 
-        def body(master, batch, base_rng):
+        def body(master, delta, batch, base_rng):
+            if with_delta:
+                local = jax.tree.map(lambda m, d: m + d[0], master, delta)
+            else:
+                local = master
+
             def micro(carry, xs):
                 acc, loss_sum = carry
                 idx, micro_batch = xs
@@ -673,7 +758,7 @@ class DeepSpeedTPUEngine:
                     out = loss_fn(p, micro_batch, rng)
                     return out[0] if has_aux else out
 
-                loss, grads = jax.value_and_grad(local_loss)(master)
+                loss, grads = jax.value_and_grad(local_loss)(local)
                 acc = jax.tree.map(jnp.add, acc, grads)
                 return (acc, loss_sum + loss), None
 
@@ -685,19 +770,34 @@ class DeepSpeedTPUEngine:
             return grads, (loss_sum / gas)[None]
 
         if not manual:
-            return body  # dp=1: worker dim is trivially [1, ...]
+            if with_delta:
+                return body  # dp=1: worker dim trivially [1, ...]
+            return lambda master, batch, rng: body(master, None, batch, rng)
 
         # pytree-prefix specs: master replicated over the manual axes,
-        # batch leaves [gas, batch, ...] sharded on the batch dim
+        # batch leaves [gas, batch, ...] sharded on the batch dim,
+        # worker_delta leaves worker-major on dim 0
         wrapped = jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(), P(None, manual), P()),
+            in_specs=(P(), P(manual), P(None, manual), P()),
             out_specs=(P(manual), P(manual)),
             axis_names=set(manual),
             check_vma=False,
         )
-        return wrapped
+        if with_delta:
+            return wrapped
+        dp = mesh.shape.get("data", 1) * mesh.shape.get("zero", 1)
+
+        def no_delta(master, batch, rng):
+            # body ignores delta when with_delta=False; the zeros tree is
+            # dead code XLA eliminates — it only satisfies the in_specs
+            zeros = jax.tree.map(
+                lambda m: jnp.zeros((dp,) + m.shape, m.dtype), master
+            )
+            return wrapped(master, zeros, batch, rng)
+
+        return no_delta
 
     def _build_onebit_step(self):
         """Compression-phase step for 1-bit Adam: per-worker grads →
@@ -746,15 +846,128 @@ class DeepSpeedTPUEngine:
 
         return jax.jit(step_fn, donate_argnums=(0,))
 
+    def _build_zoadam_step(self, kind: str):
+        """One of 0/1 Adam's four step programs (ref: onebit/zoadam.py:205
+        — there one eager step with mutable flags; here one compiled SPMD
+        program per schedule kind, chosen host-side)."""
+        optimizer = self.optimizer
+        schedule = self.lr_schedule
+        mesh = self.mesh
+        param_specs = self.param_specs
+        compute_dtype = self.compute_dtype
+        use_master = self._use_master
+        seed = self._rng_seed
+        # worker_u is identically zero through phase 1 — build full/onebit
+        # without the delta input so XLA doesn't stream a dead params-sized
+        # tree every step
+        with_delta = kind in ("local", "sync")
+        worker_acc = self._make_worker_accumulator(with_delta=with_delta)
+        upd = {
+            "full": optimizer.full_update,
+            "onebit": optimizer.onebit_update,
+            "local": optimizer.local_update,
+            "sync": optimizer.sync_update,
+        }[kind]
+
+        def step_fn(state: TrainState, batch):
+            master = state.master if use_master else cast_params(state.params, jnp.float32)
+            base_rng = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
+            if with_delta:
+                wgrads, losses = worker_acc(
+                    master, state.opt["worker_u"], batch, base_rng
+                )
+            else:
+                wgrads, losses = worker_acc(master, batch, base_rng)
+            loss = jnp.mean(losses)
+            new_step = state.step + 1
+            lr = schedule(state.step)
+            new_master, new_opt = upd(wgrads, state.opt, master, lr, mesh)
+            new_params = jax.tree.map(
+                lambda m, s: shd.constraint(m.astype(compute_dtype), s, mesh),
+                new_master,
+                param_specs,
+            )
+            new_state = TrainState(
+                step=new_step,
+                params=new_params,
+                master=new_master if use_master else None,
+                opt=new_opt,
+                loss_scale=state.loss_scale,
+            )
+            if kind in ("local", "sync"):
+                # per-replica momentum norm: worker_mu is worker-major, so
+                # normalize by sqrt(dp) to stay comparable with the
+                # replicated-mu norm of the phase-1 programs
+                dp = new_opt["worker_lrs"].shape[0]
+                norm = global_grad_norm(new_opt["worker_mu"]) / jnp.sqrt(
+                    jnp.float32(dp)
+                )
+            else:
+                norm = global_grad_norm(new_opt["mu"])
+            metrics = {
+                "loss": loss,
+                # momentum norm (the exact mean-grad norm would need the
+                # reduction the local/1-bit phases exist to avoid)
+                "grad_norm": norm,
+                "lr": lr,
+                "skipped": jnp.zeros((), jnp.int32),
+            }
+            return new_state, metrics
+
+        return jax.jit(step_fn, donate_argnums=(0,))
+
+    def _zo_transition(self):
+        """Freeze-boundary bookkeeping: tile the replicated momentum into
+        the worker-major copy and clear the error-feedback memories (they
+        switch from logging gradient error to momentum error — ref:
+        zoadam.py:305 reinitial_error_buffer)."""
+        opt = self.state.opt
+
+        def t(mu, wmu, ew, es):
+            wmu2 = jax.tree.map(
+                lambda m, w: jnp.broadcast_to(m[None], w.shape), mu, wmu
+            )
+            return (wmu2, jax.tree.map(jnp.zeros_like, ew),
+                    jax.tree.map(jnp.zeros_like, es))
+
+        shd_of = lambda tr: jax.tree.map(lambda x: x.sharding, tr)
+        with jax.sharding.set_mesh(self.mesh):
+            wmu2, ew, es = jax.jit(
+                t,
+                out_shardings=(shd_of(opt["worker_mu"]), shd_of(opt["error_w"]),
+                               shd_of(opt["error_s"])),
+            )(opt["mu"], opt["worker_mu"], opt["error_w"], opt["error_s"])
+        self.state = dataclasses.replace(
+            self.state,
+            opt={**opt, "worker_mu": wmu2, "error_w": ew, "error_s": es},
+        )
+        self._zo_transitioned = True
+
+    def _dispatch_zoadam_step(self, batch) -> Dict[str, Any]:
+        s = self.global_steps + 1  # 1-indexed global step
+        if s > self.optimizer.var_freeze_step and not self._zo_transitioned:
+            self._zo_transition()
+        kind = self._zo_sched.kind(s)
+        step_fn = self._zo_programs.get(kind)
+        if step_fn is None:
+            step_fn = self._zo_programs[kind] = self._build_zoadam_step(kind)
+        batch = self._reshape_gas(batch)
+        batch = self.shard_batch(batch, leading_accum_dim=True)
+        with jax.sharding.set_mesh(self.mesh):
+            self.state, metrics = step_fn(self.state, batch)
+        self._zo_sched.advance(s)
+        return metrics
+
     def _build_grad_step(self):
         """Device half of the offloaded step: grads + loss + global norm.
         The optimizer update runs on the host (runtime/offload.py —
         ref: csrc/adam/cpu_adam.cpp role)."""
         seed = self._rng_seed
         accumulate = self._make_accumulator()
+        fetch_params = self._make_param_fetch()
 
         def grad_fn(params, step, batch):
-            master = cast_params(params, jnp.float32)
+            master = cast_params(fetch_params(params), jnp.float32)
             base_rng = jax.random.fold_in(jax.random.PRNGKey(seed), step)
             grads, loss = accumulate(master, batch, base_rng, jnp.float32(1.0), step)
             return grads, loss, global_grad_norm(grads)
@@ -794,7 +1007,7 @@ class DeepSpeedTPUEngine:
                 self.state.master, self.state.opt, grads, grad_norm, self.state.step
             )
         params = jax.tree.map(
-            lambda p, s: jax.device_put(p, NamedSharding(self.mesh, s)),
+            lambda p, s: jax.device_put(p, self._param_storage_sharding(s)),
             params_lp,
             self.param_specs,
         )
@@ -856,6 +1069,8 @@ class DeepSpeedTPUEngine:
     def _dispatch_step(self, batch) -> Dict[str, Any]:
         if self._offload:
             return self._dispatch_offload_step(batch)
+        if self._zoadam:
+            return self._dispatch_zoadam_step(batch)
         # 1-bit Adam: switch to the compressed-momentum program once the
         # warmup window ends (one extra compile at the phase boundary)
         compressed_phase = (
@@ -890,6 +1105,7 @@ class DeepSpeedTPUEngine:
                 comms_logger.record_compiled(collective_volumes(compiled))
             self._train_compiled = compiled
             self.state, metrics = compiled(self.state, batch)
+        self.state = self._park_params(self.state)
         return metrics
 
     def train_batch_async(self, batch) -> Dict[str, Any]:
@@ -957,11 +1173,12 @@ class DeepSpeedTPUEngine:
         """Loss-only forward (ref: pipe engine eval_batch)."""
         if self._eval_step_fn is None:
             loss_fn, has_aux, dtype = self.loss_fn, self.has_aux, self.compute_dtype
+            fetch_params = self._make_param_fetch()
 
             def ev(params, batch):
                 # rng=None: rng-gated dropout paths disable themselves in
                 # eval, matching the reference's module.eval() forward
-                out = loss_fn(cast_params(params, dtype), batch, None)
+                out = loss_fn(cast_params(fetch_params(params), dtype), batch, None)
                 return out[0] if has_aux else out
 
             self._eval_step_fn = jax.jit(ev)
@@ -1043,7 +1260,7 @@ class DeepSpeedTPUEngine:
             # master_weights=False must not inflate params to fp32)
             params = jax.tree.map(
                 lambda m, s: jax.device_put(
-                    m.astype(self.compute_dtype), NamedSharding(self.mesh, s)
+                    m.astype(self.compute_dtype), self._param_storage_sharding(s)
                 ),
                 state.master,
                 self.param_specs,
@@ -1062,7 +1279,7 @@ class DeepSpeedTPUEngine:
                 master=master,
                 params=jax.tree.map(
                     lambda p, s: jax.device_put(
-                        p.astype(self.compute_dtype), NamedSharding(self.mesh, s)
+                        p.astype(self.compute_dtype), self._param_storage_sharding(s)
                     ),
                     state.params,
                     self.param_specs,
@@ -1074,7 +1291,7 @@ class DeepSpeedTPUEngine:
                 state,
                 params=jax.tree.map(
                     lambda m, s: jax.device_put(
-                        m.astype(self.compute_dtype), NamedSharding(self.mesh, s)
+                        m.astype(self.compute_dtype), self._param_storage_sharding(s)
                     ),
                     state.master,
                     self.param_specs,
@@ -1095,6 +1312,13 @@ class DeepSpeedTPUEngine:
 
         self.state = state
         self.global_steps = meta.get("global_steps", int(jax.device_get(state.step)))
+        if self._zoadam:
+            # interval state is a pure function of the step count
+            self._zo_sched = self.optimizer.make_schedule()
+            self._zo_sched.replay(self.global_steps)
+            self._zo_transitioned = (
+                self.global_steps > self.optimizer.var_freeze_step
+            )
         return tag, meta.get("client_state", {})
 
     def _load_checkpoint_nvme(self, load_dir: str, tag: Optional[str]):
@@ -1121,7 +1345,7 @@ class DeepSpeedTPUEngine:
         params = jax.tree.map(
             lambda m, s: jax.device_put(
                 np.asarray(jax.device_get(m)).astype(self.compute_dtype),
-                NamedSharding(self.mesh, s),
+                self._param_storage_sharding(s),
             ),
             master,
             self.param_specs,

@@ -204,3 +204,53 @@ class TestRound2Fixes:
         )(engine.state, engine.shard_batch(
             engine._reshape_gas(batches[0]), leading_accum_dim=True)))
         assert "remat" in jaxpr or "checkpoint" in jaxpr
+
+
+class TestActivationOffload:
+    """cpu_checkpointing + partition_activations (ref: runtime/
+    activation_checkpointing/checkpointing.py:989)."""
+
+    def test_cpu_checkpointing_matches_dots_no_batch(self):
+        batches = data(2)
+        ref = losses(
+            build_engine(activation_checkpointing={"policy": "dots_no_batch"}),
+            batches,
+        )
+        engine = build_engine(activation_checkpointing={
+            "policy": "dots_no_batch", "cpu_checkpointing": True})
+        got = losses(engine, batches)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+        # the saved-dot host transfers are in the traced program (XLA:CPU
+        # may elide the placement custom-calls in the final HLO — host and
+        # device memory coincide there; on TPU they lower to D2H/H2D)
+        jaxpr = str(jax.make_jaxpr(
+            engine._build_train_step().__wrapped__
+        )(engine.state, engine.shard_batch(
+            engine._reshape_gas(batches[0]), leading_accum_dim=True)))
+        assert "<host>" in jaxpr  # offloaded residuals are host-typed
+
+    def test_cpu_checkpointing_requires_dots_no_batch(self):
+        with pytest.raises(ValueError, match="dots_no_batch"):
+            build_engine(activation_checkpointing={
+                "policy": "full", "cpu_checkpointing": True})
+
+    def test_partition_activations_not_replicated_over_model_axis(self):
+        """partition_activations is satisfied BY DESIGN under SPMD: remat-
+        saved residuals stay sharded over the model axis. Evidence: at a
+        fixed global batch, the per-device temp footprint with tp=4 stays
+        ~equal to pure-dp (were activations replicated across the 4 model
+        ranks — what the reference flag exists to prevent — it would be
+        ~4x larger)."""
+        def temp_bytes(micro, **mesh):
+            engine = build_engine(
+                activation_checkpointing={"policy": "dots_no_batch",
+                                          "partition_activations": True},
+                train_micro_batch_size_per_gpu=micro,
+                mesh=mesh,
+            )
+            losses(engine, data(1))
+            return engine._train_compiled.memory_analysis().temp_size_in_bytes
+
+        tp = temp_bytes(8, model=4, data=2)   # global batch 16 = 8 x dp2
+        dp = temp_bytes(2, model=1, data=8)   # global batch 16 = 2 x dp8
+        assert tp < 2.5 * dp

@@ -260,6 +260,48 @@ class CheckpointConfig(ConfigModel):
     async_save: bool = False
 
 
+class DataEfficiencyConfig(ConfigModel):
+    """ref: runtime/data_pipeline/config.py get_data_efficiency_config +
+    constants.py field names. `data_sampling.curriculum_learning` is
+    consumed by runtime/data_analyzer.py build_curriculum_sampler (the
+    DeepSpeedDataSampler analog); the analyzer artifacts it reads come
+    from runtime/data_analyzer.py DataAnalyzer."""
+
+    enabled: bool = False
+    seed: int = 1234
+    data_sampling: Dict[str, Any] = Field(default_factory=dict)
+    data_routing: Dict[str, Any] = Field(default_factory=dict)
+
+    @model_validator(mode="after")
+    def _check_routing(self):
+        routing = dict(self.data_routing or {})
+        if (self.enabled and routing.get("enabled")
+                and routing.get("random_ltd", {}).get("enabled")):
+            # random-LTD is a model-graph transform here, not a dataloader
+            # one — refuse the dataloader-side knob rather than no-op it
+            raise NotImplementedError(
+                "data_routing.random_ltd is configured on the model in "
+                "deepspeed_tpu (TransformerConfig random_ltd_* fields drive "
+                "the in-graph token-drop layers); the dataloader-side block "
+                "has no consumer"
+            )
+        return self
+
+
+class NebulaConfig(ConfigModel):
+    """Tiered checkpoint service knobs (ref: nebula/config.py
+    DeepSpeedNebulaConfig + nebula/constants.py defaults). Consumed by
+    runtime/checkpoint.py TieredCheckpointEngine: fast node-local tier
+    with version retention + interval-persisted durable tier."""
+
+    enabled: bool = False
+    persistent_storage_path: Optional[str] = None
+    persistent_time_interval: float = 100.0
+    num_of_version_in_retention: int = 2
+    enable_nebula_load: bool = True
+    load_path: Optional[str] = None
+
+
 class DeepSpeedTPUConfig(ConfigModel):
     """The full config tree (ref: runtime/config.py DeepSpeedConfig)."""
 
@@ -286,6 +328,8 @@ class DeepSpeedTPUConfig(ConfigModel):
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
     monitor: MonitorConfig = Field(default_factory=MonitorConfig)
     checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
+    nebula: NebulaConfig = Field(default_factory=NebulaConfig)
+    data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)
     aio: AioConfig = Field(default_factory=AioConfig)
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
     autotuning: AutotuningConfig = Field(default_factory=AutotuningConfig)
@@ -456,7 +500,7 @@ _REFERENCE_RENAMES: Dict[str, Dict[str, str]] = {
 # Whole reference config blocks naming features that do not exist yet —
 # presence raises (silent acceptance would be a lie).
 _UNIMPLEMENTED_BLOCKS = (
-    "data_efficiency", "nebula", "zero_quantized_nontrainable_weights",
+    "zero_quantized_nontrainable_weights",
 )
 
 

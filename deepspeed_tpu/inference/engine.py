@@ -77,13 +77,15 @@ class InferenceEngine:
         self.cfg = model_config
         self.config = config or InferenceConfig()
         if model_config.attention_impl == "sparse":
-            # serving a sparse-trained model with dense attention would
-            # silently change numerics — refuse until the paged kernels
-            # honor block-sparse layouts
-            raise NotImplementedError(
-                "inference over attention_impl='sparse' models is not "
-                "implemented (ulysses/ring train-time impls are exact "
-                "attention and serve fine)"
+            # sparse-trained models serve with the train-time block layout
+            # reproduced exactly (inference/model.py _sparsity); decode
+            # runs the XLA paged path — the Pallas kernel has no layout
+            # mask yet (ulysses/ring are exact attention and serve dense)
+            log_dist(
+                "serving block-sparse attention "
+                f"(mode={model_config.sparse_mode}); decode uses the XLA "
+                "paged path",
+                ranks=[0],
             )
         if model_config.variant == "gpt2":
             # prefill pads prompts up to a power-of-two bucket, and every

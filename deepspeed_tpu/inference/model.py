@@ -100,7 +100,71 @@ def _layer_params(params, l):
     return {name: w[l] for name, w in params["layers"].items()}
 
 
-def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool):
+def _sparsity(cfg: T.TransformerConfig):
+    """SparsityConfig for a sparse-trained model, else None. Layouts are
+    deterministic (seeded), so serving reproduces the train-time block
+    mask exactly — including bigbird's random blocks."""
+    if cfg.attention_impl != "sparse":
+        return None
+    from ..ops.sparse_attention import SparsityConfig
+
+    return SparsityConfig(
+        block=cfg.sparse_block, mode=cfg.sparse_mode,
+        num_local_blocks=cfg.sparse_num_local_blocks,
+        num_global_blocks=cfg.sparse_num_global_blocks,
+        num_random_blocks=cfg.sparse_num_random_blocks,
+    )
+
+
+def _sparse_prefill_mask(scfg, Tp: int) -> jnp.ndarray:
+    """Static [Tp, Tp] bool token mask from the block layout (causality
+    included). Tp is a compiled-shape constant, so this is trace-time
+    numpy, not device work."""
+    import numpy as np
+
+    nb = -(-Tp // scfg.block)
+    lay = scfg.layout(nb * scfg.block)  # [nb, nb]
+    blk = np.arange(Tp) // scfg.block
+    mask = lay[np.ix_(blk, blk)] & (np.arange(Tp)[None, :] <= np.arange(Tp)[:, None])
+    return jnp.asarray(mask)
+
+
+def _masked_causal_attention(q, k, v, mask):
+    """[B,S,H,D] attention under an explicit [S,S] token mask — the
+    serving path for sparse-trained models (same masked-softmax math as
+    ops/sparse_attention.sparse_causal_attention, without the gather)."""
+    B, S, H, D = q.shape
+    if q.shape[2] != k.shape[2]:  # GQA
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (D**0.5)
+    logits = jnp.where(mask[None, None], logits.astype(jnp.float32), -jnp.inf)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _sparse_decode_allowed(scfg, positions, n_slots: int) -> jnp.ndarray:
+    """[S, n_slots] bool: which absolute kv positions each decode row may
+    attend to under its layout row (block of the row's own position).
+    Layout rows are prefix-stable, so the table built for the cache span
+    matches the train-time layout of any shorter sequence."""
+    import numpy as np
+
+    sblk = scfg.block
+    nb = -(-n_slots // sblk)
+    lay = jnp.asarray(scfg.layout(nb * sblk))  # [nb, nb] (trace-time numpy)
+    q_blk = positions // sblk  # [S] traced
+    rows = lay[q_blk]  # [S, nb]
+    kv_blk = jnp.arange(n_slots) // sblk  # [n_slots]
+    return rows[:, kv_blk]
+
+
+def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None):
+    if allowed is not None:
+        # block-sparse serving runs the XLA path: the Pallas decode kernel
+        # does not take a layout mask yet
+        return paged_decode_attention_xla(q, ck, cv, table, ctx, allowed=allowed)
     if use_kernel:
         return paged_decode_attention(q, ck, cv, table, ctx)
     return paged_decode_attention_xla(q, ck, cv, table, ctx)
@@ -125,6 +189,12 @@ def decode_step(
     # and their (garbage) logits are sliced off by the engine
     valid = ctx_lens > 0
     positions = jnp.maximum(ctx_lens - 1, 0)  # [S] this token's position
+    scfg = _sparsity(cfg)
+    allowed = (
+        _sparse_decode_allowed(scfg, positions,
+                               tables.shape[1] * cache.block_size)
+        if scfg is not None else None
+    )
     x = params["embed"][tokens]  # [S, E] — activations in the params dtype
     if cfg.variant == "gpt2":
         x = x + params["pos_embed"][positions].astype(x.dtype)
@@ -155,7 +225,8 @@ def decode_step(
         new_k.append(ck)
         new_v.append(cv)
 
-        att = _decode_attention(q, ck, cv, tables, ctx_lens, use_kernel)
+        att = _decode_attention(q, ck, cv, tables, ctx_lens, use_kernel,
+                                allowed=allowed)
         out = jnp.einsum("shd,hde->se", att, lp["wo"].astype(x.dtype))
         if cfg.variant == "gpt2":
             out = out + lp["bo"].astype(x.dtype)
@@ -234,6 +305,11 @@ def prefill_step(
     Tp = tokens.shape[0]
     bs = cache.block_size
     positions = jnp.arange(Tp, dtype=jnp.int32)
+    scfg = _sparsity(cfg)
+    sparse_mask = (
+        _sparse_prefill_mask(scfg, Tp)
+        if scfg is not None and Tp % scfg.block != 0 else None
+    )
     x = params["embed"][tokens][None]  # [1, Tp, E] — params-dtype activations
     if cfg.variant == "gpt2":
         x = x + params["pos_embed"][:Tp].astype(x.dtype)[None]
@@ -263,7 +339,22 @@ def prefill_step(
         new_k.append(ck)
         new_v.append(cv)
 
-        att = causal_attention(q, k, v, use_flash=use_kernel and cfg.use_flash)
+        if scfg is not None and Tp % scfg.block == 0:
+            # block-gather path: FLOPs/memory scale with layout density,
+            # not Tp^2 (same computation the training forward runs)
+            from ..ops.sparse_attention import sparse_causal_attention
+
+            kk, vv = k, v
+            if q.shape[2] != kk.shape[2]:  # GQA repeat, as in training
+                rep = q.shape[2] // kk.shape[2]
+                kk = jnp.repeat(kk, rep, axis=2)
+                vv = jnp.repeat(vv, rep, axis=2)
+            att = sparse_causal_attention(q, kk, vv, scfg)
+        elif sparse_mask is not None:
+            # bucket shorter than a layout block: dense-with-mask fallback
+            att = _masked_causal_attention(q, k, v, sparse_mask)
+        else:
+            att = causal_attention(q, k, v, use_flash=use_kernel and cfg.use_flash)
         out = jnp.einsum("bshd,hde->bse", att, lp["wo"].astype(x.dtype))
         if cfg.variant == "gpt2":
             out = out + lp["bo"].astype(x.dtype)

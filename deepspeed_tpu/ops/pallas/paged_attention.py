@@ -136,11 +136,16 @@ def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens):
     return out[:, :, :G, :].reshape(S, H, D)
 
 
-def paged_decode_attention_xla(q, k_cache, v_cache, block_table, ctx_lens):
-    """jnp oracle for the kernel (tests; also a CPU fallback).
+def paged_decode_attention_xla(q, k_cache, v_cache, block_table, ctx_lens,
+                               allowed=None):
+    """jnp oracle for the kernel (tests; also a CPU fallback, and the
+    block-sparse serving path via `allowed`).
 
     Gathers each sequence's paged KV into a dense [S, NB*bs, KV, D]
-    context — O(S·max_ctx) memory, fine at test scale."""
+    context — O(S·max_ctx) memory, fine at test scale.
+
+    allowed: optional [S, NB*bs] bool — extra per-position mask (the
+    block-sparse layout row of each query's position)."""
     S, H, D = q.shape
     _, bs, KV, _ = k_cache.shape
     G = H // KV
@@ -153,6 +158,8 @@ def paged_decode_attention_xla(q, k_cache, v_cache, block_table, ctx_lens):
     logits = logits / (D**0.5)
     pos = jnp.arange(k.shape[1])
     mask = pos[None, :] < ctx_lens[:, None]  # [S, NB*bs]
+    if allowed is not None:
+        mask = mask & allowed
     logits = jnp.where(mask[:, None, :], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("shk,skhd->shd", probs, v)

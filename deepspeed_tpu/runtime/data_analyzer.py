@@ -1,0 +1,266 @@
+"""Data-efficiency analyzer + metric-driven curriculum sampling.
+
+TPU-native analog of the reference data-sampling stack
+(ref: runtime/data_pipeline/data_sampling/data_analyzer.py
+DataAnalyzer:21 — offline map/reduce of per-sample metrics into mmap
+index files; data_sampler.py DeepSpeedDataSampler:36 — difficulty-
+filtered global-batch index sampling driven by the curriculum schedule).
+
+The reference parallelizes the map phase with torch workers/threads and
+merges with its MMapIndexedDataset builders; here the map shards by
+(num_workers, worker_id) over plain Python iteration (metric fns are
+numpy/host work — this is dataloader-side, never on the TPU), and the
+index files reuse runtime/indexed_dataset.py, the same Megatron mmap
+format the reference writes, so artifacts interoperate.
+
+Artifacts per metric under `<save_path>/<metric>/`:
+  <metric>_sample_to_metric   value per sample, dataset order
+  <metric>_index_to_metric    sorted unique metric values
+  <metric>_index_to_sample    sample ids grouped per sorted value
+  (accumulate-type metrics write a single accumulated vector
+   <metric>_metric_value)
+"""
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .data_pipeline import CurriculumScheduler
+from .indexed_dataset import MMapIndexedDataset, MMapIndexedDatasetBuilder
+
+SINGLE_VALUE = "single_value_per_sample"
+ACCUMULATE = "accumulate_value"
+
+
+def _metric_dir(save_path: str, name: str) -> str:
+    d = os.path.join(save_path, name)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class DataAnalyzer:
+    """Offline per-sample metric computation (map) + index-file build
+    (reduce). ref: data_analyzer.py:21 (__init__ knob names kept).
+
+    metric_functions receive one dataset sample and return a scalar
+    (single_value_per_sample) or a vector accumulated across samples
+    (accumulate_value, e.g. per-token vocab counts)."""
+
+    def __init__(
+        self,
+        dataset: Sequence,
+        metric_names: List[str],
+        metric_functions: List[Callable[[Any], Any]],
+        metric_types: Optional[List[str]] = None,
+        save_path: str = "./",
+        num_workers: int = 1,
+        worker_id: int = 0,
+    ):
+        if not (len(metric_names) == len(metric_functions)):
+            raise ValueError("metric_names and metric_functions must align")
+        self.dataset = dataset
+        self.metric_names = list(metric_names)
+        self.metric_functions = list(metric_functions)
+        self.metric_types = list(metric_types or [SINGLE_VALUE] * len(metric_names))
+        for t in self.metric_types:
+            if t not in (SINGLE_VALUE, ACCUMULATE):
+                raise ValueError(f"unsupported metric_type {t}")
+        self.save_path = save_path
+        self.num_workers = int(num_workers)
+        self.worker_id = int(worker_id)
+
+    # --- map ----------------------------------------------------------
+    def _shard_indices(self) -> np.ndarray:
+        return np.arange(self.worker_id, len(self.dataset), self.num_workers)
+
+    def run_map(self) -> None:
+        """Compute this worker's metric values and persist the partials
+        (ref: run_map_helper — one thread here; metric fns are host-bound
+        numpy, parallelize by running N processes with distinct
+        worker_id)."""
+        idxs = self._shard_indices()
+        singles: Dict[str, List[float]] = {n: [] for n in self.metric_names}
+        accums: Dict[str, Optional[np.ndarray]] = {n: None for n in self.metric_names}
+        for i in idxs:
+            sample = self.dataset[int(i)]
+            for name, fn, typ in zip(self.metric_names, self.metric_functions,
+                                     self.metric_types):
+                v = fn(sample)
+                if typ == SINGLE_VALUE:
+                    singles[name].append(int(v))
+                else:
+                    v = np.asarray(v, np.int64)
+                    accums[name] = v if accums[name] is None else accums[name] + v
+        for name, typ in zip(self.metric_names, self.metric_types):
+            d = _metric_dir(self.save_path, name)
+            if typ == SINGLE_VALUE:
+                np.save(os.path.join(d, f"worker{self.worker_id}_indices.npy"), idxs)
+                np.save(os.path.join(d, f"worker{self.worker_id}_values.npy"),
+                        np.asarray(singles[name], np.int64))
+            else:
+                np.save(os.path.join(d, f"worker{self.worker_id}_accum.npy"),
+                        accums[name] if accums[name] is not None
+                        else np.zeros(0, np.int64))
+
+    # --- reduce -------------------------------------------------------
+    def run_reduce(self) -> None:
+        """Merge all workers' partials into the mmap index files
+        (ref: run_reduce + merge_map_results)."""
+        for name, typ in zip(self.metric_names, self.metric_types):
+            d = _metric_dir(self.save_path, name)
+            if typ == ACCUMULATE:
+                total: Optional[np.ndarray] = None
+                for w in range(self.num_workers):
+                    a = np.load(os.path.join(d, f"worker{w}_accum.npy"))
+                    if a.size:
+                        total = a if total is None else total + a
+                b = MMapIndexedDatasetBuilder(
+                    os.path.join(d, f"{name}_metric_value"), np.int64)
+                b.add_item(total if total is not None else np.zeros(0, np.int64))
+                b.end_document()
+                b.finalize()
+                continue
+            idx_parts, val_parts = [], []
+            for w in range(self.num_workers):
+                idx_parts.append(np.load(os.path.join(d, f"worker{w}_indices.npy")))
+                val_parts.append(np.load(os.path.join(d, f"worker{w}_values.npy")))
+            indices = np.concatenate(idx_parts)
+            values = np.concatenate(val_parts)
+            order = np.argsort(indices)
+            indices, values = indices[order], values[order]
+            if not np.array_equal(indices, np.arange(len(indices))):
+                raise ValueError("map partials do not cover the dataset")
+
+            # sample_to_metric: dataset order
+            b = MMapIndexedDatasetBuilder(
+                os.path.join(d, f"{name}_sample_to_metric"), np.int64)
+            for v in values:
+                b.add_item([v])
+            b.end_document()
+            b.finalize()
+
+            # index_to_metric (sorted unique values) + index_to_sample
+            # (sample ids per value, ascending difficulty)
+            uniq = np.unique(values)
+            bm = MMapIndexedDatasetBuilder(
+                os.path.join(d, f"{name}_index_to_metric"), np.int64)
+            bs = MMapIndexedDatasetBuilder(
+                os.path.join(d, f"{name}_index_to_sample"), np.int64)
+            for v in uniq:
+                bm.add_item([v])
+                bs.add_item(np.nonzero(values == v)[0].astype(np.int64))
+            bm.end_document()
+            bs.end_document()
+            bm.finalize()
+            bs.finalize()
+
+    def run_map_reduce(self) -> None:
+        if self.num_workers != 1:
+            raise ValueError(
+                "run_map_reduce is the single-worker convenience; run "
+                "run_map per worker then run_reduce once"
+            )
+        self.run_map()
+        self.run_reduce()
+
+
+class CurriculumDataSampler:
+    """Difficulty-filtered global-batch index stream
+    (ref: data_sampler.py DeepSpeedDataSampler:36).
+
+    difficulty_type:
+      'value'      — samples with metric value <= current difficulty
+      'percentile' — easiest `difficulty`% of samples (by sorted metric)
+    The difficulty trajectory is a CurriculumScheduler (same schedule
+    math as seqlen curriculum). Deterministic given (seed, step) — the
+    TPU-friendly property: resume needs no sampler state beyond the
+    global step."""
+
+    def __init__(
+        self,
+        index_to_metric_path: str,
+        index_to_sample_path: str,
+        schedule_config: Dict[str, Any],
+        global_batch_size: int,
+        difficulty_type: str = "value",
+        seed: int = 0,
+    ):
+        self.index_to_metric = MMapIndexedDataset(index_to_metric_path)
+        self.index_to_sample = MMapIndexedDataset(index_to_sample_path)
+        if difficulty_type not in ("value", "percentile"):
+            raise ValueError(f"unsupported difficulty_type {difficulty_type}")
+        self.difficulty_type = difficulty_type
+        self.scheduler = CurriculumScheduler(schedule_config)
+        self.global_batch_size = int(global_batch_size)
+        self.seed = int(seed)
+        # flattened (ascending-difficulty) sample ids + per-value bounds
+        self._values = np.asarray(
+            [int(self.index_to_metric[i][0]) for i in range(len(self.index_to_metric))]
+        )
+        groups = [np.asarray(self.index_to_sample[i])
+                  for i in range(len(self.index_to_sample))]
+        self._flat = (np.concatenate(groups) if groups
+                      else np.zeros(0, np.int64))
+        self._bounds = np.cumsum([0] + [g.size for g in groups])
+        self.total_samples = int(self._flat.size)
+
+    def _eligible_count(self, difficulty: int) -> int:
+        if self.difficulty_type == "value":
+            k = int(np.searchsorted(self._values, difficulty, side="right"))
+            n = int(self._bounds[k])
+        else:  # percentile
+            n = int(np.ceil(self.total_samples * difficulty / 100.0))
+        return max(min(n, self.total_samples), 1)
+
+    def get_next_global_batch(self, step: int) -> np.ndarray:
+        """Sample ids for global step `step` (1-indexed), drawn uniformly
+        from the current difficulty pool (with replacement across steps,
+        matching the reference's reshuffle-on-new-cluster behavior)."""
+        difficulty = self.scheduler.update_difficulty(step)
+        n = self._eligible_count(difficulty)
+        rng = np.random.default_rng((self.seed, step))
+        return self._flat[rng.integers(0, n, self.global_batch_size)]
+
+
+def build_curriculum_sampler(config, global_batch_size: Optional[int] = None):
+    """CurriculumDataSampler from a parsed config's `data_efficiency`
+    block (ref: engine _configure_distributed_model building the
+    DeepSpeedDataSampler from data_efficiency_config).
+
+    Field names match the reference JSON schema:
+      data_efficiency.data_sampling.curriculum_learning.curriculum_metrics
+        .<name>.{index_to_metric_path, index_to_sample_path,
+                 difficulty_type, min_difficulty, max_difficulty,
+                 schedule_type, schedule_config}
+    """
+    de = config.data_efficiency
+    if not (de.enabled and de.data_sampling.get("enabled", True)):
+        raise ValueError("data_efficiency.data_sampling is not enabled")
+    cl = dict(de.data_sampling.get("curriculum_learning", {}))
+    if not cl.get("enabled", False):
+        raise ValueError(
+            "data_efficiency.data_sampling.curriculum_learning is not enabled"
+        )
+    metrics = dict(cl.get("curriculum_metrics", {}))
+    if len(metrics) != 1:
+        raise NotImplementedError(
+            "exactly one curriculum metric is supported (the reference's "
+            "multi-metric difficulty intersection is not implemented)"
+        )
+    name, m = next(iter(metrics.items()))
+    m = dict(m)
+    schedule_config = {
+        "min_difficulty": m["min_difficulty"],
+        "max_difficulty": m["max_difficulty"],
+        "schedule_type": m["schedule_type"],
+        "schedule_config": m.get("schedule_config", {}),
+    }
+    return CurriculumDataSampler(
+        index_to_metric_path=m["index_to_metric_path"],
+        index_to_sample_path=m["index_to_sample_path"],
+        schedule_config=schedule_config,
+        global_batch_size=int(global_batch_size or config.train_batch_size),
+        difficulty_type=m.get("difficulty_type", "value"),
+        seed=int(de.seed),
+    )

@@ -358,7 +358,23 @@ class DeepSpeedTPUEngine:
         self.global_steps = 0
         self._metrics_host: Dict[str, float] = {}
 
-        self.checkpoint_engine = CheckpointEngine(async_save=config.checkpoint.async_save)
+        if config.nebula.enabled:
+            # tiered fast/durable checkpointing (ref: nebula engine role)
+            from .checkpoint import TieredCheckpointEngine
+
+            ncfg = config.nebula
+            self.checkpoint_engine = TieredCheckpointEngine(
+                persistent_storage_path=ncfg.persistent_storage_path,
+                persistent_time_interval=ncfg.persistent_time_interval,
+                num_of_version_in_retention=ncfg.num_of_version_in_retention,
+                load_path=ncfg.load_path,
+                enable_tier_load=ncfg.enable_nebula_load,
+                async_save=True,
+            )
+        else:
+            self.checkpoint_engine = CheckpointEngine(
+                async_save=config.checkpoint.async_save
+            )
 
         # curriculum learning (ref: runtime/data_pipeline/
         # curriculum_scheduler.py wired at engine.py train-batch level)

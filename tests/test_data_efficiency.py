@@ -1,0 +1,245 @@
+"""Data-efficiency analyzer + tiered (Nebula-class) checkpointing.
+
+Ref model: tests/unit/runtime/test_data_efficiency.py (curriculum
+sampling behavior) and the nebula engine's tier semantics.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.config.config import parse_config
+from deepspeed_tpu.runtime.data_analyzer import (
+    CurriculumDataSampler,
+    DataAnalyzer,
+    build_curriculum_sampler,
+)
+from deepspeed_tpu.runtime.indexed_dataset import MMapIndexedDataset
+
+
+def make_dataset(n=64, seed=0):
+    """Variable-length token samples; 'seqlen' is the canonical metric."""
+    r = np.random.default_rng(seed)
+    return [r.integers(0, 100, (int(l),)).astype(np.int32)
+            for l in r.integers(4, 33, (n,))]
+
+
+class TestDataAnalyzer:
+    def test_map_reduce_single_worker(self, tmp_path):
+        ds_samples = make_dataset()
+        an = DataAnalyzer(
+            ds_samples, ["seqlen"], [lambda s: len(s)],
+            save_path=str(tmp_path))
+        an.run_map_reduce()
+        d = tmp_path / "seqlen"
+        s2m = MMapIndexedDataset(str(d / "seqlen_sample_to_metric"))
+        assert len(s2m) == len(ds_samples)
+        got = [int(s2m[i][0]) for i in range(len(s2m))]
+        assert got == [len(s) for s in ds_samples]
+        i2m = MMapIndexedDataset(str(d / "seqlen_index_to_metric"))
+        i2s = MMapIndexedDataset(str(d / "seqlen_index_to_sample"))
+        vals = [int(i2m[i][0]) for i in range(len(i2m))]
+        assert vals == sorted(set(got))
+        # grouped sample ids cover the dataset exactly once
+        all_ids = np.concatenate([np.asarray(i2s[i]) for i in range(len(i2s))])
+        assert sorted(all_ids.tolist()) == list(range(len(ds_samples)))
+        for i, v in enumerate(vals):
+            assert all(len(ds_samples[j]) == v for j in np.asarray(i2s[i]))
+
+    def test_multi_worker_map_matches_single(self, tmp_path):
+        ds_samples = make_dataset()
+        for w in range(4):
+            DataAnalyzer(ds_samples, ["seqlen"], [len],
+                         save_path=str(tmp_path / "multi"),
+                         num_workers=4, worker_id=w).run_map()
+        DataAnalyzer(ds_samples, ["seqlen"], [len],
+                     save_path=str(tmp_path / "multi"),
+                     num_workers=4).run_reduce()
+        DataAnalyzer(ds_samples, ["seqlen"], [len],
+                     save_path=str(tmp_path / "single")).run_map_reduce()
+        a = MMapIndexedDataset(str(tmp_path / "multi/seqlen/seqlen_sample_to_metric"))
+        b = MMapIndexedDataset(str(tmp_path / "single/seqlen/seqlen_sample_to_metric"))
+        assert [int(a[i][0]) for i in range(len(a))] == \
+               [int(b[i][0]) for i in range(len(b))]
+
+    def test_accumulate_metric(self, tmp_path):
+        ds_samples = make_dataset(n=16)
+        vocab = 100
+
+        def counts(s):
+            return np.bincount(s, minlength=vocab)
+
+        DataAnalyzer(ds_samples, ["vocab"], [counts],
+                     metric_types=["accumulate_value"],
+                     save_path=str(tmp_path)).run_map_reduce()
+        acc = MMapIndexedDataset(str(tmp_path / "vocab/vocab_metric_value"))
+        expect = sum(counts(s) for s in ds_samples)
+        np.testing.assert_array_equal(np.asarray(acc[0]), expect)
+
+
+class TestCurriculumSampler:
+    @pytest.fixture()
+    def index_paths(self, tmp_path):
+        ds_samples = make_dataset()
+        DataAnalyzer(ds_samples, ["seqlen"], [len],
+                     save_path=str(tmp_path)).run_map_reduce()
+        d = tmp_path / "seqlen"
+        return (str(d / "seqlen_index_to_metric"),
+                str(d / "seqlen_index_to_sample"), ds_samples)
+
+    def test_value_difficulty_filters(self, index_paths):
+        i2m, i2s, ds_samples = index_paths
+        sampler = CurriculumDataSampler(
+            i2m, i2s,
+            {"min_difficulty": 8, "max_difficulty": 32,
+             "schedule_type": "fixed_linear",
+             "schedule_config": {"total_curriculum_step": 10,
+                                 "difficulty_step": 4}},
+            global_batch_size=16, difficulty_type="value", seed=3)
+        early = sampler.get_next_global_batch(1)
+        assert all(len(ds_samples[i]) <= 8 for i in early)
+        late = sampler.get_next_global_batch(20)  # past the ramp: all
+        assert len(set(int(i) for i in late)) > 4
+        # deterministic given (seed, step): a freshly-built sampler resumed
+        # at step 1 reproduces the same batch (no sampler state to save)
+        resumed = CurriculumDataSampler(
+            i2m, i2s,
+            {"min_difficulty": 8, "max_difficulty": 32,
+             "schedule_type": "fixed_linear",
+             "schedule_config": {"total_curriculum_step": 10,
+                                 "difficulty_step": 4}},
+            global_batch_size=16, difficulty_type="value", seed=3)
+        np.testing.assert_array_equal(early, resumed.get_next_global_batch(1))
+
+    def test_percentile_difficulty(self, index_paths):
+        i2m, i2s, ds_samples = index_paths
+        sampler = CurriculumDataSampler(
+            i2m, i2s,
+            {"min_difficulty": 10, "max_difficulty": 100,
+             "schedule_type": "fixed_linear",
+             "schedule_config": {"total_curriculum_step": 10,
+                                 "difficulty_step": 10}},
+            global_batch_size=32, difficulty_type="percentile", seed=0)
+        early = sampler.get_next_global_batch(1)  # easiest 10%
+        lens = sorted(len(s) for s in ds_samples)
+        cutoff = lens[int(np.ceil(len(lens) * 0.10)) - 1]
+        assert all(len(ds_samples[i]) <= cutoff for i in early)
+
+    def test_config_factory(self, index_paths, tmp_path):
+        i2m, i2s, _ = index_paths
+        cfg = parse_config({
+            "train_micro_batch_size_per_gpu": 4,
+            "data_efficiency": {
+                "enabled": True, "seed": 7,
+                "data_sampling": {
+                    "enabled": True,
+                    "curriculum_learning": {
+                        "enabled": True,
+                        "curriculum_metrics": {
+                            "seqlen": {
+                                "index_to_metric_path": i2m,
+                                "index_to_sample_path": i2s,
+                                "difficulty_type": "value",
+                                "min_difficulty": 8,
+                                "max_difficulty": 32,
+                                "schedule_type": "fixed_linear",
+                                "schedule_config": {
+                                    "total_curriculum_step": 10,
+                                    "difficulty_step": 4}}}}}}})
+        cfg.resolve_batch_sizes(1)
+        sampler = build_curriculum_sampler(cfg)
+        batch = sampler.get_next_global_batch(1)
+        assert batch.shape == (4,)
+
+
+class TestTieredCheckpoint:
+    """Nebula-class fast/durable tiering (ref: nebula_checkpoint_engine)."""
+
+    def _build(self, tmp_path, **nebula_kw):
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.models import transformer as T
+
+        mcfg = T.TransformerConfig(vocab_size=64, n_layers=1, n_heads=2,
+                                   d_model=32, max_seq=16, variant="llama",
+                                   use_flash=False)
+        cfg = {
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "seed": 7, "steps_per_print": 1000,
+            "nebula": {"enabled": True,
+                       "persistent_storage_path": str(tmp_path / "durable"),
+                       **nebula_kw},
+        }
+        return ds.initialize(
+            cfg, loss_fn=T.make_loss_fn(mcfg),
+            param_init_fn=lambda k: T.init(mcfg, k),
+            param_logical_specs=T.logical_specs(mcfg)), mcfg
+
+    def _batch(self):
+        r = np.random.default_rng(0)
+        return {"tokens": r.integers(0, 64, (8, 17)).astype(np.int32)}
+
+    def test_tiering_and_retention(self, tmp_path):
+        engine, _ = self._build(
+            tmp_path, persistent_time_interval=1e9,
+            num_of_version_in_retention=2)
+        fast = tmp_path / "fast"
+        b = self._batch()
+        for i in range(4):
+            engine.train_batch(b)
+            engine.save_checkpoint(str(fast), tag=f"v{i}")
+        engine.checkpoint_engine.wait()
+        # fast tier keeps only the newest 2 versions
+        kept = sorted(t for t in os.listdir(fast) if t.startswith("v"))
+        assert kept == ["v2", "v3"], kept
+        # durable tier persisted only the first version (interval huge)
+        assert sorted(os.listdir(tmp_path / "durable")) == ["latest", "v0"]
+
+    def test_load_falls_back_to_durable(self, tmp_path):
+        import shutil
+
+        engine, _ = self._build(tmp_path, persistent_time_interval=0.0)
+        fast = tmp_path / "fast"
+        b = self._batch()
+        l0 = engine.train_batch(b)["loss"]
+        engine.save_checkpoint(str(fast), tag="ck")
+        engine.checkpoint_engine.wait()
+        rest_a = [engine.train_batch(b)["loss"] for _ in range(2)]
+
+        shutil.rmtree(fast)  # node died; scratch gone
+        engine2, _ = self._build(tmp_path, persistent_time_interval=0.0)
+        engine2.load_checkpoint(str(fast), tag="ck")
+        rest_b = [engine2.train_batch(b)["loss"] for _ in range(2)]
+        np.testing.assert_allclose(rest_b, rest_a, rtol=2e-4)
+
+    def test_requires_persistent_path(self, tmp_path):
+        with pytest.raises(ValueError, match="persistent_storage_path"):
+            import deepspeed_tpu as ds
+            from deepspeed_tpu.models import transformer as T
+
+            mcfg = T.TransformerConfig(vocab_size=64, n_layers=1, n_heads=2,
+                                       d_model=32, max_seq=16,
+                                       variant="llama", use_flash=False)
+            ds.initialize(
+                {"train_micro_batch_size_per_gpu": 1,
+                 "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                 "nebula": {"enabled": True}},
+                loss_fn=T.make_loss_fn(mcfg),
+                param_init_fn=lambda k: T.init(mcfg, k),
+                param_logical_specs=T.logical_specs(mcfg))
+
+    def test_disable_nebula_load_skips_durable_fallback(self, tmp_path):
+        import shutil
+
+        engine, _ = self._build(tmp_path, persistent_time_interval=0.0,
+                                enable_nebula_load=False)
+        fast = tmp_path / "fast"
+        engine.train_batch(self._batch())
+        engine.save_checkpoint(str(fast), tag="ck")
+        engine.checkpoint_engine.wait()
+        shutil.rmtree(fast)
+        engine2, _ = self._build(tmp_path, persistent_time_interval=0.0,
+                                 enable_nebula_load=False)
+        with pytest.raises(FileNotFoundError):
+            engine2.load_checkpoint(str(fast), tag="ck")

@@ -341,3 +341,65 @@ class TestDecodeMulti:
             c = c + 1
             want.append(int(t[0]))
         assert [int(x) for x in np.asarray(gen)[:, 0]] == want
+
+
+class TestSparseServing:
+    """Serving sparse-trained models: the engine reproduces the training
+    block layout exactly (prefill token mask + decode layout rows)."""
+
+    def _model(self, mode="fixed", **kw):
+        return small_model(
+            "llama", attention_impl="sparse", sparse_block=8,
+            sparse_num_local_blocks=2, sparse_num_global_blocks=1,
+            sparse_mode=mode, **kw)
+
+    @staticmethod
+    def _oracle(params, cfg, context):
+        """Training sparse forward needs seq % block == 0: pad TRAILING
+        tokens (causal — they can't affect earlier positions)."""
+        blk = cfg.sparse_block
+        n = len(context)
+        padded = list(context) + [0] * ((-n) % blk)
+        logits = T.forward(params, jnp.asarray([padded], jnp.int32), cfg)
+        return np.asarray(logits[0, n - 1], np.float32)
+
+    @pytest.mark.parametrize("mode,kw", [
+        ("fixed", {}),
+        ("fixed", {"n_kv_heads": 2}),  # GQA
+        ("bigbird", {}),
+    ])
+    def test_matches_sparse_training_forward(self, rng, mode, kw):
+        cfg, params = self._model(mode, **kw)
+        eng = engine_for(cfg, params)
+        prompt = list(rng.integers(0, 128, 11))
+        context = list(prompt)
+        logits = eng.put([0], [np.asarray(prompt)])
+        np.testing.assert_allclose(
+            logits[0], self._oracle(params, cfg, context),
+            rtol=2e-2, atol=2e-2)
+        # decode PAST the local window (block 8 x 2 local blocks = 16):
+        # correctness now depends on the layout masking old tokens out
+        for _ in range(10):
+            tok = int(np.argmax(logits[0]))
+            context.append(tok)
+            logits = eng.put([0], [np.asarray([tok])])
+            ref = self._oracle(params, cfg, context)
+            np.testing.assert_allclose(logits[0], ref, rtol=2e-2, atol=2e-2)
+            assert int(np.argmax(logits[0])) == int(np.argmax(ref))
+        assert len(context) > 16
+
+    def test_layout_actually_masks(self, rng):
+        """A sparse-served model must NOT match the dense oracle once the
+        context exceeds the window — guards against the mask being a
+        no-op."""
+        cfg, params = self._model()
+        dense_cfg = T.TransformerConfig(**{
+            **{f: getattr(cfg, f) for f in (
+                "vocab_size", "n_layers", "n_heads", "d_model", "max_seq",
+                "variant", "use_flash")},
+        })
+        eng = engine_for(cfg, params)
+        prompt = list(rng.integers(0, 128, 31))
+        sparse_logits = eng.put([0], [np.asarray(prompt)])[0]
+        dense_ref = oracle_next_logits(params, dense_cfg, prompt)
+        assert not np.allclose(sparse_logits, dense_ref, rtol=2e-2, atol=2e-2)

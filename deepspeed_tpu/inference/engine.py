@@ -229,25 +229,32 @@ class InferenceEngine:
             raise ValueError("uids and tokens length mismatch")
 
         prefills: List[Tuple[int, int, np.ndarray]] = []  # (pos, uid, toks)
-        decodes: List[Tuple[int, int, int]] = []  # (pos, uid, token)
+        # chunked continuation (SplitFuse/ragged analog): an in-flight
+        # sequence's multi-token chunk becomes len(chunk) "virtual decode
+        # rows" sharing one block table with per-row increasing context —
+        # the same compiled decode program serves single-token decodes and
+        # continuation prefills (only the last row's logits are surfaced)
+        decodes: List[Tuple[int, int, np.ndarray]] = []  # (pos, uid, chunk)
+        n_rows = 0
         for i, (uid, toks) in enumerate(zip(uids, tokens)):
+            if len(toks) == 0:
+                raise ValueError(f"uid {uid}: empty token array")
             seq = self.state.get(uid)
             if seq is not None and seq.seen_tokens > 0:
-                if len(toks) != 1:
-                    raise NotImplementedError(
-                        f"uid {uid} is in-flight; continuation must be 1 "
-                        f"token/step (got {len(toks)}) — chunked "
-                        "continuation-prefill lands with the ragged "
-                        "prefill kernel"
+                if seq.seen_tokens + len(toks) > self.config.max_seq_len:
+                    raise ValueError(
+                        f"uid {uid}: {seq.seen_tokens}+{len(toks)} tokens "
+                        "> max_seq_len"
                     )
-                decodes.append((i, uid, int(toks[0])))
+                decodes.append((i, uid, toks))
+                n_rows += len(toks)
             else:
                 if len(toks) > self.config.max_seq_len:
                     raise ValueError(f"prompt of {len(toks)} > max_seq_len")
                 prefills.append((i, uid, toks))
-        if len(decodes) > self.config.max_batch_size:
+        if n_rows > self.config.max_batch_size:
             raise RuntimeError(
-                f"{len(decodes)} decode sequences > max_batch_size "
+                f"{n_rows} decode rows > max_batch_size "
                 f"{self.config.max_batch_size}; split the put()"
             )
 
@@ -268,26 +275,32 @@ class InferenceEngine:
             out[pos] = np.asarray(logits)
 
         if decodes:
-            s = len(decodes)
-            sp = _bucket(s, 8)
+            sp = _bucket(n_rows, 8)
             toks = np.zeros((sp,), np.int32)
             ctx = np.zeros((sp,), np.int32)  # pad rows: ctx 0 = inert
-            for row, (_, uid, tok) in enumerate(decodes):
-                self.state.extend(uid, 1)
-                toks[row] = tok
-                ctx[row] = self.state.get(uid).seen_tokens + 1
             tables = np.zeros((sp, self.config.blocks_per_seq), np.int32)
-            tables[:s] = self.state.block_table(
-                [uid for _, uid, _ in decodes], self.config.blocks_per_seq
-            )
+            last_row: List[Tuple[int, int]] = []  # (out pos, its last row)
+            row = 0
+            for pos, uid, chunk in decodes:
+                base = self.state.get(uid).seen_tokens
+                self.state.extend(uid, len(chunk))
+                table = self.state.block_table(
+                    [uid], self.config.blocks_per_seq
+                )[0]
+                for j, tok in enumerate(chunk):
+                    toks[row] = int(tok)
+                    ctx[row] = base + j + 1
+                    tables[row] = table
+                    row += 1
+                last_row.append((pos, row - 1))
             logits, self.cache = self._decode_fn(sp)(
                 self.params, self.cache, jnp.asarray(toks),
                 jnp.asarray(tables), jnp.asarray(ctx),
             )
-            logits = np.asarray(logits[:s])
-            for row, (pos, uid, _) in enumerate(decodes):
-                self.state.commit(uid, 1)
-                out[pos] = logits[row]
+            logits = np.asarray(logits[:n_rows])
+            for (pos, uid, chunk), (_, lr) in zip(decodes, last_row):
+                self.state.commit(uid, len(chunk))
+                out[pos] = logits[lr]
         return out
 
     def flush(self, uid: int) -> None:

@@ -133,11 +133,12 @@ def _masked_causal_attention(q, k, v, mask):
     """[B,S,H,D] attention under an explicit [S,S] token mask — the
     serving path for sparse-trained models (same masked-softmax math as
     ops/sparse_attention.sparse_causal_attention, without the gather)."""
+    from ..ops.attention import _repeat_kv
+
     B, S, H, D = q.shape
-    if q.shape[2] != k.shape[2]:  # GQA
-        rep = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    rep = q.shape[2] // k.shape[2]  # GQA
+    k = _repeat_kv(k, rep)
+    v = _repeat_kv(v, rep)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (D**0.5)
     logits = jnp.where(mask[None, None], logits.astype(jnp.float32), -jnp.inf)
     p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
@@ -158,6 +159,77 @@ def _sparse_decode_allowed(scfg, positions, n_slots: int) -> jnp.ndarray:
     rows = lay[q_blk]  # [S, nb]
     kv_blk = jnp.arange(n_slots) // sblk  # [n_slots]
     return rows[:, kv_blk]
+
+
+def _mlp(h, lp, cfg: T.TransformerConfig):
+    """FFN over [T, E] tokens — dense or MoE (Mixtral-class serving).
+
+    MoE serving is CAPACITY-FREE exact top-k: every token gets its full
+    expert mix — no train-time capacity drops (those are a training-
+    throughput artifact; ref: sharded_moe.py top1/top2gating keep the
+    drops only because the fixed [X, C] buffers feed the all-to-all).
+    Gate weights reproduce the training combine weights exactly (top-1:
+    the softmax gate; top-2: the renormalized pair), so serving matches
+    the training forward wherever training dropped nothing.
+
+    Experts run as a `lax.scan` over the stacked expert weights with a
+    per-expert combine column — X-times the dense FFN FLOPs, no [T,X,C]
+    dispatch tensor. Fine for decode widths; a gathered-GEMM path is the
+    optimization lever for huge prefills."""
+    if cfg.n_experts == 0:
+        if cfg.variant == "llama":
+            inner = jax.nn.silu(
+                jnp.einsum("te,ef->tf", h, lp["w_gate"].astype(h.dtype))
+            ) * jnp.einsum("te,ef->tf", h, lp["w_in"].astype(h.dtype))
+        else:
+            inner = jax.nn.gelu(
+                jnp.einsum("te,ef->tf", h, lp["w_in"].astype(h.dtype))
+                + lp["b_in"].astype(h.dtype)
+            )
+        out = jnp.einsum("tf,fe->te", inner, lp["w_out"].astype(h.dtype))
+        if cfg.variant == "gpt2":
+            out = out + lp["b_out"].astype(h.dtype)
+        return out
+
+    X = cfg.n_experts
+    logits = h.astype(jnp.float32) @ lp["w_router"].astype(jnp.float32)  # [T, X]
+    gates = jax.nn.softmax(logits, axis=-1)
+    idx1 = jnp.argmax(logits, axis=-1)  # eval: no gate noise
+    onehot1 = jax.nn.one_hot(idx1, X, dtype=jnp.float32)
+    g1 = jnp.sum(gates * onehot1, axis=-1)
+    if cfg.moe_top_k == 1:
+        weights = onehot1 * g1[:, None]  # [T, X]
+    else:
+        masked = jnp.where(onehot1 > 0, -jnp.inf, logits)
+        onehot2 = jax.nn.one_hot(jnp.argmax(masked, axis=-1), X,
+                                 dtype=jnp.float32)
+        g2 = jnp.sum(gates * onehot2, axis=-1)
+        denom = jnp.maximum(g1 + g2, jnp.finfo(jnp.float32).eps)
+        weights = (onehot1 * (g1 / denom)[:, None]
+                   + onehot2 * (g2 / denom)[:, None])
+
+    has_gate = cfg.variant == "llama"
+    xs = [lp["w_in"], lp["w_out"], weights.T.astype(h.dtype)]
+    if has_gate:
+        xs.append(lp["w_gate"])
+    if cfg.variant == "gpt2":
+        xs += [lp["b_in"], lp["b_out"]]
+
+    def expert(acc, ws):
+        if cfg.variant == "llama":
+            w_in, w_out, wcol, w_gate = ws
+            inner = jax.nn.silu(h @ w_gate.astype(h.dtype)) * (
+                h @ w_in.astype(h.dtype)
+            )
+            y = inner @ w_out.astype(h.dtype)
+        else:
+            w_in, w_out, wcol, b_in, b_out = ws
+            inner = jax.nn.gelu(h @ w_in.astype(h.dtype) + b_in.astype(h.dtype))
+            y = inner @ w_out.astype(h.dtype) + b_out.astype(h.dtype)
+        return acc + wcol[:, None] * y, None
+
+    out, _ = jax.lax.scan(expert, jnp.zeros_like(h), tuple(xs))
+    return out
 
 
 def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None):
@@ -195,6 +267,10 @@ def decode_step(
                                tables.shape[1] * cache.block_size)
         if scfg is not None else None
     )
+    if cfg.sliding_window > 0:
+        # Mistral-class: attend only to the last `window` positions
+        kv_pos = jnp.arange(tables.shape[1] * cache.block_size)
+        allowed = kv_pos[None, :] > (positions[:, None] - cfg.sliding_window)
     x = params["embed"][tokens]  # [S, E] — activations in the params dtype
     if cfg.variant == "gpt2":
         x = x + params["pos_embed"][positions].astype(x.dtype)
@@ -233,19 +309,7 @@ def decode_step(
         x = x + out
 
         h = T._norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg)
-        if cfg.variant == "llama":
-            inner = jax.nn.silu(
-                jnp.einsum("se,ef->sf", h, lp["w_gate"].astype(x.dtype))
-            ) * jnp.einsum("se,ef->sf", h, lp["w_in"].astype(x.dtype))
-        else:
-            inner = jax.nn.gelu(
-                jnp.einsum("se,ef->sf", h, lp["w_in"].astype(x.dtype))
-                + lp["b_in"].astype(x.dtype)
-            )
-        out = jnp.einsum("sf,fe->se", inner, lp["w_out"].astype(x.dtype))
-        if cfg.variant == "gpt2":
-            out = out + lp["b_out"].astype(x.dtype)
-        x = x + out
+        x = x + _mlp(h, lp, cfg)
 
     x = T._norm(x, params["ln_f_scale"], params.get("ln_f_bias"), cfg)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
@@ -342,38 +406,27 @@ def prefill_step(
         if scfg is not None and Tp % scfg.block == 0:
             # block-gather path: FLOPs/memory scale with layout density,
             # not Tp^2 (same computation the training forward runs)
+            from ..ops.attention import _repeat_kv
             from ..ops.sparse_attention import sparse_causal_attention
 
-            kk, vv = k, v
-            if q.shape[2] != kk.shape[2]:  # GQA repeat, as in training
-                rep = q.shape[2] // kk.shape[2]
-                kk = jnp.repeat(kk, rep, axis=2)
-                vv = jnp.repeat(vv, rep, axis=2)
-            att = sparse_causal_attention(q, kk, vv, scfg)
+            rep = q.shape[2] // k.shape[2]  # GQA repeat, as in training
+            att = sparse_causal_attention(
+                q, _repeat_kv(k, rep), _repeat_kv(v, rep), scfg
+            )
         elif sparse_mask is not None:
             # bucket shorter than a layout block: dense-with-mask fallback
             att = _masked_causal_attention(q, k, v, sparse_mask)
         else:
-            att = causal_attention(q, k, v, use_flash=use_kernel and cfg.use_flash)
+            att = causal_attention(q, k, v,
+                                   use_flash=use_kernel and cfg.use_flash,
+                                   window=cfg.sliding_window)
         out = jnp.einsum("bshd,hde->bse", att, lp["wo"].astype(x.dtype))
         if cfg.variant == "gpt2":
             out = out + lp["bo"].astype(x.dtype)
         x = x + out
 
         h = T._norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg)
-        if cfg.variant == "llama":
-            inner = jax.nn.silu(
-                jnp.einsum("bse,ef->bsf", h, lp["w_gate"].astype(x.dtype))
-            ) * jnp.einsum("bse,ef->bsf", h, lp["w_in"].astype(x.dtype))
-        else:
-            inner = jax.nn.gelu(
-                jnp.einsum("bse,ef->bsf", h, lp["w_in"].astype(x.dtype))
-                + lp["b_in"].astype(x.dtype)
-            )
-        out = jnp.einsum("bsf,fe->bse", inner, lp["w_out"].astype(x.dtype))
-        if cfg.variant == "gpt2":
-            out = out + lp["b_out"].astype(x.dtype)
-        x = x + out
+        x = x + _mlp(h[0], lp, cfg)[None]
 
     # logits for the last REAL token only (logits_gather): slice before
     # the vocab matmul so the head runs on one token, not Tp

@@ -50,6 +50,10 @@ class TransformerConfig:
     # block-sparse layouts (ops/sparse_attention.py, ref
     # ops/sparse_attention/sparsity_config.py) via the sparse_* knobs.
     attention_impl: str = "ulysses"
+    # Token-exact sliding-window attention (Mistral-class; Mixtral = this
+    # + n_experts). 0 disables. Applies to the ulysses impl; serving
+    # masks the paged decode path to the same window.
+    sliding_window: int = 0
     sparse_block: int = 64
     sparse_mode: str = "fixed"  # fixed | bigbird | dense
     sparse_num_local_blocks: int = 4
@@ -84,6 +88,12 @@ class TransformerConfig:
             raise ValueError(
                 f"unknown attention_impl '{self.attention_impl}' "
                 "(expected ulysses|ring|sparse)"
+            )
+        if self.sliding_window > 0 and self.attention_impl != "ulysses":
+            raise ValueError(
+                "sliding_window requires attention_impl='ulysses' (ring "
+                "rotates full KV; sparse expresses locality via its own "
+                "block layout)"
             )
         if self.variant not in ("llama", "gpt2"):
             raise ValueError(f"unknown variant '{self.variant}'")
@@ -342,7 +352,8 @@ def _attention_block(x, lp, cfg: TransformerConfig, rng=None, positions=None):
         k = _shard(k, DP, None, ("model", "seq"), None)
         v = _shard(v, DP, None, ("model", "seq"), None)
 
-        out = causal_attention(q, k, v, use_flash=cfg.use_flash)  # [B,S,H,D]
+        out = causal_attention(q, k, v, use_flash=cfg.use_flash,
+                               window=cfg.sliding_window)  # [B,S,H,D]
 
     out = _shard(out, DP, "seq", "model", None)
     out = jnp.einsum("bshd,hde->bse", out, lp["wo"].astype(x.dtype))

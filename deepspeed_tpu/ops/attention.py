@@ -31,7 +31,7 @@ def _repeat_kv(k, n_rep: int):
     return jnp.broadcast_to(k[:, :, :, None, :], (B, S, KV, n_rep, D)).reshape(B, S, KV * n_rep, D)
 
 
-def _xla_attention(q, k, v, causal: bool = True):
+def _xla_attention(q, k, v, causal: bool = True, window: int = 0):
     B, S, H, D = q.shape
     scale = 1.0 / (D**0.5)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
@@ -39,6 +39,10 @@ def _xla_attention(q, k, v, causal: bool = True):
     if causal:
         Sk = k.shape[1]
         mask = jnp.tril(jnp.ones((S, Sk), bool), k=Sk - S)
+        if window > 0:
+            # token-exact sliding window (Mistral-class): q attends only
+            # to the last `window` positions including itself
+            mask &= jnp.triu(jnp.ones((S, Sk), bool), k=Sk - S - window + 1)
         logits = jnp.where(mask[None, None], logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
@@ -67,17 +71,22 @@ _flash_fn = None
 _flash_resolved = False
 
 
-def causal_attention(q, k, v, use_flash: bool = True):
+def causal_attention(q, k, v, use_flash: bool = True, window: int = 0):
     """Causal self-attention, [B,S,H,D] x [B,S,KV,D] -> [B,S,H,D].
 
     GQA KV heads are consumed in-place by the flash kernel (index maps,
-    no HBM repeat); only the XLA fallback materializes the repeat."""
-    if use_flash and q.shape[1] >= 256 and _on_tpu():
+    no HBM repeat); only the XLA fallback materializes the repeat.
+
+    window > 0 enables a token-exact sliding window (Mistral-class);
+    that path runs masked XLA attention — the flash kernel has no window
+    clamp yet."""
+    if window <= 0 and use_flash and q.shape[1] >= 256 and _on_tpu():
         flash = _load_flash()
         if flash is not None:
             return flash(q, k, v, causal=True)
     n_rep = q.shape[2] // k.shape[2]
-    return _xla_attention(q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep), causal=True)
+    return _xla_attention(q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep),
+                          causal=True, window=window)
 
 
 def _on_tpu() -> bool:

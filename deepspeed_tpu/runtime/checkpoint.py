@@ -190,26 +190,38 @@ class TieredCheckpointEngine:
         self._sweep_retention(save_dir, keep_tag=tag)
 
     def _sweep_retention(self, save_dir: str, keep_tag: str) -> None:
-        """Drop fast-tier versions beyond the retention window (never the
-        one just written). The durable tier retains everything."""
+        """Drop fast-tier versions beyond the retention window. Never
+        swept: the version just written (its async commit may be in
+        flight) and the version 'latest' currently points to (until the
+        new commit republishes 'latest', that one is the only recoverable
+        fast-tier checkpoint). Runs on every process — fast tiers may be
+        node-local; on a shared filesystem concurrent sweeps target the
+        same already-doomed dirs, which ignore_errors tolerates."""
         import shutil
 
-        if jax.process_index() != 0:
-            return
         save_dir = os.path.abspath(save_dir)
         if not os.path.isdir(save_dir):
             return
-        tags = [
-            t for t in os.listdir(save_dir)
-            if os.path.isdir(os.path.join(save_dir, t))
-        ]
-        tags.sort(key=lambda t: os.path.getmtime(os.path.join(save_dir, t)))
+        protected = {keep_tag}
+        latest_file = os.path.join(save_dir, "latest")
+        try:
+            if os.path.exists(latest_file):
+                with open(latest_file) as f:
+                    protected.add(f.read().strip())
+        except OSError:
+            pass
+        try:
+            tags = [
+                t for t in os.listdir(save_dir)
+                if os.path.isdir(os.path.join(save_dir, t))
+            ]
+            tags.sort(key=lambda t: os.path.getmtime(os.path.join(save_dir, t)))
+        except OSError:
+            return  # racing with another process's sweep
         excess = max(0, len(tags) - self.retention)
         for t in tags[:excess]:
-            if t == keep_tag:
+            if t in protected:
                 continue
-            # the async save of keep_tag may still be committing; only
-            # older, already-committed versions are swept
             shutil.rmtree(os.path.join(save_dir, t), ignore_errors=True)
 
     # --- load path (fast tier first, durable fallback) ----------------

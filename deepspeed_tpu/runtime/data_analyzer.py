@@ -88,6 +88,15 @@ class DataAnalyzer:
                                      self.metric_types):
                 v = fn(sample)
                 if typ == SINGLE_VALUE:
+                    if float(v) != int(v):
+                        # the index files are int64 (the reference's metric
+                        # dtypes are integral too) — refuse rather than
+                        # silently collapse a float metric to one bucket
+                        raise ValueError(
+                            f"metric '{name}' returned non-integral value "
+                            f"{v!r}; quantize float metrics to integer "
+                            "difficulty levels first"
+                        )
                     singles[name].append(int(v))
                 else:
                     v = np.asarray(v, np.int64)
@@ -256,11 +265,18 @@ def build_curriculum_sampler(config, global_batch_size: Optional[int] = None):
         "schedule_type": m["schedule_type"],
         "schedule_config": m.get("schedule_config", {}),
     }
+    if global_batch_size is None:
+        global_batch_size = config.train_batch_size
+        if global_batch_size is None:
+            raise ValueError(
+                "pass global_batch_size, or resolve the config's batch "
+                "triangle first (config.resolve_batch_sizes / engine init)"
+            )
     return CurriculumDataSampler(
         index_to_metric_path=m["index_to_metric_path"],
         index_to_sample_path=m["index_to_sample_path"],
         schedule_config=schedule_config,
-        global_batch_size=int(global_batch_size or config.train_batch_size),
+        global_batch_size=int(global_batch_size),
         difficulty_type=m.get("difficulty_type", "value"),
         seed=int(de.seed),
     )

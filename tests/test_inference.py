@@ -215,12 +215,48 @@ class TestEngineEndToEnd:
         # all sequences flushed after generate
         assert eng.state.free_blocks == eng.config.num_kv_blocks
 
-    def test_in_flight_multi_token_raises(self, rng):
+    def test_chunked_continuation_prefill(self, rng):
+        """An in-flight sequence may carry a multi-token chunk (SplitFuse
+        continuation-prefill): logits equal feeding the same tokens one
+        at a time, and equal the full-context oracle."""
+        cfg, params = small_model()
+        prompt = list(rng.integers(0, 128, 6))
+        chunk = [int(t) for t in rng.integers(0, 128, 5)]
+
+        a = engine_for(cfg, params)
+        a.put([0], [np.asarray(prompt)])
+        chunked = a.put([0], [np.asarray(chunk)])[0]
+
+        b = engine_for(cfg, params)
+        lb = b.put([0], [np.asarray(prompt)])
+        for t in chunk:
+            lb = b.put([0], [np.asarray([t])])
+        np.testing.assert_allclose(chunked, lb[0], rtol=2e-2, atol=2e-2)
+        np.testing.assert_allclose(
+            chunked, oracle_next_logits(params, cfg, prompt + chunk),
+            rtol=2e-2, atol=2e-2)
+        # the chunk is committed: one more decode continues correctly
+        tok = int(np.argmax(chunked))
+        la = a.put([0], [np.asarray([tok])])
+        np.testing.assert_allclose(
+            la[0], oracle_next_logits(params, cfg, prompt + chunk + [tok]),
+            rtol=2e-2, atol=2e-2)
+
+    def test_mixed_chunk_and_decode_batch(self, rng):
         cfg, params = small_model()
         eng = engine_for(cfg, params)
-        eng.put([0], [np.asarray(rng.integers(0, 128, 4))])
-        with pytest.raises(NotImplementedError):
-            eng.put([0], [np.asarray([1, 2])])
+        p0 = list(rng.integers(0, 128, 6))
+        p1 = list(rng.integers(0, 128, 9))
+        l = eng.put([0, 1], [np.asarray(p0), np.asarray(p1)])
+        t1 = int(np.argmax(l[1]))
+        chunk = [int(t) for t in rng.integers(0, 128, 4)]
+        out = eng.put([0, 1], [np.asarray(chunk), np.asarray([t1])])
+        np.testing.assert_allclose(
+            out[0], oracle_next_logits(params, cfg, p0 + chunk),
+            rtol=2e-2, atol=2e-2)
+        np.testing.assert_allclose(
+            out[1], oracle_next_logits(params, cfg, p1 + [t1]),
+            rtol=2e-2, atol=2e-2)
 
 
 class TestReviewRegressions:
@@ -403,3 +439,103 @@ class TestSparseServing:
         sparse_logits = eng.put([0], [np.asarray(prompt)])[0]
         dense_ref = oracle_next_logits(params, dense_cfg, prompt)
         assert not np.allclose(sparse_logits, dense_ref, rtol=2e-2, atol=2e-2)
+
+
+class TestMoEServing:
+    """Mixtral-class serving: MoE models decode/prefill with exact
+    capacity-free top-k expert mixing (tests vs the training forward at a
+    capacity factor high enough that training drops nothing)."""
+
+    @pytest.mark.parametrize("top_k", [1, 2])
+    def test_matches_moe_training_forward(self, rng, top_k):
+        cfg, params = small_model(
+            "llama", n_experts=4, moe_top_k=top_k,
+            moe_capacity_factor=100.0)  # no train-time drops -> exact
+        eng = engine_for(cfg, params)
+        prompt = list(rng.integers(0, 128, 11))
+        context = list(prompt)
+        logits = eng.put([0], [np.asarray(prompt)])
+        ref = oracle_next_logits(params, cfg, context)
+        np.testing.assert_allclose(logits[0], ref, rtol=2e-2, atol=2e-2)
+        for _ in range(5):
+            tok = int(np.argmax(logits[0]))
+            context.append(tok)
+            logits = eng.put([0], [np.asarray([tok])])
+            ref = oracle_next_logits(params, cfg, context)
+            np.testing.assert_allclose(logits[0], ref, rtol=2e-2, atol=2e-2)
+            assert int(np.argmax(logits[0])) == int(np.argmax(ref))
+
+    def test_moe_generate(self, rng):
+        cfg, params = small_model("llama", n_experts=4, moe_top_k=2)
+        eng = engine_for(cfg, params)
+        outs = eng.generate(
+            [list(rng.integers(0, 128, 9)), list(rng.integers(0, 128, 5))],
+            max_new_tokens=6)
+        assert all(len(o) == 6 for o in outs)
+
+
+class TestSlidingWindowServing:
+    """Mistral-class sliding-window attention: training and serving agree,
+    with the window actually excluding old positions."""
+
+    def test_matches_training_forward_past_window(self, rng):
+        cfg, params = small_model("llama", sliding_window=8, n_kv_heads=2)
+        eng = engine_for(cfg, params)
+        prompt = list(rng.integers(0, 128, 11))
+        context = list(prompt)
+        logits = eng.put([0], [np.asarray(prompt)])
+        np.testing.assert_allclose(
+            logits[0], oracle_next_logits(params, cfg, context),
+            rtol=2e-2, atol=2e-2)
+        for _ in range(8):  # context grows to 19 >> window 8
+            tok = int(np.argmax(logits[0]))
+            context.append(tok)
+            logits = eng.put([0], [np.asarray([tok])])
+            ref = oracle_next_logits(params, cfg, context)
+            np.testing.assert_allclose(logits[0], ref, rtol=2e-2, atol=2e-2)
+            assert int(np.argmax(logits[0])) == int(np.argmax(ref))
+
+    def test_window_excludes_old_tokens(self, rng):
+        """Perturbing a token OUTSIDE every live window must not change
+        the next-token logits."""
+        cfg, params = small_model("llama", sliding_window=4)
+        ctx = list(rng.integers(0, 128, 16))
+        a = oracle_next_logits(params, cfg, ctx)
+        ctx2 = list(ctx)
+        ctx2[0] = (ctx2[0] + 1) % 128  # outside the last-4 window... but
+        # position 0 feeds early hidden states that stay in-window for
+        # layer 2 — use a 1-layer config for a clean locality check
+        cfg1 = T.TransformerConfig(
+            vocab_size=128, n_layers=1, n_heads=4, d_model=64, max_seq=128,
+            variant="llama", use_flash=False, sliding_window=4)
+        p1 = T.init(cfg1, jax.random.PRNGKey(0))
+        a1 = oracle_next_logits(p1, cfg1, ctx)
+        b1 = oracle_next_logits(p1, cfg1, ctx2)
+        np.testing.assert_allclose(a1, b1, rtol=1e-5, atol=1e-6)
+        assert a is not None  # multi-layer ran fine too
+
+    def test_mixtral_class_window_plus_moe(self, rng):
+        cfg, params = small_model("llama", sliding_window=8, n_experts=4,
+                                  moe_top_k=2, moe_capacity_factor=100.0)
+        eng = engine_for(cfg, params)
+        prompt = list(rng.integers(0, 128, 13))
+        context = list(prompt)
+        logits = eng.put([0], [np.asarray(prompt)])
+        np.testing.assert_allclose(
+            logits[0], oracle_next_logits(params, cfg, context),
+            rtol=2e-2, atol=2e-2)
+        for _ in range(4):
+            tok = int(np.argmax(logits[0]))
+            context.append(tok)
+            logits = eng.put([0], [np.asarray([tok])])
+            np.testing.assert_allclose(
+                logits[0], oracle_next_logits(params, cfg, context),
+                rtol=2e-2, atol=2e-2)
+
+
+def test_empty_token_array_raises(rng):
+    cfg, params = small_model()
+    eng = engine_for(cfg, params)
+    eng.put([0], [np.asarray(rng.integers(0, 128, 4))])
+    with pytest.raises(ValueError, match="empty"):
+        eng.put([0], [np.asarray([], np.int32)])

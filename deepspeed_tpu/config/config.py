@@ -350,12 +350,12 @@ class DeepSpeedTPUConfig(ConfigModel):
         (VERDICT r1 W2: 'dead config knobs are silent lies')."""
         z = self.zero_optimization
         unimpl = []
-        if z.offload_param.device == OffloadDevice.nvme:
-            unimpl.append("zero_optimization.offload_param.device=nvme")
-        elif z.offload_param.device != OffloadDevice.none:
-            # ZeRO-Infinity param tier (host DRAM) is a stage-3 feature,
-            # matching the reference's assertion (zero/config.py offload_param
-            # is consumed only by stage3.py / parameter_offload.py)
+        if z.offload_param.device != OffloadDevice.none:
+            # ZeRO-Infinity param tier is a stage-3 feature, matching the
+            # reference's assertion (zero/config.py offload_param is
+            # consumed only by stage3.py / parameter_offload.py). The nvme
+            # tier additionally requires offload_optimizer=nvme (engine
+            # check — params re-materialize from the optimizer swap files).
             if z.stage != 3:
                 raise ValueError(
                     "zero_optimization.offload_param requires zero stage 3"

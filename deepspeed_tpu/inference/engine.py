@@ -279,7 +279,7 @@ class InferenceEngine:
             toks = np.zeros((sp,), np.int32)
             ctx = np.zeros((sp,), np.int32)  # pad rows: ctx 0 = inert
             tables = np.zeros((sp, self.config.blocks_per_seq), np.int32)
-            last_row: List[Tuple[int, int]] = []  # (out pos, its last row)
+            last_row: List[int] = []  # each chunk's final row index
             row = 0
             for pos, uid, chunk in decodes:
                 base = self.state.get(uid).seen_tokens
@@ -292,13 +292,13 @@ class InferenceEngine:
                     ctx[row] = base + j + 1
                     tables[row] = table
                     row += 1
-                last_row.append((pos, row - 1))
+                last_row.append(row - 1)
             logits, self.cache = self._decode_fn(sp)(
                 self.params, self.cache, jnp.asarray(toks),
                 jnp.asarray(tables), jnp.asarray(ctx),
             )
             logits = np.asarray(logits[:n_rows])
-            for (pos, uid, chunk), (_, lr) in zip(decodes, last_row):
+            for (pos, uid, chunk), lr in zip(decodes, last_row):
                 self.state.commit(uid, len(chunk))
                 out[pos] = logits[lr]
         return out

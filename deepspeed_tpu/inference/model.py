@@ -232,14 +232,15 @@ def _mlp(h, lp, cfg: T.TransformerConfig):
     return out
 
 
-def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None):
+def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None,
+                      window: int = 0):
     if allowed is not None:
         # block-sparse serving runs the XLA path: the Pallas decode kernel
-        # does not take a layout mask yet
+        # does not take an arbitrary layout mask
         return paged_decode_attention_xla(q, ck, cv, table, ctx, allowed=allowed)
     if use_kernel:
-        return paged_decode_attention(q, ck, cv, table, ctx)
-    return paged_decode_attention_xla(q, ck, cv, table, ctx)
+        return paged_decode_attention(q, ck, cv, table, ctx, window=window)
+    return paged_decode_attention_xla(q, ck, cv, table, ctx, window=window)
 
 
 # ---------------------------------------------------------------------------
@@ -267,10 +268,6 @@ def decode_step(
                                tables.shape[1] * cache.block_size)
         if scfg is not None else None
     )
-    if cfg.sliding_window > 0:
-        # Mistral-class: attend only to the last `window` positions
-        kv_pos = jnp.arange(tables.shape[1] * cache.block_size)
-        allowed = kv_pos[None, :] > (positions[:, None] - cfg.sliding_window)
     x = params["embed"][tokens]  # [S, E] — activations in the params dtype
     if cfg.variant == "gpt2":
         x = x + params["pos_embed"][positions].astype(x.dtype)
@@ -302,7 +299,7 @@ def decode_step(
         new_v.append(cv)
 
         att = _decode_attention(q, ck, cv, tables, ctx_lens, use_kernel,
-                                allowed=allowed)
+                                allowed=allowed, window=cfg.sliding_window)
         out = jnp.einsum("shd,hde->se", att, lp["wo"].astype(x.dtype))
         if cfg.variant == "gpt2":
             out = out + lp["bo"].astype(x.dtype)

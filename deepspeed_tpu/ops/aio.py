@@ -71,7 +71,11 @@ class AsyncIOHandle:
     def async_pread(self, arr: np.ndarray, path: str) -> int:
         assert arr.flags["C_CONTIGUOUS"]
         if self._h is None:
-            arr[...] = np.fromfile(path, dtype=arr.dtype).reshape(arr.shape)
+            # prefix read of arr.nbytes, matching the native path (callers
+            # may read only a leading section of a larger file)
+            arr[...] = np.fromfile(
+                path, dtype=arr.dtype, count=arr.size
+            ).reshape(arr.shape)
             return 0
         self._inflight = getattr(self, "_inflight", {})
         t = self._lib.ds_aio_submit_pread(

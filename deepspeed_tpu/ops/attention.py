@@ -78,12 +78,11 @@ def causal_attention(q, k, v, use_flash: bool = True, window: int = 0):
     no HBM repeat); only the XLA fallback materializes the repeat.
 
     window > 0 enables a token-exact sliding window (Mistral-class);
-    that path runs masked XLA attention — the flash kernel has no window
-    clamp yet."""
-    if window <= 0 and use_flash and q.shape[1] >= 256 and _on_tpu():
+    the flash kernels prune out-of-window blocks from compute AND DMA."""
+    if use_flash and q.shape[1] >= 256 and _on_tpu():
         flash = _load_flash()
         if flash is not None:
-            return flash(q, k, v, causal=True)
+            return flash(q, k, v, causal=True, window=window)
     n_rep = q.shape[2] // k.shape[2]
     return _xla_attention(q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep),
                           causal=True, window=window)

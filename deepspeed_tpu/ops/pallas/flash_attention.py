@@ -57,12 +57,25 @@ def _dot(a, b, trans_a=False, trans_b=False):
 # forward
 # ---------------------------------------------------------------------------
 
+def _win_jbase(i, bq: int, bk: int, window: int, nk: int):
+    """First k block the sliding window needs for q block i."""
+    jb = jnp.maximum(i * bq - window + 1, 0) // bk
+    return jnp.minimum(jb, nk - 1)
+
+
+def _win_j(i, j, bq: int, bk: int, window: int, nk: int):
+    """Window-relative grid step j → absolute k block (clamped; the
+    kernel's `needed` check drops clamped-overflow steps)."""
+    return jnp.minimum(_win_jbase(i, bq, bk, window, nk) + j, nk - 1)
+
+
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc,
     *, scale: float, block_q: int, block_k: int, seq_len: int, causal: bool,
+    window: int, nk_total: int,
 ):
     i = pl.program_id(1)  # q block
-    j = pl.program_id(2)  # k block (sequential)
+    j = pl.program_id(2)  # k block step (sequential; window-relative)
     nk = pl.num_programs(2)
 
     @pl.when(j == 0)
@@ -72,10 +85,19 @@ def _fwd_kernel(
         acc_sc[:] = jnp.zeros_like(acc_sc)
 
     q_start = i * block_q
-    k_start = j * block_k
-    needed = True
-    if causal:
-        needed = k_start < q_start + block_q
+    if window > 0:
+        # the grid walks only the ~window/bk blocks the band needs; steps
+        # clamped past the end are dropped
+        j_abs = _win_j(i, j, block_q, block_k, window, nk_total)
+        k_start = j_abs * block_k
+        needed = _win_jbase(i, block_q, block_k, window, nk_total) + j < nk_total
+        if causal:
+            needed = jnp.logical_and(needed, k_start < q_start + block_q)
+    else:
+        k_start = j * block_k
+        needed = True
+        if causal:
+            needed = k_start < q_start + block_q
 
     @pl.when(needed)
     def _compute():
@@ -88,6 +110,8 @@ def _fwd_kernel(
         mask = cols < seq_len  # k padding
         if causal:
             mask = jnp.logical_and(mask, cols <= rows)
+        if window > 0:
+            mask = jnp.logical_and(mask, cols > rows - window)
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_sc[:]  # (bq, 1)
@@ -124,27 +148,44 @@ def _kv_index(b, H: int, KV: int, G: int):
     return (b // H) * KV + (b % H) // G
 
 
-def _clamp_j(j, i, bq: int, bk: int, causal: bool):
-    """Causal DMA pruning for the k-sequential kernels (fwd, dq): blocks
-    strictly above the diagonal are skipped by @pl.when, but Pallas would
-    still stream their tiles. Clamping the index map to the last needed
-    k block makes pruned steps revisit a resident block — no transfer."""
-    if not causal:
-        return j
-    jmax = ((i + 1) * bq - 1) // bk
-    return jnp.minimum(j, jmax)
+def _clamp_j(j, i, bq: int, bk: int, causal: bool, window: int = 0, nk: int = 0):
+    """k-block index for the k-sequential kernels' DMA (fwd, dq).
+
+    window > 0: grid j is window-relative — translate to the absolute
+    block (iterations scale with the window, not S).
+    causal: blocks strictly above the diagonal are skipped by @pl.when,
+    but Pallas would still stream their tiles; clamping to the last
+    needed block makes pruned steps revisit a resident block."""
+    if window > 0:
+        j = _win_j(i, j, bq, bk, window, nk)
+    if causal:
+        jmax = ((i + 1) * bq - 1) // bk
+        j = jnp.minimum(j, jmax)
+    return j
 
 
-def _clamp_i(i, j, bq: int, bk: int, causal: bool):
-    """Same DMA pruning for the q-sequential dk/dv kernel: q blocks
-    strictly above the diagonal map to the first needed q block."""
-    if not causal:
-        return i
-    imin = (j * bk) // bq
-    return jnp.maximum(i, imin)
+def _win_ibase(j, bk: int, bq: int):
+    """First q block the causal band reaches for k block j."""
+    return (j * bk) // bq
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, H, KV):
+def _win_i(j, i, bk: int, bq: int, nq: int):
+    """Window-relative grid step i → absolute q block for the
+    q-sequential dk/dv kernel."""
+    return jnp.minimum(_win_ibase(j, bk, bq) + i, nq - 1)
+
+
+def _clamp_i(i, j, bq: int, bk: int, causal: bool, window: int = 0, nq: int = 0):
+    """q-block index for the q-sequential dk/dv kernel's DMA."""
+    if window > 0:
+        i = _win_i(j, i, bk, bq, nq)
+    if causal:
+        imin = (j * bk) // bq
+        i = jnp.maximum(i, imin)
+    return i
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, H, KV, window=0):
     """q: [B*H, S, D]; k,v: [B*KV, S, D] → (o [B*H,S,D], lse [B*H,S])."""
     BH, S, D = q.shape
     G = H // KV
@@ -158,20 +199,23 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, H, KV):
     nq, nk = Sp // bq, Sk // bk
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, block_q=bq, block_k=bk, seq_len=S, causal=causal
+        _fwd_kernel, scale=scale, block_q=bq, block_k=bk, seq_len=S, causal=causal,
+        window=window, nk_total=nk,
     )
+    # window: the k grid walks only the blocks the band can touch
+    nkw = min(nk, pl.cdiv(bq + window - 1, bk) + 1) if window > 0 else nk
     o, lse = pl.pallas_call(
         kernel,
-        grid=(BH, nq, nk),
+        grid=(BH, nq, nkw),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec(
                 (1, bk, D),
-                lambda b, i, j: (_kv_index(b, H, KV, G), _clamp_j(j, i, bq, bk, causal), 0),
+                lambda b, i, j: (_kv_index(b, H, KV, G), _clamp_j(j, i, bq, bk, causal, window, nk), 0),
             ),
             pl.BlockSpec(
                 (1, bk, D),
-                lambda b, i, j: (_kv_index(b, H, KV, G), _clamp_j(j, i, bq, bk, causal), 0),
+                lambda b, i, j: (_kv_index(b, H, KV, G), _clamp_j(j, i, bq, bk, causal, window, nk), 0),
             ),
         ],
         out_specs=[
@@ -201,9 +245,10 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, H, KV):
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc,
     *, scale: float, block_q: int, block_k: int, seq_len: int, causal: bool,
+    window: int, nk_total: int,
 ):
     i = pl.program_id(1)  # q block
-    j = pl.program_id(2)  # k block (sequential)
+    j = pl.program_id(2)  # k block step (sequential; window-relative)
     nk = pl.num_programs(2)
 
     @pl.when(j == 0)
@@ -211,10 +256,16 @@ def _bwd_dq_kernel(
         dq_sc[:] = jnp.zeros_like(dq_sc)
 
     q_start = i * block_q
-    k_start = j * block_k
-    needed = True
-    if causal:
-        needed = k_start < q_start + block_q
+    if window > 0:
+        k_start = _win_j(i, j, block_q, block_k, window, nk_total) * block_k
+        needed = _win_jbase(i, block_q, block_k, window, nk_total) + j < nk_total
+        if causal:
+            needed = jnp.logical_and(needed, k_start < q_start + block_q)
+    else:
+        k_start = j * block_k
+        needed = True
+        if causal:
+            needed = k_start < q_start + block_q
 
     @pl.when(needed)
     def _compute():
@@ -227,6 +278,8 @@ def _bwd_dq_kernel(
         mask = cols < seq_len
         if causal:
             mask = jnp.logical_and(mask, cols <= rows)
+        if window > 0:
+            mask = jnp.logical_and(mask, cols > rows - window)
 
         lse = lse_ref[0].reshape(block_q, 1)  # (bq, 1)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # (bq, bk) f32
@@ -245,11 +298,11 @@ def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_sc, dv_sc,
     *, scale: float, block_q: int, block_k: int, seq_len: int, causal: bool,
-    n_group: int,
+    window: int, n_group: int, nq_total: int,
 ):
     j = pl.program_id(1)   # k block
     g = pl.program_id(2)   # q-head within the kv group (sequential)
-    i = pl.program_id(3)   # q block (sequential)
+    i = pl.program_id(3)   # q block step (sequential; window-relative)
     nq = pl.num_programs(3)
 
     @pl.when(jnp.logical_and(g == 0, i == 0))
@@ -257,11 +310,22 @@ def _bwd_dkv_kernel(
         dk_sc[:] = jnp.zeros_like(dk_sc)
         dv_sc[:] = jnp.zeros_like(dv_sc)
 
-    q_start = i * block_q
     k_start = j * block_k
-    needed = True
-    if causal:
-        needed = k_start < q_start + block_q
+    if window > 0:
+        i_abs = _win_i(j, i, block_k, block_q, nq_total)
+        q_start = i_abs * block_q
+        needed = _win_ibase(j, block_k, block_q) + i < nq_total
+        # rows beyond the window never see this k block
+        needed = jnp.logical_and(
+            needed, q_start <= k_start + block_k - 1 + window - 1
+        )
+        if causal:
+            needed = jnp.logical_and(needed, k_start < q_start + block_q)
+    else:
+        q_start = i * block_q
+        needed = True
+        if causal:
+            needed = k_start < q_start + block_q
 
     @pl.when(needed)
     def _compute():
@@ -275,6 +339,8 @@ def _bwd_dkv_kernel(
         mask = cols < seq_len
         if causal:
             mask = jnp.logical_and(mask, cols <= rows)
+        if window > 0:
+            mask = jnp.logical_and(mask, cols > rows - window)
 
         lse = lse_ref[0]  # (1, bq) broadcasts over bk rows
         p_t = jnp.where(mask, jnp.exp(s_t - lse), 0.0)  # (bk, bq) f32
@@ -291,7 +357,7 @@ def _bwd_dkv_kernel(
         dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, H, KV):
+def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, H, KV, window=0):
     BH, S, D = q.shape
     BKV = k.shape[0]
     G = H // KV
@@ -309,16 +375,22 @@ def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, H, KV):
     kp = _pad_to(k, Sk, 1)
     vp = _pad_to(v, Sk, 1)
 
-    kwargs = dict(scale=scale, block_q=bq, block_k=bk, seq_len=S, causal=causal)
     kv_ix = lambda b: _kv_index(b, H, KV, G)
+    # window-relative inner grids: k steps per q block / q steps per k
+    # block scale with the window, not S
+    nkw = min(nk, pl.cdiv(bq + window - 1, bk) + 1) if window > 0 else nk
+    niw = min(nq, pl.cdiv(bk + window - 1, bq) + 1) if window > 0 else nq
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, **kwargs),
-        grid=(BH, nq, nk),
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, block_q=bq, block_k=bk, seq_len=S,
+            causal=causal, window=window, nk_total=nk,
+        ),
+        grid=(BH, nq, nkw),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (kv_ix(b), _clamp_j(j, i, bq, bk, causal), 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (kv_ix(b), _clamp_j(j, i, bq, bk, causal), 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (kv_ix(b), _clamp_j(j, i, bq, bk, causal, window, nk), 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (kv_ix(b), _clamp_j(j, i, bq, bk, causal, window, nk), 0)),
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
             pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
@@ -333,15 +405,18 @@ def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, H, KV):
     q_ix = lambda b, g: (b // KV) * H + (b % KV) * G + g
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, n_group=G, **kwargs),
-        grid=(BKV, nk, G, nq),
+        functools.partial(
+            _bwd_dkv_kernel, n_group=G, scale=scale, block_q=bq, block_k=bk,
+            seq_len=S, causal=causal, window=window, nq_total=nq,
+        ),
+        grid=(BKV, nk, G, niw),
         in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, j, g, i: (q_ix(b, g), _clamp_i(i, j, bq, bk, causal), 0)),
+            pl.BlockSpec((1, bq, D), lambda b, j, g, i: (q_ix(b, g), _clamp_i(i, j, bq, bk, causal, window, nq), 0)),
             pl.BlockSpec((1, bk, D), lambda b, j, g, i: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, j, g, i: (b, j, 0)),
-            pl.BlockSpec((1, bq, D), lambda b, j, g, i: (q_ix(b, g), _clamp_i(i, j, bq, bk, causal), 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, j, g, i: (q_ix(b, g), 0, _clamp_i(i, j, bq, bk, causal))),
-            pl.BlockSpec((1, 1, bq), lambda b, j, g, i: (q_ix(b, g), 0, _clamp_i(i, j, bq, bk, causal))),
+            pl.BlockSpec((1, bq, D), lambda b, j, g, i: (q_ix(b, g), _clamp_i(i, j, bq, bk, causal, window, nq), 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, j, g, i: (q_ix(b, g), 0, _clamp_i(i, j, bq, bk, causal, window, nq))),
+            pl.BlockSpec((1, 1, bq), lambda b, j, g, i: (q_ix(b, g), 0, _clamp_i(i, j, bq, bk, causal, window, nq))),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda b, j, g, i: (b, j, 0)),
@@ -365,35 +440,43 @@ def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, H, KV):
 # custom VJP + public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, block_q, block_k, H, KV):
-    o, _ = _flash_fwd(q, k, v, causal, block_q, block_k, H, KV)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, block_q, block_k, H, KV, window):
+    o, _ = _flash_fwd(q, k, v, causal, block_q, block_k, H, KV, window)
     return o
 
 
-def _flash_fwd_rule(q, k, v, causal, block_q, block_k, H, KV):
-    o, lse = _flash_fwd(q, k, v, causal, block_q, block_k, H, KV)
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k, H, KV, window):
+    o, lse = _flash_fwd(q, k, v, causal, block_q, block_k, H, KV, window)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd_rule(causal, block_q, block_k, H, KV, res, do):
+def _flash_bwd_rule(causal, block_q, block_k, H, KV, window, res, do):
     q, k, v, o, lse = res
-    return _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, H, KV)
+    return _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, H, KV,
+                      window)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def flash_attention(
-    q, k, v, causal: bool = True, block_q: int = 512, block_k: int = 1024
+    q, k, v, causal: bool = True, block_q: int = 512, block_k: int = 1024,
+    window: int = 0,
 ):
     """[B,S,H,D] x [B,S,KV,D] x [B,S,KV,D] → [B,S,H,D] flash attention.
 
     GQA (KV < H) is handled inside the kernels via index maps — callers
-    must NOT pre-repeat KV heads."""
+    must NOT pre-repeat KV heads.
+
+    window > 0: token-exact sliding window (Mistral-class) — requires
+    causal; out-of-window blocks are pruned from both compute (@pl.when)
+    and DMA (index-map clamps), so FLOPs/traffic scale with window, not
+    S^2."""
     B, S, H, D = q.shape
     KV = k.shape[2]
     assert H % KV == 0, f"n_heads {H} not a multiple of kv_heads {KV}"
+    assert window == 0 or causal, "sliding window requires causal attention"
     bq = min(block_q, S)
     bk = min(block_k, S)
 
@@ -401,5 +484,5 @@ def flash_attention(
         h = x.shape[2]
         return x.transpose(0, 2, 1, 3).reshape(B * h, S, D)
 
-    o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, bq, bk, H, KV)
+    o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, bq, bk, H, KV, window)
     return o.reshape(B, H, S, D).transpose(0, 2, 1, 3)

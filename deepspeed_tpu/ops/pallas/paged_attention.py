@@ -33,13 +33,18 @@ from .flash_attention import NEG_INF, _dot, _interpret
 # decode attention
 # ---------------------------------------------------------------------------
 
+def _win_jbase_decode(ctx, window: int, block_size: int):
+    """First table slot the sliding window needs (window > 0)."""
+    return jnp.maximum(ctx - window, 0) // block_size
+
+
 def _decode_kernel(
     tbl_ref, ctx_ref,  # scalar prefetch: [S, NB] block table, [S] ctx lens
     q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc,
-    *, block_size: int, scale: float, n_kv: int, gp: int,
+    *, block_size: int, scale: float, n_kv: int, gp: int, window: int,
 ):
     s = pl.program_id(0)
-    j = pl.program_id(1)  # table slot (sequential)
+    j = pl.program_id(1)  # table slot (sequential; window-relative)
     nb = pl.num_programs(1)
 
     @pl.when(j == 0)
@@ -49,16 +54,24 @@ def _decode_kernel(
         acc_sc[:] = jnp.zeros_like(acc_sc)
 
     ctx = ctx_ref[s]
-    needed = j * block_size < ctx
+    if window > 0:
+        # grid walks only the ~window/bs slots inside the window
+        j_abs = _win_jbase_decode(ctx, window, block_size) + j
+        needed = j_abs * block_size < ctx
+    else:
+        j_abs = j
+        needed = j * block_size < ctx
 
     @pl.when(needed)
     def _compute():
         k = k_ref[0]  # (bs, KV, D)
         v = v_ref[0]
-        cols = j * block_size + jax.lax.broadcasted_iota(
+        cols = j_abs * block_size + jax.lax.broadcasted_iota(
             jnp.int32, (gp, block_size), 1
         )
         live = cols < ctx
+        if window > 0:
+            live = jnp.logical_and(live, cols >= ctx - window)
         for h in range(n_kv):
             q = q_ref[0, h]  # (Gp, D)
             kh = k[:, h, :]  # (bs, D)
@@ -85,7 +98,8 @@ def _decode_kernel(
         )
 
 
-def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens):
+def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens,
+                           window: int = 0):
     """One-token-per-sequence attention over the paged KV cache.
 
     q: [S, H, D] (the new token's queries, KV already written)
@@ -93,6 +107,8 @@ def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens):
     block_table: [S, NB] int32 — cache block ids per sequence
     ctx_lens: [S] int32 — context length INCLUDING the new token; rows
       with 0 are batch padding (output is garbage, sliced by the caller)
+    window > 0: token-exact sliding window (Mistral-class serving) — the
+      slot grid shrinks to ~window/block_size steps per sequence
     returns: [S, H, D]
     """
     S, H, D = q.shape
@@ -108,11 +124,14 @@ def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens):
 
     def kv_index(s, j, tbl_ref, ctx_ref):
         last = jnp.maximum(ctx_ref[s] - 1, 0) // bs
+        if window > 0:
+            j = _win_jbase_decode(ctx_ref[s], window, bs) + j
         return (tbl_ref[s, jnp.minimum(j, last)], 0, 0, 0)
 
+    NBw = min(NB, pl.cdiv(window, bs) + 1) if window > 0 else NB
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(S, NB),
+        grid=(S, NBw),
         in_specs=[
             pl.BlockSpec((1, KV, Gp, D), lambda s, j, tbl, ctx: (s, 0, 0, 0)),
             pl.BlockSpec((1, bs, KV, D), kv_index),
@@ -127,7 +146,8 @@ def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens):
     )
     out = pl.pallas_call(
         functools.partial(
-            _decode_kernel, block_size=bs, scale=scale, n_kv=KV, gp=Gp
+            _decode_kernel, block_size=bs, scale=scale, n_kv=KV, gp=Gp,
+            window=window,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, KV, Gp, D), q.dtype),
@@ -137,7 +157,7 @@ def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens):
 
 
 def paged_decode_attention_xla(q, k_cache, v_cache, block_table, ctx_lens,
-                               allowed=None):
+                               allowed=None, window: int = 0):
     """jnp oracle for the kernel (tests; also a CPU fallback, and the
     block-sparse serving path via `allowed`).
 
@@ -145,7 +165,8 @@ def paged_decode_attention_xla(q, k_cache, v_cache, block_table, ctx_lens,
     context — O(S·max_ctx) memory, fine at test scale.
 
     allowed: optional [S, NB*bs] bool — extra per-position mask (the
-    block-sparse layout row of each query's position)."""
+    block-sparse layout row of each query's position).
+    window > 0: token-exact sliding window per row."""
     S, H, D = q.shape
     _, bs, KV, _ = k_cache.shape
     G = H // KV
@@ -158,6 +179,8 @@ def paged_decode_attention_xla(q, k_cache, v_cache, block_table, ctx_lens,
     logits = logits / (D**0.5)
     pos = jnp.arange(k.shape[1])
     mask = pos[None, :] < ctx_lens[:, None]  # [S, NB*bs]
+    if window > 0:
+        mask = mask & (pos[None, :] >= ctx_lens[:, None] - window)
     if allowed is not None:
         mask = mask & allowed
     logits = jnp.where(mask[:, None, :], logits, NEG_INF)

@@ -185,6 +185,19 @@ class DeepSpeedTPUEngine:
         self._offload_param = (
             config.zero_optimization.offload_param.device == "cpu"
         )
+        # offload_param=nvme: params resident NOWHERE between steps —
+        # re-materialized from the swap files' master sections each step
+        # (full ZeRO-Infinity; requires the optimizer tier on NVMe, whose
+        # files already hold the authoritative fp32 masters).
+        self._offload_param_nvme = (
+            config.zero_optimization.offload_param.device == "nvme"
+        )
+        if self._offload_param_nvme and not self._offload_nvme:
+            raise NotImplementedError(
+                "offload_param.device=nvme requires "
+                "offload_optimizer.device=nvme (params re-materialize from "
+                "the optimizer tier's swap files)"
+            )
         if self._offload:
             if config.fp16.enabled:
                 raise NotImplementedError(
@@ -519,11 +532,14 @@ class DeepSpeedTPUEngine:
         stored_host = jax.jit(
             lambda m: cast_params(m, self.compute_dtype)
         )(master_host)
-        params_dev = jax.tree.map(
-            lambda x, s: jax.device_put(x, self._param_storage_sharding(s)),
-            stored_host,
-            self.param_specs,
-        )
+        if self._offload_param_nvme:
+            params_dev = None  # swap files are the only resident copy
+        else:
+            params_dev = jax.tree.map(
+                lambda x, s: jax.device_put(x, self._param_storage_sharding(s)),
+                stored_host,
+                self.param_specs,
+            )
         step = jax.device_put(jnp.zeros((), jnp.int32), NamedSharding(mesh, P()))
         state = TrainState(
             step=step, params=params_dev, master=None, opt=None, loss_scale=None
@@ -990,6 +1006,18 @@ class DeepSpeedTPUEngine:
 
         return jax.jit(grad_fn)
 
+    def _materialized_params(self):
+        """Device-ready params; under offload_param=nvme they are read
+        back from the swap files' master sections on demand."""
+        if self.state.params is not None:
+            return self.state.params
+        lp = self.swapper.unflatten(self.swapper.read_lp_params())
+        return jax.tree.map(
+            lambda p, s: jax.device_put(p, NamedSharding(self.mesh, s)),
+            lp,
+            self.param_specs,
+        )
+
     def _dispatch_offload_step(self, batch) -> Dict[str, Any]:
         """One global step with the optimizer tier in host DRAM:
         device grads → D2H → host update (clip+adam+cast) → H2D params.
@@ -1001,7 +1029,7 @@ class DeepSpeedTPUEngine:
         batch = self.shard_batch(batch, leading_accum_dim=True)
         with jax.sharding.set_mesh(self.mesh):
             grads, loss, grad_norm = self._grad_step_fn(
-                self.state.params, self.state.step, batch
+                self._materialized_params(), self.state.step, batch
             )
         if self._offload_nvme:
             # NVMe tier: leaf-ordered swap-in → host update → swap-out
@@ -1014,19 +1042,23 @@ class DeepSpeedTPUEngine:
                 flat_grads, jax.device_get(grad_norm),
                 int(jax.device_get(self.state.step)),
             )
-            params_lp = jax.tree_util.tree_unflatten(
-                jax.tree_util.tree_structure(self.state.params), lp_leaves
-            )
+            # the swapper's treedef, NOT state.params' (which is empty
+            # under offload_param=nvme)
+            params_lp = self.swapper.unflatten(lp_leaves)
             master, opt = None, None
         else:
             master, opt, params_lp, lr = self.host_optimizer.step(
                 self.state.master, self.state.opt, grads, grad_norm, self.state.step
             )
-        params = jax.tree.map(
-            lambda p, s: jax.device_put(p, self._param_storage_sharding(s)),
-            params_lp,
-            self.param_specs,
-        )
+        if self._offload_param_nvme:
+            # params live only in the swap files between steps
+            params = None
+        else:
+            params = jax.tree.map(
+                lambda p, s: jax.device_put(p, self._param_storage_sharding(s)),
+                params_lp,
+                self.param_specs,
+            )
         self.state = dataclasses.replace(
             self.state,
             step=self.state.step + 1,
@@ -1209,7 +1241,7 @@ class DeepSpeedTPUEngine:
             batch = jax.tree.map(add_micro_dim, batch)
         batch = self.shard_batch(batch, leading_accum_dim=self.pipelined)
         with jax.sharding.set_mesh(self.mesh):
-            return float(self._eval_step_fn(self.state.params, batch))
+            return float(self._eval_step_fn(self._materialized_params(), batch))
 
     # ------------------------------------------------------------------
     # checkpointing (ref: engine.py save_checkpoint:3064 / load:2700)
@@ -1223,6 +1255,16 @@ class DeepSpeedTPUEngine:
             # ref: stage3 NVMe-aware save paths)
             master, opt = self.swapper.export_state()
             state_to_save = dataclasses.replace(self.state, master=master, opt=opt)
+            if state_to_save.params is None:
+                # offload_param=nvme keeps no resident params — materialize
+                # them into the checkpoint so ANY engine layout can load it
+                state_to_save = dataclasses.replace(
+                    state_to_save,
+                    params=jax.tree.map(
+                        lambda m: np.asarray(m).astype(self.compute_dtype),
+                        master,
+                    ),
+                )
         meta = {
             "global_steps": self.global_steps,
             "client_state": client_state or {},
@@ -1344,8 +1386,17 @@ class DeepSpeedTPUEngine:
         disk_has_master = meta_probe.get("has_master", True)
         # current swap contents provide the host-resident template shapes
         tmpl_master, tmpl_opt = self.swapper.export_state()
+        params_tmpl = self.state.params
+        if params_tmpl is None:
+            # offload_param=nvme engine: the checkpoint still carries a
+            # params subtree (see save_checkpoint) — template it from the
+            # swap masters
+            params_tmpl = jax.tree.map(
+                lambda m: np.asarray(m).astype(self.compute_dtype), tmpl_master
+            )
         template = dataclasses.replace(
             self.state,
+            params=params_tmpl,
             master=tmpl_master if disk_has_master else None,
             opt=tmpl_opt,
             loss_scale=None,
@@ -1358,14 +1409,17 @@ class DeepSpeedTPUEngine:
                 lambda p: np.asarray(jax.device_get(p), np.float32), state.params
             )
         self.swapper.import_state(master, state.opt)
-        params = jax.tree.map(
-            lambda m, s: jax.device_put(
-                np.asarray(jax.device_get(m)).astype(self.compute_dtype),
-                self._param_storage_sharding(s),
-            ),
-            master,
-            self.param_specs,
-        )
+        if self._offload_param_nvme:
+            params = None  # the freshly-imported swap files are the copy
+        else:
+            params = jax.tree.map(
+                lambda m, s: jax.device_put(
+                    np.asarray(jax.device_get(m)).astype(self.compute_dtype),
+                    self._param_storage_sharding(s),
+                ),
+                master,
+                self.param_specs,
+            )
         self.state = dataclasses.replace(
             state, params=params, master=None, opt=None, loss_scale=None
         )
@@ -1375,6 +1429,8 @@ class DeepSpeedTPUEngine:
     # ------------------------------------------------------------------
     @property
     def params(self):
+        if self.state.params is None:  # offload_param=nvme
+            return self._materialized_params()
         return self.state.params
 
     @property

@@ -203,3 +203,35 @@ class NVMeOptimizerSwapper:
         for t in write_tickets:
             self.aio.wait(t)
         return params_lp, lr
+
+    def read_lp_params(self) -> List[np.ndarray]:
+        """Read ONLY the master section of every leaf and cast to the
+        compute dtype — the offload_param=nvme re-materialization (params
+        are resident nowhere between steps; ref: partitioned_param_swapper
+        swap-in of fp16 partitions). Read-ahead mirrors step()."""
+        n = len(self._leaf_paths)
+
+        def submit_read(i):
+            path = self._leaf_paths[i]
+            shape = self._shapes[path]
+            size = int(np.prod(shape)) if shape else 1
+            buf = np.empty(size, np.float32)  # master is the file prefix
+            return buf, self.aio.async_pread(buf, self._file(path))
+
+        out: List[np.ndarray] = []
+        pending = submit_read(0)
+        for i in range(n):
+            buf, ticket = pending
+            self.aio.wait(ticket)
+            if i + 1 < n:
+                pending = submit_read(i + 1)
+            shape = self._shapes[path := self._leaf_paths[i]]
+            out.append(
+                buf.reshape(shape).astype(
+                    np.dtype(jnp.dtype(self.compute_dtype).name)
+                )
+            )
+        return out
+
+    def unflatten(self, leaves):
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)

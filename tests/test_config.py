@@ -134,9 +134,6 @@ def test_unimplemented_knobs_raise():
     import pytest as _pytest
     base = {"train_micro_batch_size_per_gpu": 1}
     for extra in (
-        {"zero_optimization": {"stage": 3,
-                               "offload_param": {"device": "nvme",
-                                                 "nvme_path": "/tmp/x"}}},
         {"checkpoint": {"load_universal": True}},
         {"prescale_gradients": True},
         {"sparse_attention": {"mode": "fixed"}},

@@ -11,6 +11,11 @@ import numpy as np
 import pytest
 
 from deepspeed_tpu.ops.attention import _xla_attention, causal_attention
+# Mosaic requires the lse tile (1, block_q) to satisfy the (8,128)
+# tiling rule, so real-TPU runs use 128-sized blocks; the interpreter
+# lane keeps 64 for speed. Same kernels either way.
+BLK = 128 if jax.default_backend() == "tpu" else 64
+
 from deepspeed_tpu.ops.pallas.flash_attention import (
     _flash_bwd,
     _flash_fwd,
@@ -44,7 +49,7 @@ class TestForwardKernel:
         k = jnp.asarray(rng.normal(size=(BH, S, D)), jnp.float32)
         v = jnp.asarray(rng.normal(size=(BH, S, D)), jnp.float32)
         with jax.default_matmul_precision("highest"):
-            o, lse = _flash_fwd(q, k, v, causal, 64, 64, H=1, KV=1)
+            o, lse = _flash_fwd(q, k, v, causal, BLK, BLK, H=1, KV=1)
             ref = oracle(q[:, :, None], k[:, :, None], v[:, :, None], causal)[:, :, 0]
             # reference lse
             scale = 1.0 / (D**0.5)
@@ -78,8 +83,8 @@ class TestBackwardKernels:
 
         dq_ref, dk_ref, dv_ref = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
 
-        o, lse = _flash_fwd(q, k, v, causal, 64, 64, H=1, KV=1)
-        dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, causal, 64, 64, H=1, KV=1)
+        o, lse = _flash_fwd(q, k, v, causal, BLK, BLK, H=1, KV=1)
+        dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, causal, BLK, BLK, H=1, KV=1)
         np.testing.assert_allclose(dq, dq_ref, rtol=2e-3, atol=2e-3)
         np.testing.assert_allclose(dk, dk_ref, rtol=2e-3, atol=2e-3)
         np.testing.assert_allclose(dv, dv_ref, rtol=2e-3, atol=2e-3)
@@ -93,13 +98,13 @@ class TestFlashGQA:
         do = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
 
         def f_flash(q, k, v):
-            return jnp.sum(flash_attention(q, k, v, block_q=64, block_k=64) * do)
+            return jnp.sum(flash_attention(q, k, v, block_q=BLK, block_k=BLK) * do)
 
         def f_ref(q, k, v):
             return jnp.sum(oracle(q, k, v, causal=True) * do)
 
         with jax.default_matmul_precision("highest"):
-            out = flash_attention(q, k, v, block_q=64, block_k=64)
+            out = flash_attention(q, k, v, block_q=BLK, block_k=BLK)
             ref = oracle(q, k, v)
             g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
             g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
@@ -145,3 +150,43 @@ class TestWrapper:
             out = _xla_attention(q, k, v, causal=True)
         # first token attends only to itself
         np.testing.assert_allclose(out[0, 0, 0], v[0, 0, 0], rtol=1e-4, atol=1e-4)
+
+
+class TestSlidingWindowKernel:
+    """window > 0: the kernels must match the windowed XLA oracle in fwd
+    AND both backward kernels, across block-boundary window sizes, GQA,
+    and the padding path."""
+
+    @pytest.mark.parametrize("window", [16, 64, 100])
+    @pytest.mark.parametrize("S", [128, 96])
+    def test_fwd_and_grads_match_oracle(self, rng, window, S):
+        q, k, v = make_qkv(rng, B=2, S=S, H=2, D=64)
+
+        def win_oracle(q, k, v):
+            return _xla_attention(q, k, v, causal=True, window=window)
+
+        def flash_fn(q, k, v):
+            return flash_attention(q, k, v, causal=True, block_q=BLK,
+                                   block_k=BLK, window=window)
+
+        with jax.default_matmul_precision("highest"):
+            o = flash_fn(q, k, v)
+            ref = win_oracle(q, k, v)
+            np.testing.assert_allclose(o, ref, rtol=2e-3, atol=2e-3)
+
+            cot = jnp.asarray(rng.normal(size=o.shape), o.dtype)
+            g = jax.grad(lambda *a: jnp.vdot(flash_fn(*a), cot), argnums=(0, 1, 2))(q, k, v)
+            gr = jax.grad(lambda *a: jnp.vdot(win_oracle(*a), cot), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(a, b, rtol=5e-3, atol=5e-3)
+
+    def test_gqa_window(self, rng):
+        q, k, v = make_qkv(rng, B=2, S=128, H=4, KV=2, D=64)
+        with jax.default_matmul_precision("highest"):
+            o = flash_attention(q, k, v, causal=True, block_q=BLK,
+                                block_k=BLK, window=32)
+            n_rep = 2
+            ref = _xla_attention(q, jnp.repeat(k, n_rep, axis=2),
+                                 jnp.repeat(v, n_rep, axis=2),
+                                 causal=True, window=32)
+        np.testing.assert_allclose(o, ref, rtol=2e-3, atol=2e-3)

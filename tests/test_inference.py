@@ -80,6 +80,19 @@ class TestStateManager:
 
 
 class TestPagedDecodeKernel:
+    @pytest.mark.parametrize("window", [0, 20, 48])
+    def test_windowed_matches_oracle(self, rng, window):
+        S, KV, D, bs, NBLK, NB = 3, 2, 64, 16, 32, 4
+        q = jnp.asarray(rng.normal(size=(S, KV * 2, D)), jnp.float32)
+        kc = jnp.asarray(rng.normal(size=(NBLK, bs, KV, D)), jnp.float32)
+        vc = jnp.asarray(rng.normal(size=(NBLK, bs, KV, D)), jnp.float32)
+        tbl = jnp.asarray(rng.permutation(NBLK)[: S * NB].reshape(S, NB).astype(np.int32))
+        ctx = jnp.asarray(np.array([5, 33, 64], np.int32))
+        with jax.default_matmul_precision("highest"):
+            out = paged_decode_attention(q, kc, vc, tbl, ctx, window=window)
+            ref = paged_decode_attention_xla(q, kc, vc, tbl, ctx, window=window)
+        np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)
+
     @pytest.mark.parametrize("G", [1, 4])
     def test_matches_oracle(self, rng, G):
         S, KV, D, bs, NBLK, NB = 3, 2, 64, 16, 32, 4

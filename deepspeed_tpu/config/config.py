@@ -59,6 +59,11 @@ class ZeroConfig(ConfigModel):
     # ZeRO++ quantized collectives (ref: zero/config.py:268/:280).
     zero_quantized_weights: bool = False
     zero_quantized_gradients: bool = False
+    # ref: zero/config.py zero_quantized_nontrainable_weights — resident
+    # int8 storage for frozen weights. Not implemented (all engine params
+    # are trainable here; serve frozen models via inference PTQ instead) —
+    # parses when false so stock ZeRO++ configs load, raises when true.
+    zero_quantized_nontrainable_weights: bool = False
     offload_optimizer: OffloadConfig = Field(default_factory=OffloadConfig)
     offload_param: OffloadConfig = Field(default_factory=OffloadConfig)
     # Accepted no-ops on TPU: grad reduction placement/overlap is scheduled
@@ -260,6 +265,18 @@ class CheckpointConfig(ConfigModel):
     async_save: bool = False
 
 
+class ProgressiveLayerDropConfig(ConfigModel):
+    """ref: runtime/progressive_layer_drop.py ProgressiveLayerDrop:10 +
+    constants PLD_THETA/PLD_GAMMA. theta(t) = (1-θ)·exp(-γt) + θ decays
+    from 1 (keep everything) toward θ; the engine injects it into each
+    micro-batch and the model drops layer l with prob
+    (l+1)/L · (1-theta) via lax.cond (compute actually skipped)."""
+
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+
 class DataEfficiencyConfig(ConfigModel):
     """ref: runtime/data_pipeline/config.py get_data_efficiency_config +
     constants.py field names. `data_sampling.curriculum_learning` is
@@ -334,6 +351,9 @@ class DeepSpeedTPUConfig(ConfigModel):
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
     autotuning: AutotuningConfig = Field(default_factory=AutotuningConfig)
     curriculum_learning: CurriculumConfig = Field(default_factory=CurriculumConfig)
+    progressive_layer_drop: ProgressiveLayerDropConfig = Field(
+        default_factory=ProgressiveLayerDropConfig
+    )
     # compression training (ref: compression/config.py — deep free-form
     # schema validated by compression.init_compression at engine build)
     compression_training: Optional[Dict[str, Any]] = None
@@ -350,6 +370,12 @@ class DeepSpeedTPUConfig(ConfigModel):
         (VERDICT r1 W2: 'dead config knobs are silent lies')."""
         z = self.zero_optimization
         unimpl = []
+        if z.zero_quantized_nontrainable_weights:
+            unimpl.append(
+                "zero_optimization.zero_quantized_nontrainable_weights "
+                "(serve frozen models via inference PTQ: init_inference "
+                "quantization={'bits': 8})"
+            )
         if z.offload_param.device != OffloadDevice.none:
             # ZeRO-Infinity param tier is a stage-3 feature, matching the
             # reference's assertion (zero/config.py offload_param is
@@ -372,10 +398,11 @@ class DeepSpeedTPUConfig(ConfigModel):
                 "policy='dots_no_batch' (the saved dot outputs are what "
                 "moves to host DRAM)"
             )
-        if self.checkpoint.load_universal:
-            unimpl.append("checkpoint.load_universal")
         if self.checkpoint.use_node_local_storage:
-            unimpl.append("checkpoint.use_node_local_storage")
+            unimpl.append(
+                "checkpoint.use_node_local_storage (use the nebula block: "
+                "fast node-local tier + durable persistent_storage_path)"
+            )
         if self.prescale_gradients:
             unimpl.append("prescale_gradients")
         if unimpl:
@@ -499,9 +526,7 @@ _REFERENCE_RENAMES: Dict[str, Dict[str, str]] = {
 
 # Whole reference config blocks naming features that do not exist yet —
 # presence raises (silent acceptance would be a lie).
-_UNIMPLEMENTED_BLOCKS = (
-    "zero_quantized_nontrainable_weights",
-)
+_UNIMPLEMENTED_BLOCKS = ()
 
 
 def _compat_filter(config: Dict[str, Any]) -> Dict[str, Any]:

@@ -236,8 +236,11 @@ def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None,
                       window: int = 0):
     if allowed is not None:
         # block-sparse serving runs the XLA path: the Pallas decode kernel
-        # does not take an arbitrary layout mask
-        return paged_decode_attention_xla(q, ck, cv, table, ctx, allowed=allowed)
+        # does not take an arbitrary layout mask. (window is passed through
+        # for completeness — the config forbids sparse+sliding_window, so
+        # both masks never actually combine today.)
+        return paged_decode_attention_xla(q, ck, cv, table, ctx,
+                                          allowed=allowed, window=window)
     if use_kernel:
         return paged_decode_attention(q, ck, cv, table, ctx, window=window)
     return paged_decode_attention_xla(q, ck, cv, table, ctx, window=window)

@@ -446,23 +446,43 @@ def _wants_rng(cfg: TransformerConfig) -> bool:
     )
 
 
-def _make_layer_body(cfg: TransformerConfig, use_rng: bool, positions=None):
+def _make_layer_body(cfg: TransformerConfig, use_rng: bool, positions=None,
+                     pld_theta=None):
     """One transformer layer as a scan body (shared by the flat
     scan-over-layers path, the pipelined per-stage path, and the
     random-LTD subset segment — which passes the subset's original
-    `positions`)."""
+    `positions`).
+
+    pld_theta: traced scalar — Progressive Layer Dropping (ref:
+    runtime/progressive_layer_drop.py, arXiv 2010.13369). Each layer is
+    skipped with prob (l+1)/L * (1 - theta) (the paper's depth-increasing
+    schedule); the skip is a `lax.cond`, so a dropped layer's compute is
+    actually skipped at runtime, not masked."""
 
     def layer_body(carry, xs):
-        if use_rng:
+        if pld_theta is not None:
+            h0, (lp, layer_rng, idx) = carry, xs
+            r1, r2, r_pld = jax.random.split(layer_rng, 3)
+        elif use_rng:
             h0, (lp, layer_rng) = carry, xs
             r1, r2 = jax.random.split(layer_rng)
         else:
             h0, lp = carry, xs
             r1 = r2 = None
-        h = _attention_block(h0, lp, cfg, r1, positions=positions)
-        h, l_aux = _mlp_block(h, lp, cfg, r2)
-        h = _shard(h, DP, "seq", None)
-        return h, l_aux
+
+        def run(h0):
+            h = _attention_block(h0, lp, cfg, r1, positions=positions)
+            h, l_aux = _mlp_block(h, lp, cfg, r2)
+            h = _shard(h, DP, "seq", None)
+            return h, l_aux
+
+        if pld_theta is None:
+            return run(h0)
+        p_keep = 1.0 - (idx + 1.0) / cfg.n_layers * (1.0 - pld_theta)
+        keep = jax.random.bernoulli(r_pld, p_keep)
+        return jax.lax.cond(
+            keep, run, lambda h: (h, jnp.float32(0.0)), h0
+        )
 
     if cfg.remat == "full":
         layer_body = jax.checkpoint(layer_body)
@@ -475,21 +495,26 @@ def _make_layer_body(cfg: TransformerConfig, use_rng: bool, positions=None):
 
 def forward_hidden(
     params: Dict[str, Any], tokens, cfg: TransformerConfig, rng=None,
-    with_aux: bool = False, ltd_idx=None,
+    with_aux: bool = False, ltd_idx=None, pld_theta=None,
 ):
     """tokens [B, S] int32 → final hidden states [B, S, E] (post ln_f).
 
     with_aux=True additionally returns {"moe_aux_loss": scalar} (sum of
     per-layer load-balancing losses; 0 for dense models).
     ltd_idx [B, K] (with cfg.random_ltd_layer_range set) routes the LTD
-    layer segment over the kept-token subset only."""
+    layer segment over the kept-token subset only.
+    pld_theta: traced scalar keep-floor for Progressive Layer Dropping
+    (requires rng; eval passes rng=None, which disables PLD like the
+    reference's eval forward)."""
     x = params["embed"][tokens]
     x = _shard(x, DP, "seq", None)
     if cfg.variant == "gpt2":
         x = x + params["pos_embed"][: tokens.shape[1]].astype(x.dtype)
 
-    use_rng = rng is not None and _wants_rng(cfg)
-    layer_body = _make_layer_body(cfg, use_rng)
+    if rng is None:
+        pld_theta = None  # eval: keep every layer
+    use_rng = rng is not None and (_wants_rng(cfg) or pld_theta is not None)
+    layer_body = _make_layer_body(cfg, use_rng, pld_theta=pld_theta)
 
     layers = params["layers"]
     if cfg.pipeline_stages > 1:
@@ -504,7 +529,13 @@ def forward_hidden(
 
     def seg(x_in, lo, hi, body):
         lp = jax.tree.map(lambda t: t[lo:hi], layers)
-        xs = (lp, layer_rngs[lo:hi]) if use_rng else lp
+        if pld_theta is not None:
+            xs = (lp, layer_rngs[lo:hi],
+                  jnp.arange(lo, hi, dtype=jnp.float32))
+        elif use_rng:
+            xs = (lp, layer_rngs[lo:hi])
+        else:
+            xs = lp
         return jax.lax.scan(body, x_in, xs)
 
     if ltd_idx is not None and cfg.random_ltd_layer_range is not None:
@@ -518,7 +549,8 @@ def forward_hidden(
         B = x.shape[0]
         x, aux1 = seg(x, 0, a, layer_body)
         h_sub = jnp.take_along_axis(x, ltd_idx[..., None], axis=1)
-        sub_body = _make_layer_body(cfg, use_rng, positions=ltd_idx)
+        sub_body = _make_layer_body(cfg, use_rng, positions=ltd_idx,
+                                    pld_theta=pld_theta)
         h_sub, aux2 = seg(h_sub, a, b, sub_body)
         x = x.at[jnp.arange(B)[:, None], ltd_idx].set(h_sub)
         x, aux3 = seg(x, b, cfg.n_layers, layer_body)
@@ -611,6 +643,7 @@ def make_loss_fn(cfg: TransformerConfig, loss_chunks: int = 8):
         x, aux = forward_hidden(
             params, inputs, cfg, rng, with_aux=True,
             ltd_idx=batch.get("random_ltd"),
+            pld_theta=batch.get("pld_theta"),
         )
         n = _ce_chunk_count(inputs.shape[1], loss_chunks)
         loss = _token_mean_ce(x, _lm_head(params, cfg), targets, _shift_mask(batch, targets), n)

@@ -389,6 +389,15 @@ class DeepSpeedTPUEngine:
                 async_save=config.checkpoint.async_save
             )
 
+        if config.progressive_layer_drop.enabled:
+            # PLD rides the fused/offload gradient paths (theta needs the
+            # step; the worker-partial paths don't thread it)
+            if pipelined or self._onebit or self._zoadam or self._qgz:
+                raise NotImplementedError(
+                    "progressive_layer_drop does not compose with "
+                    "pipeline/1-bit/0-1-Adam/qgZ gradient paths"
+                )
+
         # curriculum learning (ref: runtime/data_pipeline/
         # curriculum_scheduler.py wired at engine.py train-batch level)
         if config.curriculum_learning.enabled:
@@ -598,6 +607,18 @@ class DeepSpeedTPUEngine:
         pipelined = self.pipelined
         qwz_apply = self._qwz_apply
         compression = self._compression
+        pld = cfg.progressive_layer_drop
+
+        def with_pld(b, step):
+            """Inject the PLD keep-floor theta(t) = (1-θ)e^{-γt}+θ (ref:
+            progressive_layer_drop.py update_state) into a batch dict —
+            computed in-graph from the step, so no per-step recompiles."""
+            if not pld.enabled:
+                return b
+            theta = (1.0 - pld.theta) * jnp.exp(
+                -pld.gamma * step.astype(jnp.float32)
+            ) + pld.theta
+            return dict(b, pld_theta=theta)
 
         if self._qgz:
             worker_acc = self._make_worker_accumulator()
@@ -629,7 +650,7 @@ class DeepSpeedTPUEngine:
                 # collective-permute program) — no outer GAS scan.
                 def scaled_loss(m):
                     p = to_model_params(m)
-                    out = loss_fn(p, batch, base_rng)
+                    out = loss_fn(p, with_pld(batch, step), base_rng)
                     l, _aux = out if has_aux else (out, None)
                     return l * scale, l
 
@@ -647,7 +668,7 @@ class DeepSpeedTPUEngine:
 
                 def scaled_loss(m):
                     p = to_model_params(m)
-                    out = loss_fn(p, micro_batch, rng)
+                    out = loss_fn(p, with_pld(micro_batch, step), rng)
                     loss, aux = out if has_aux else (out, None)
                     return loss * scale, loss
 
@@ -1274,6 +1295,9 @@ class DeepSpeedTPUEngine:
             "has_master": state_to_save.master is not None,
             "has_loss_scale": state_to_save.loss_scale is not None,
             "optimizer": self.optimizer.name,
+            # pipeline layout of the stored layer stack — what
+            # load_universal converts across (mesh changes are free)
+            "pipeline_stages": int(self.mesh.shape.get("pipe", 1)),
         }
         self.checkpoint_engine.save(save_dir, tag, state_to_save, meta)
         return tag
@@ -1287,8 +1311,20 @@ class DeepSpeedTPUEngine:
         master from params (ref: engine.py:2700 load dp/mp resize checks —
         here layout changes are free, only the master/scaler structure
         needs reconciling)."""
-        if self._offload_nvme:
-            return self._load_checkpoint_nvme(load_dir, tag)
+        scratch = None
+        if self.config.checkpoint.load_universal:
+            load_dir, tag, scratch = self._maybe_convert_universal(load_dir, tag)
+        try:
+            if self._offload_nvme:
+                return self._load_checkpoint_nvme(load_dir, tag)
+            return self._load_checkpoint_fused(load_dir, tag)
+        finally:
+            if scratch is not None:
+                import shutil
+
+                shutil.rmtree(scratch, ignore_errors=True)
+
+    def _load_checkpoint_fused(self, load_dir: str, tag: Optional[str]):
         meta_probe = self.checkpoint_engine.peek_meta(load_dir, tag)
         disk_has_master = meta_probe.get("has_master", self.state.master is not None)
         disk_has_ls = meta_probe.get("has_loss_scale", self.state.loss_scale is not None)
@@ -1378,6 +1414,31 @@ class DeepSpeedTPUEngine:
                 self.global_steps > self.optimizer.var_freeze_step
             )
         return tag, meta.get("client_state", {})
+
+    def _maybe_convert_universal(self, load_dir: str, tag: Optional[str]):
+        """checkpoint.load_universal: re-partition the stored layer stack
+        to THIS engine's pipeline degree before restore (the
+        --universal-checkpoint load, ref: ds_to_universal.py + engine
+        load_universal_checkpoint — mesh/stage/precision changes are
+        already free; the pipeline degree is the one tree change)."""
+        import tempfile
+
+        from ..utils.universal_checkpoint import convert_pipeline_layout
+
+        meta = self.checkpoint_engine.peek_meta(load_dir, tag)
+        src = int(meta.get("pipeline_stages", 1))
+        tgt = int(self.mesh.shape.get("pipe", 1))
+        if src == tgt:
+            return load_dir, tag, None
+        out_dir = tempfile.mkdtemp(prefix="ds_tpu_universal_")
+        convert_pipeline_layout(load_dir, out_dir, src, tgt, tag)
+        log_dist(
+            f"load_universal: converted pipeline layout {src}→{tgt} stages",
+            ranks=[0],
+        )
+        # caller deletes out_dir after restore (a converted checkpoint can
+        # be model-sized; leaking one per resume would fill /tmp)
+        return out_dir, tag, out_dir
 
     def _load_checkpoint_nvme(self, load_dir: str, tag: Optional[str]):
         """Restore into the NVMe tier: checkpointed master+moments go back

@@ -195,3 +195,40 @@ class TestUniversalCheckpoint:
         main([str(tmp_path / "c"), str(tmp_path / "o"),
               "--source-stages", "1", "--target-stages", "2"])
         assert "wrote converted checkpoint" in capsys.readouterr().out
+
+    def test_load_universal_auto_converts(self, tmp_path):
+        """checkpoint.load_universal=true: a flat engine loads a
+        pipeline-degree-2 checkpoint directly, conversion happening inside
+        load_checkpoint (meta carries the stored pipeline_stages)."""
+        pcfg = T.TransformerConfig(vocab_size=VOCAB, n_layers=4, n_heads=4,
+                                   d_model=64, max_seq=32, variant="llama",
+                                   use_flash=False, pipeline_stages=2)
+        fcfg = T.TransformerConfig(vocab_size=VOCAB, n_layers=4, n_heads=4,
+                                   d_model=64, max_seq=32, variant="llama",
+                                   use_flash=False)
+        common = {"train_batch_size": 16, "gradient_accumulation_steps": 4,
+                  "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                  "seed": 7, "steps_per_print": 1000}
+        pipe = ds.initialize(
+            {**common, "mesh": {"pipe": 2, "data": 4}},
+            loss_fn=T.make_pipelined_loss_fn(pcfg),
+            param_init_fn=lambda k: T.init(pcfg, k),
+            param_logical_specs=T.logical_specs(pcfg),
+            pipelined=True)
+        r = np.random.default_rng(0)
+        batches = [{"tokens": r.integers(0, VOCAB, (16, 33)).astype(np.int32)}
+                   for _ in range(5)]
+        for b in batches[:3]:
+            pipe.train_batch(b)
+        pipe.save_checkpoint(str(tmp_path / "ck"))
+        rest_pipe = [pipe.train_batch(b)["loss"] for b in batches[3:]]
+
+        flat = ds.initialize(
+            {**common, "mesh": {"data": 4, "model": 2},
+             "checkpoint": {"load_universal": True}},
+            loss_fn=T.make_loss_fn(fcfg),
+            param_init_fn=lambda k: T.init(fcfg, k),
+            param_logical_specs=T.logical_specs(fcfg))
+        flat.load_checkpoint(str(tmp_path / "ck"))  # NO manual conversion
+        rest_flat = [flat.train_batch(b)["loss"] for b in batches[3:]]
+        np.testing.assert_allclose(rest_flat, rest_pipe, rtol=2e-4)

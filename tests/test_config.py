@@ -134,7 +134,9 @@ def test_unimplemented_knobs_raise():
     import pytest as _pytest
     base = {"train_micro_batch_size_per_gpu": 1}
     for extra in (
-        {"checkpoint": {"load_universal": True}},
+        {"checkpoint": {"use_node_local_storage": True}},
+        {"zero_optimization": {"stage": 3,
+                               "zero_quantized_nontrainable_weights": True}},
         {"prescale_gradients": True},
         {"sparse_attention": {"mode": "fixed"}},
         {"data_efficiency": {"enabled": True,

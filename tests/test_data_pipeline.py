@@ -165,3 +165,72 @@ class TestRandomLTD:
     def test_truncate_to_seqlen(self):
         b = truncate_to_seqlen({"tokens": np.zeros((4, 65), np.int32)}, 16)
         assert b["tokens"].shape == (4, 17)
+
+
+class TestProgressiveLayerDrop:
+    """PLD (ref: runtime/progressive_layer_drop.py, arXiv 2010.13369)."""
+
+    def _build(self, **cfg_kw):
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.models import transformer as T
+
+        mcfg = T.TransformerConfig(vocab_size=128, n_layers=4, n_heads=4,
+                                   d_model=64, max_seq=32, variant="llama",
+                                   use_flash=False)
+        cfg = {"train_micro_batch_size_per_gpu": 2,
+               "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+               "seed": 7, "steps_per_print": 1000}
+        cfg.update(cfg_kw)
+        return ds.initialize(cfg, loss_fn=T.make_loss_fn(mcfg),
+                             param_init_fn=lambda k: T.init(mcfg, k),
+                             param_logical_specs=T.logical_specs(mcfg))
+
+    def _data(self, n=6):
+        r = np.random.default_rng(0)
+        return [{"tokens": r.integers(0, 128, (16, 33)).astype(np.int32)}
+                for _ in range(n)]
+
+    def test_gamma_zero_keeps_every_layer(self):
+        """Behavioral check of the engine's theta schedule: with gamma=0,
+        theta(t) = (1-θ)·e^0 + θ = 1 forever — keep prob 1 for every
+        layer, so the PLD engine's trajectory must EQUAL the dense
+        engine's. A sign/argument regression in the schedule breaks
+        this."""
+        batches = self._data(4)
+        dense = self._build()
+        pld = self._build(progressive_layer_drop={
+            "enabled": True, "theta": 0.5, "gamma": 0.0})
+        ld = [dense.train_batch(b)["loss"] for b in batches]
+        lp = [pld.train_batch(b)["loss"] for b in batches]
+        np.testing.assert_allclose(lp, ld, rtol=1e-6)
+
+    def test_pld_trains_and_differs_from_dense(self):
+        batches = self._data()
+        dense = self._build()
+        pld = self._build(progressive_layer_drop={
+            "enabled": True, "theta": 0.3, "gamma": 1.0})  # fast decay
+        ld = [dense.train_batch(b)["loss"] for b in batches]
+        lp = [pld.train_batch(b)["loss"] for b in batches]
+        assert all(np.isfinite(l) for l in lp)
+        assert lp[-1] < lp[0]  # still converges with dropped layers
+        # after theta decays, layers ARE being dropped -> trajectories split
+        assert any(abs(a - b) > 1e-6 for a, b in zip(ld[1:], lp[1:]))
+
+    def test_eval_keeps_all_layers(self):
+        """rng=None in eval disables PLD — eval losses are deterministic
+        and equal a dense engine's eval at identical params."""
+        pld = self._build(progressive_layer_drop={
+            "enabled": True, "theta": 0.3, "gamma": 1.0})
+        dense = self._build()
+        b = self._data(1)[0]
+        assert pld.eval_batch(b) == pld.eval_batch(b)
+        np.testing.assert_allclose(pld.eval_batch(b), dense.eval_batch(b),
+                                   rtol=1e-6)
+
+    def test_pld_incompatible_paths_raise(self):
+        import pytest as _pytest
+
+        with _pytest.raises(NotImplementedError, match="progressive"):
+            self._build(progressive_layer_drop={"enabled": True},
+                        optimizer={"type": "OneBitAdam",
+                                   "params": {"lr": 1e-3, "freeze_step": 5}})

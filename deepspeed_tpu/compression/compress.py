@@ -19,7 +19,10 @@ Supported (reference config schema, same key names):
       — structured output-row pruning
   head_pruning {dense_ratio, schedule_offset, modules}
       — attention-head pruning on [H, ...] leaves
-Activation quantization needs model-side hooks and raises for now.
+Activation quantization lives on the model
+(TransformerConfig.activation_quant_bits — applied to the normed
+activations feeding every projection, training and serving alike); the
+config block here raises with that pointer.
 
 `modules` patterns are fnmatch globs over the param path
 ("layers/w_in") — the analog of the reference's module-name matching.
@@ -119,7 +122,10 @@ def init_compression(config: Dict[str, Any]):
             .get("enabled") or (config.get("activation_quantization") or {}) \
             .get("different_groups"):
         raise NotImplementedError(
-            "activation_quantization needs in-model hooks (pending)"
+            "activation_quantization is configured on the model in "
+            "deepspeed_tpu (models are functional — there is no module to "
+            "hook): set TransformerConfig(activation_quant_bits=8); the "
+            "same fake-quant then applies in training AND serving"
         )
     for kind, key in (("sparse", "sparse_pruning"), ("row", "row_pruning"),
                       ("head", "head_pruning")):

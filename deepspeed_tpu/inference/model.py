@@ -278,7 +278,7 @@ def decode_step(
     new_k, new_v = [], []
     for l in range(cfg.n_layers):
         lp = _layer_params(params, l)
-        h = T._norm(x, lp["ln1_scale"], lp.get("ln1_bias"), cfg)
+        h = T._act_quant(T._norm(x, lp["ln1_scale"], lp.get("ln1_bias"), cfg), cfg)
         q = jnp.einsum("se,ehd->shd", h, lp["wq"].astype(x.dtype))
         k = jnp.einsum("se,ehd->shd", h, lp["wk"].astype(x.dtype))
         v = jnp.einsum("se,ehd->shd", h, lp["wv"].astype(x.dtype))
@@ -308,7 +308,7 @@ def decode_step(
             out = out + lp["bo"].astype(x.dtype)
         x = x + out
 
-        h = T._norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg)
+        h = T._act_quant(T._norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg), cfg)
         x = x + _mlp(h, lp, cfg)
 
     x = T._norm(x, params["ln_f_scale"], params.get("ln_f_bias"), cfg)
@@ -387,7 +387,7 @@ def prefill_step(
     new_k, new_v = [], []
     for l in range(cfg.n_layers):
         lp = _layer_params(params, l)
-        h = T._norm(x, lp["ln1_scale"], lp.get("ln1_bias"), cfg)
+        h = T._act_quant(T._norm(x, lp["ln1_scale"], lp.get("ln1_bias"), cfg), cfg)
         q = jnp.einsum("bse,ehd->bshd", h, lp["wq"].astype(x.dtype))
         k = jnp.einsum("bse,ehd->bshd", h, lp["wk"].astype(x.dtype))
         v = jnp.einsum("bse,ehd->bshd", h, lp["wv"].astype(x.dtype))
@@ -425,7 +425,7 @@ def prefill_step(
             out = out + lp["bo"].astype(x.dtype)
         x = x + out
 
-        h = T._norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg)
+        h = T._act_quant(T._norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg), cfg)
         x = x + _mlp(h[0], lp, cfg)[None]
 
     # logits for the last REAL token only (logits_gather): slice before

@@ -60,6 +60,12 @@ class TransformerConfig:
     sparse_num_global_blocks: int = 1
     sparse_num_random_blocks: int = 2
     dropout: float = 0.0
+    # QAT activation quantization (ref: compression/basic_layer.py
+    # LinearLayer_Compress activation_quantization — there a forward hook
+    # on every compressed linear; here symmetric per-tensor fake-quant
+    # with straight-through gradients on the normed activations feeding
+    # the attention and FFN projections). 0 disables.
+    activation_quant_bits: int = 0
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     tie_embeddings: bool = True
@@ -302,6 +308,27 @@ def _shard(x, *spec):
     return jax.lax.with_sharding_constraint(x, P(*spec))
 
 
+def _act_quant(x, cfg: TransformerConfig):
+    """Fake-quantize activations (STE) when activation_quant_bits is set
+    (ref: basic_layer.py activation quantization hooks). Applies in train
+    AND eval/serving — a QAT model's numerics include the quantizer.
+
+    The scale is PER-TOKEN (absmax over the feature dim): a token's
+    quantization grid depends only on that token, so training, prefill
+    and decode produce bit-identical quantized activations — a tensor-
+    global max would couple tokens across the batch/padding and insert a
+    cross-device reduction per layer."""
+    bits = cfg.activation_quant_bits
+    if bits <= 0:
+        return x
+    qmax = float(2 ** (bits - 1) - 1)
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / qmax, 1.0)
+    q = (jnp.clip(jnp.round(xf / scale), -qmax, qmax) * scale).astype(x.dtype)
+    return x + jax.lax.stop_gradient(q - x)
+
+
 def _dropout(x, rate: float, rng):
     """Inverted dropout (ref kernel: csrc/transformer/dropout_kernels.cu —
     on TPU this fuses into the surrounding elementwise ops)."""
@@ -313,7 +340,7 @@ def _dropout(x, rate: float, rng):
 
 def _attention_block(x, lp, cfg: TransformerConfig, rng=None, positions=None):
     B, S, E = x.shape
-    h = _norm(x, lp["ln1_scale"], lp.get("ln1_bias"), cfg)
+    h = _act_quant(_norm(x, lp["ln1_scale"], lp.get("ln1_bias"), cfg), cfg)
     q = jnp.einsum("bse,ehd->bshd", h, lp["wq"].astype(x.dtype))
     k = jnp.einsum("bse,ehd->bshd", h, lp["wk"].astype(x.dtype))
     v = jnp.einsum("bse,ehd->bshd", h, lp["wv"].astype(x.dtype))
@@ -367,7 +394,7 @@ def _mlp_block(x, lp, cfg: TransformerConfig, rng=None):
     """Dense or MoE FFN; returns (residual output, moe aux loss)."""
     if cfg.n_experts > 0:
         return _moe_mlp_block(x, lp, cfg, rng)
-    h = _norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg)
+    h = _act_quant(_norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg), cfg)
     if cfg.variant == "llama":
         gate = jnp.einsum("bse,ef->bsf", h, lp["w_gate"].astype(x.dtype))
         up = jnp.einsum("bse,ef->bsf", h, lp["w_in"].astype(x.dtype))
@@ -390,7 +417,7 @@ def _moe_mlp_block(x, lp, cfg: TransformerConfig, rng=None):
     from ..moe.sharded_moe import moe_ffn
 
     B, S, E = x.shape
-    h = _norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg)
+    h = _act_quant(_norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg), cfg)
     tokens = h.reshape(B * S, E)
 
     def expert_fn(xin):  # [X, C, E] expert-major

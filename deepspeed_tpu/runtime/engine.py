@@ -1426,7 +1426,13 @@ class DeepSpeedTPUEngine:
         from ..utils.universal_checkpoint import convert_pipeline_layout
 
         meta = self.checkpoint_engine.peek_meta(load_dir, tag)
-        src = int(meta.get("pipeline_stages", 1))
+        if "pipeline_stages" in meta:
+            src = int(meta["pipeline_stages"])
+        else:
+            # pre-meta checkpoints: infer the stored degree from the saved
+            # layer-leaf ranks (a stage-partitioned stack carries one extra
+            # leading dim vs this engine's flat layout)
+            src = self._infer_stored_pipeline_stages(load_dir, tag)
         tgt = int(self.mesh.shape.get("pipe", 1))
         if src == tgt:
             return load_dir, tag, None
@@ -1439,6 +1445,45 @@ class DeepSpeedTPUEngine:
         # caller deletes out_dir after restore (a converted checkpoint can
         # be model-sized; leaking one per resume would fill /tmp)
         return out_dir, tag, out_dir
+
+    def _infer_stored_pipeline_stages(self, load_dir: str, tag: Optional[str]) -> int:
+        """Stored pipeline degree of a checkpoint without pipeline_stages
+        meta, read from orbax array metadata (no tensor data touched).
+        Returns 1 when the layout matches this engine's (or when the
+        params tree has no 'layers' stack to compare)."""
+        import os as _os
+
+        import orbax.checkpoint as ocp
+
+        tpl_layers = (
+            self.state.params.get("layers")
+            if isinstance(self.state.params, dict) else None
+        )
+        if not tpl_layers:
+            return 1
+        try:
+            resolved = self.checkpoint_engine.resolve_tag(load_dir, tag)
+            md = ocp.Checkpointer(ocp.PyTreeCheckpointHandler()).metadata(
+                _os.path.join(_os.path.abspath(load_dir), resolved, "state")
+            )
+            # StepMetadata -> item_metadata.tree (plain dict of ArrayMetadata)
+            tree = getattr(getattr(md, "item_metadata", md), "tree", md)
+            stored_layers = tree["params"]["layers"]
+        except Exception:
+            return 1
+        # rank of a FLAT layer stack for this model ([L, ...])
+        flat_extra = 1 if self.mesh.shape.get("pipe", 1) > 1 else 0
+        for k, tpl in tpl_layers.items():
+            stored = stored_layers.get(k)
+            if stored is None:
+                continue
+            flat_rank = tpl.ndim - flat_extra
+            stored_rank = len(tuple(stored.shape))
+            if stored_rank == flat_rank + 1:
+                return int(stored.shape[0])  # [P, L/P, ...]
+            if stored_rank == flat_rank:
+                return 1
+        return 1
 
     def _load_checkpoint_nvme(self, load_dir: str, tag: Optional[str]):
         """Restore into the NVMe tier: checkpointed master+moments go back

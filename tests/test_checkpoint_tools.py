@@ -232,3 +232,43 @@ class TestUniversalCheckpoint:
         flat.load_checkpoint(str(tmp_path / "ck"))  # NO manual conversion
         rest_flat = [flat.train_batch(b)["loss"] for b in batches[3:]]
         np.testing.assert_allclose(rest_flat, rest_pipe, rtol=2e-4)
+
+    def test_load_universal_infers_degree_without_meta(self, tmp_path):
+        """Checkpoints saved before pipeline_stages meta existed: the
+        stored degree is inferred from the saved layer-leaf ranks."""
+        import json
+
+        pcfg = T.TransformerConfig(vocab_size=VOCAB, n_layers=4, n_heads=4,
+                                   d_model=64, max_seq=32, variant="llama",
+                                   use_flash=False, pipeline_stages=2)
+        fcfg = T.TransformerConfig(vocab_size=VOCAB, n_layers=4, n_heads=4,
+                                   d_model=64, max_seq=32, variant="llama",
+                                   use_flash=False)
+        common = {"train_batch_size": 16, "gradient_accumulation_steps": 4,
+                  "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                  "seed": 7, "steps_per_print": 1000}
+        pipe = ds.initialize(
+            {**common, "mesh": {"pipe": 2, "data": 4}},
+            loss_fn=T.make_pipelined_loss_fn(pcfg),
+            param_init_fn=lambda k: T.init(pcfg, k),
+            param_logical_specs=T.logical_specs(pcfg),
+            pipelined=True)
+        r = np.random.default_rng(0)
+        b = {"tokens": r.integers(0, VOCAB, (16, 33)).astype(np.int32)}
+        pipe.train_batch(b)
+        tag = pipe.save_checkpoint(str(tmp_path / "ck"))
+        pipe.checkpoint_engine.wait()
+        # strip the meta key, simulating an old checkpoint
+        mp = tmp_path / "ck" / tag / "meta.json"
+        meta = json.loads(mp.read_text())
+        meta.pop("pipeline_stages")
+        mp.write_text(json.dumps(meta))
+
+        flat = ds.initialize(
+            {**common, "gradient_accumulation_steps": 2, "mesh": {"data": 8},
+             "checkpoint": {"load_universal": True}},
+            loss_fn=T.make_loss_fn(fcfg),
+            param_init_fn=lambda k: T.init(fcfg, k),
+            param_logical_specs=T.logical_specs(fcfg))
+        flat.load_checkpoint(str(tmp_path / "ck"))
+        assert np.isfinite(flat.train_batch(b)["loss"])

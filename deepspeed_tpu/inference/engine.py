@@ -26,6 +26,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config.config import ConfigModel
 from ..models import transformer as T
@@ -38,7 +39,10 @@ class InferenceConfig(ConfigModel):
     """ref: inference/v2/ragged/manager_configs.py DSStateManagerConfig +
     RaggedInferenceEngineConfig (max_tracked_sequences,
     max_ragged_batch_size, KVCacheConfig) — flattened to what the TPU
-    engine needs."""
+    engine needs. tp_size: tensor-parallel degree (the v1 engine's
+    tensor_parallel.tp_size, ref: inference/config.py DeepSpeedTPConfig) —
+    weights shard by the training rules table, the KV cache shards over
+    its KV-head dim."""
 
     max_tracked_sequences: int = 256
     max_batch_size: int = 64          # decode sequences per step
@@ -46,6 +50,7 @@ class InferenceConfig(ConfigModel):
     kv_block_size: int = 128
     num_kv_blocks: int = 512          # total paged-cache blocks
     min_prefill_bucket: int = 64
+    tp_size: int = 1                  # tensor-parallel degree
 
     @property
     def blocks_per_seq(self) -> int:
@@ -59,6 +64,42 @@ def _bucket(n: int, lo: int) -> int:
     return b
 
 
+def _shard_serving_params(params: Any, cfg: T.TransformerConfig,
+                          mesh: Mesh) -> Any:
+    """device_put the served weight tree with the training rules table
+    (parallel/sharding.py — heads/mlp/vocab over 'model'), shape-guarded
+    per leaf so e.g. 2 GQA kv-heads under tp=8 replicate instead of
+    failing. Quantized leaves shard their int codes by the same logical
+    spec (group scales replicate — they are small and the pairing of a
+    sharded scale dim with packed codes is not worth the bookkeeping).
+    ref: inference/engine.py:331 sharded checkpoint load + AutoTP slicing
+    — here sharding is a placement, not a tensor-surgery pass."""
+    from ..parallel import sharding as Sh
+    from .quantization import QuantizedWeight
+
+    rules = Sh.make_rules()
+    specs = T.logical_specs(cfg)
+    repl = NamedSharding(mesh, P())
+
+    def put(spec, leaf):
+        if isinstance(leaf, QuantizedWeight):
+            pspec = Sh.logical_to_mesh_spec(tuple(spec), rules, mesh,
+                                            shape=leaf.q.shape)
+            return QuantizedWeight(
+                q=jax.device_put(leaf.q, NamedSharding(mesh, pspec)),
+                scale=jax.device_put(leaf.scale, repl),
+                bits=leaf.bits, dtype_name=leaf.dtype_name,
+            )
+        pspec = Sh.logical_to_mesh_spec(tuple(spec), rules, mesh,
+                                        shape=leaf.shape)
+        return jax.device_put(leaf, NamedSharding(mesh, pspec))
+
+    is_spec = lambda x: isinstance(x, tuple) and all(
+        s is None or isinstance(s, str) for s in x
+    )
+    return jax.tree.map(put, specs, params, is_leaf=is_spec)
+
+
 class InferenceEngine:
     """put/query/flush over (params, TransformerConfig)."""
 
@@ -69,13 +110,47 @@ class InferenceEngine:
         config: Optional[InferenceConfig] = None,
         dtype=jnp.bfloat16,
         quantization: Optional[Dict[str, Any]] = None,
+        mesh: Optional[Mesh] = None,
     ):
         """quantization: ZeRO-Inference weight-only PTQ, e.g.
         {"bits": 8, "group_size": 128} — weights stay int8/int4 in HBM
         and dequantize transiently inside each compiled step
-        (ref: deepspeed/inference/quantization/)."""
+        (ref: deepspeed/inference/quantization/).
+
+        mesh: explicit serving mesh; when absent and config.tp_size > 1,
+        a {'model': tp_size} mesh is built over the first tp_size devices
+        (ref: inference/engine.py:254 _create_model_parallel_group)."""
         self.cfg = model_config
         self.config = config or InferenceConfig()
+        if mesh is not None and self.config.tp_size > 1 and \
+                int(mesh.shape.get("model", 1)) != self.config.tp_size:
+            raise ValueError(
+                f"explicit mesh has model={mesh.shape.get('model', 1)} but "
+                f"config.tp_size={self.config.tp_size}; drop one of the two"
+            )
+        if mesh is None and self.config.tp_size > 1:
+            from ..platform.mesh import build_mesh
+
+            devs = jax.devices()
+            if len(devs) < self.config.tp_size:
+                raise ValueError(
+                    f"tp_size {self.config.tp_size} > {len(devs)} devices"
+                )
+            mesh = build_mesh({"model": self.config.tp_size},
+                              devices=devs[: self.config.tp_size])
+        # a mesh whose axes are all size 1 is the single-device path
+        self.mesh = (
+            mesh if mesh is not None and any(s > 1 for s in mesh.shape.values())
+            else None
+        )
+        if self.mesh is not None:
+            tp = int(self.mesh.shape.get("model", 1))
+            if model_config.n_heads % tp != 0:
+                raise ValueError(
+                    f"n_heads {model_config.n_heads} not divisible by "
+                    f"tp_size {tp} (ref AutoTP requires head divisibility, "
+                    "module_inject/auto_tp.py)"
+                )
         if model_config.attention_impl == "sparse":
             # sparse-trained models serve with the train-time block layout
             # reproduced exactly (inference/model.py _sparsity); decode
@@ -113,7 +188,8 @@ class InferenceEngine:
             max_tracked=self.config.max_tracked_sequences,
         )
         self.cache = M.init_cache(
-            model_config, self.config.num_kv_blocks, self.config.kv_block_size, dtype
+            model_config, self.config.num_kv_blocks, self.config.kv_block_size,
+            dtype, mesh=self.mesh,
         )
         self._use_kernel = jax.default_backend() == "tpu"
         self._prefill_fns: Dict[int, Any] = {}
@@ -141,16 +217,20 @@ class InferenceEngine:
             from .quantization import quantize_for_inference
 
             cast = quantize_for_inference(cast, **self._quantization)
+        if self.mesh is not None:
+            cast = _shard_serving_params(cast, self.cfg, self.mesh)
         self.params = cast
 
     # -- compiled-step caches -------------------------------------------
     def _prefill_fn(self, tp: int):
         if tp not in self._prefill_fns:
             cfg, use_kernel, deq = self.cfg, self._use_kernel, self._dequant
+            mesh = self.mesh
 
             def step(params, cache, tokens, n_real, table):
                 return M.prefill_step(
-                    deq(params), cache, tokens, n_real, table, cfg, use_kernel
+                    deq(params), cache, tokens, n_real, table, cfg, use_kernel,
+                    mesh=mesh,
                 )
 
             self._prefill_fns[tp] = jax.jit(step, donate_argnums=(1,))
@@ -159,10 +239,12 @@ class InferenceEngine:
     def _decode_fn(self, s: int):
         if s not in self._decode_fns:
             cfg, use_kernel, deq = self.cfg, self._use_kernel, self._dequant
+            mesh = self.mesh
 
             def step(params, cache, tokens, tables, ctx):
                 return M.decode_step(
-                    deq(params), cache, tokens, tables, ctx, cfg, use_kernel
+                    deq(params), cache, tokens, tables, ctx, cfg, use_kernel,
+                    mesh=mesh,
                 )
 
             self._decode_fns[s] = jax.jit(step, donate_argnums=(1,))
@@ -177,15 +259,23 @@ class InferenceEngine:
             self._decode_multi_fns = {}
         if key not in self._decode_multi_fns:
             cfg, use_kernel, deq = self.cfg, self._use_kernel, self._dequant
+            mesh = self.mesh
 
             def step(params, cache, tokens, tables, ctx):
                 return M.decode_multi(
                     deq(params), cache, tokens, tables, ctx, cfg,
-                    n_steps=n_steps, use_kernel=use_kernel,
+                    n_steps=n_steps, use_kernel=use_kernel, mesh=mesh,
                 )
 
             self._decode_multi_fns[key] = jax.jit(step, donate_argnums=(1,))
         return self._decode_multi_fns[key]
+
+    def _dev(self, x):
+        """Host array → device, replicated over the serving mesh (so the
+        compiled step's non-weight operands carry a committed sharding)."""
+        if self.mesh is None:
+            return jnp.asarray(x)
+        return jax.device_put(jnp.asarray(x), NamedSharding(self.mesh, P()))
 
     # -- scheduling queries (ref: engine_v2.py query:158/can_schedule:184)
     def query(self, uid: int) -> Dict[str, int]:
@@ -268,8 +358,8 @@ class InferenceEngine:
             padded = np.zeros((tp,), np.int32)
             padded[:n] = toks
             logits, self.cache = self._prefill_fn(tp)(
-                self.params, self.cache, jnp.asarray(padded),
-                jnp.int32(n), jnp.asarray(table),
+                self.params, self.cache, self._dev(padded),
+                self._dev(np.int32(n)), self._dev(table),
             )
             self.state.commit(uid, n)
             out[pos] = np.asarray(logits)
@@ -294,8 +384,8 @@ class InferenceEngine:
                     row += 1
                 last_row.append(row - 1)
             logits, self.cache = self._decode_fn(sp)(
-                self.params, self.cache, jnp.asarray(toks),
-                jnp.asarray(tables), jnp.asarray(ctx),
+                self.params, self.cache, self._dev(toks),
+                self._dev(tables), self._dev(ctx),
             )
             logits = np.asarray(logits[:n_rows])
             for (pos, uid, chunk), lr in zip(decodes, last_row):
@@ -358,11 +448,26 @@ def init_inference(
     config: Optional[Dict[str, Any]] = None,
     dtype=jnp.bfloat16,
     quantization: Optional[Dict[str, Any]] = None,
+    mesh: Optional[Mesh] = None,
 ) -> InferenceEngine:
     """Build the inference engine (ref: deepspeed/__init__.py
     init_inference:268 → InferenceEngine; config keys follow
     InferenceConfig). quantization={"bits": 8|4, "group_size": N}
-    enables ZeRO-Inference weight-only PTQ."""
-    icfg = InferenceConfig(**(config or {}))
+    enables ZeRO-Inference weight-only PTQ.
+
+    Tensor parallelism: pass an explicit mesh, config["tp_size"]=N, or
+    the reference's spelling config["tensor_parallel"]={"tp_size": N}
+    (ref: inference/config.py DeepSpeedTPConfig)."""
+    cfg = dict(config or {})
+    tp = cfg.pop("tensor_parallel", None)
+    if tp is not None:
+        if isinstance(tp, dict):
+            size = int(tp.get("tp_size", 1))
+            if not tp.get("enabled", True):
+                size = 1
+        else:
+            size = int(tp)
+        cfg.setdefault("tp_size", size)
+    icfg = InferenceConfig(**cfg)
     return InferenceEngine(model_config, params, icfg, dtype,
-                           quantization=quantization)
+                           quantization=quantization, mesh=mesh)

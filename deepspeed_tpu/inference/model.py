@@ -20,10 +20,11 @@ the arena is updated in place.
 """
 
 from functools import partial
-from typing import Any, Dict, List, NamedTuple, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import transformer as T
 from ..ops.attention import causal_attention
@@ -32,6 +33,65 @@ from ..ops.pallas.paged_attention import (
     paged_decode_attention_xla,
     paged_kv_write,
 )
+
+
+# ---------------------------------------------------------------------------
+# tensor-parallel serving helpers
+#
+# The reference's inference engine is TP-first: it builds an mp group and
+# row/col-slices every Linear (ref: inference/engine.py:254
+# _create_model_parallel_group; v2 sharding helpers
+# inference/v2/model_implementations/sharding/qkv.py). TPU-native, TP is
+# a mesh 'model' axis: weights carry the SAME logical specs as training
+# (models/transformer.logical_specs + parallel/sharding rules), the paged
+# KV cache shards over its KV-head dim, and XLA inserts the Megatron
+# collectives (psum after the row-parallel wo/w_out matmuls). The only
+# ops XLA cannot partition are the Pallas custom calls — those run under
+# shard_map over the head dims, which the cache layout was designed for
+# ("TP shards the KV dim", ops/pallas/paged_attention.py:15).
+# ---------------------------------------------------------------------------
+
+
+def _tp_size(mesh: Optional[Mesh]) -> int:
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get("model", 1))
+
+
+def _heads_shardable(mesh: Optional[Mesh], cfg: T.TransformerConfig) -> bool:
+    """Pallas kernels may run per-shard only when Q and KV heads both
+    split evenly over 'model' (contiguous-block GQA grouping then stays
+    device-local: local q group g pairs with local kv head g)."""
+    tp = _tp_size(mesh)
+    return tp > 1 and cfg.n_heads % tp == 0 and cfg.kv_heads % tp == 0
+
+
+def _cons(x, mesh: Optional[Mesh], *spec):
+    """with_sharding_constraint, shape-guarded: any dim whose mesh-axis
+    product does not divide it falls back to replicated."""
+    if mesh is None:
+        return x
+    out = []
+    for i, ax in enumerate(spec):
+        if ax is None:
+            out.append(None)
+            continue
+        size = mesh.shape.get(ax, 1)
+        out.append(ax if size > 1 and x.shape[i] % size == 0 else None)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*out)))
+
+
+def cache_pspec(mesh: Optional[Mesh], kv_heads: int) -> P:
+    """PartitionSpec for one [NBLK, bs, KV, D] cache arena."""
+    tp = _tp_size(mesh)
+    if tp > 1 and kv_heads % tp == 0:
+        return P(None, None, "model", None)
+    return P()
+
+
+def _shard_map_kernel(fn, mesh: Mesh, in_specs, out_specs):
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
 
 
 class PagedCache(NamedTuple):
@@ -50,14 +110,17 @@ class PagedCache(NamedTuple):
 
 
 def init_cache(
-    cfg: T.TransformerConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16
+    cfg: T.TransformerConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16,
+    mesh: Optional[Mesh] = None,
 ) -> PagedCache:
     KV, D, L = cfg.kv_heads, cfg.head_dim, cfg.n_layers
     shape = (num_blocks, block_size, KV, D)
-    return PagedCache(
-        k=[jnp.zeros(shape, dtype) for _ in range(L)],
-        v=[jnp.zeros(shape, dtype) for _ in range(L)],
-    )
+    if mesh is not None:
+        sharding = NamedSharding(mesh, cache_pspec(mesh, KV))
+        mk = lambda: jax.device_put(jnp.zeros(shape, dtype), sharding)
+    else:
+        mk = lambda: jnp.zeros(shape, dtype)
+    return PagedCache(k=[mk() for _ in range(L)], v=[mk() for _ in range(L)])
 
 
 def _rope_at(x, positions, cfg: T.TransformerConfig):
@@ -80,11 +143,22 @@ def _flat_slot_index(positions, block_table, block_size):
     return block_table[positions // block_size] * block_size + positions % block_size
 
 
-def _write_kv(cache_k, cache_v, k_new, v_new, flat_idx):
-    """Write [T, KV, D] new KV into [KV, NBLK, bs, D] caches at flat
+def _write_kv(cache_k, cache_v, k_new, v_new, flat_idx, mesh=None):
+    """Write [T, KV, D] new KV into [NBLK, bs, KV, D] caches at flat
     slots [T] via the Pallas RMW kernel — XLA scatter costs a fixed ~3ms
     per call on TPU (docs/PROFILE_r02.md), which at 2/layer dominated
-    the decode step."""
+    the decode step. Under a TP mesh with the KV dim sharded, each device
+    RMWs its own KV slice (shard_map; slots are replicated)."""
+    KV = cache_k.shape[2]
+    tp = _tp_size(mesh)
+    if tp > 1 and KV % tp == 0:
+        kv = P(None, None, "model", None)
+        new = P(None, "model", None)
+        return _shard_map_kernel(
+            paged_kv_write, mesh,
+            in_specs=(kv, kv, new, new, P(None)),
+            out_specs=(kv, kv),
+        )(cache_k, cache_v, k_new, v_new, flat_idx)
     return paged_kv_write(cache_k, cache_v, k_new, v_new, flat_idx)
 
 
@@ -233,7 +307,7 @@ def _mlp(h, lp, cfg: T.TransformerConfig):
 
 
 def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None,
-                      window: int = 0):
+                      window: int = 0, mesh=None):
     if allowed is not None:
         # block-sparse serving runs the XLA path: the Pallas decode kernel
         # does not take an arbitrary layout mask. (window is passed through
@@ -241,8 +315,23 @@ def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None,
         # both masks never actually combine today.)
         return paged_decode_attention_xla(q, ck, cv, table, ctx,
                                           allowed=allowed, window=window)
-    if use_kernel:
+    tp = _tp_size(mesh)
+    H, KV = q.shape[1], ck.shape[2]
+    if tp > 1 and H % tp == 0 and KV % tp == 0:
+        # heads are device-local: run the kernel (or its oracle) per shard
+        fn = partial(paged_decode_attention if use_kernel
+                     else paged_decode_attention_xla, window=window)
+        qs = P(None, "model", None)
+        kv = P(None, None, "model", None)
+        return _shard_map_kernel(
+            fn, mesh,
+            in_specs=(qs, kv, kv, P(None, None), P(None)),
+            out_specs=qs,
+        )(q, ck, cv, table, ctx)
+    if use_kernel and tp <= 1:
         return paged_decode_attention(q, ck, cv, table, ctx, window=window)
+    # under a TP mesh with non-divisible heads, the XLA path lets SPMD
+    # partition freely (a raw pallas_call over sharded operands cannot)
     return paged_decode_attention_xla(q, ck, cv, table, ctx, window=window)
 
 
@@ -252,13 +341,15 @@ def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None,
 
 def decode_step(
     params, cache: PagedCache, tokens, tables, ctx_lens, cfg: T.TransformerConfig,
-    use_kernel: bool = True,
+    use_kernel: bool = True, mesh: Optional[Mesh] = None,
 ):
     """tokens [S] int32, tables [S, NB] int32, ctx_lens [S] int32 (context
     length INCLUDING the new token) → (logits [S, V], new cache).
 
     ref: engine_v2.py put→model.forward decode path; one compiled program
-    per (S, NB) shape."""
+    per (S, NB) shape. mesh: TP serving — params/cache arrive sharded
+    over 'model' and constraints keep activations head-sharded between
+    the column-parallel QKV and row-parallel output projections."""
     S = tokens.shape[0]
     E, KV, D, bs = cfg.d_model, cfg.kv_heads, cfg.head_dim, cache.block_size
     # rows with ctx_lens == 0 are batch padding: their KV write is dropped
@@ -289,6 +380,9 @@ def decode_step(
         else:
             q = _rope_at(q, positions, cfg)
             k = _rope_at(k, positions, cfg)
+        q = _cons(q, mesh, None, "model", None)
+        k = _cons(k, mesh, None, "model", None)
+        v = _cons(v, mesh, None, "model", None)
 
         # per-row flat slot: each row has its own table; padding rows
         # scatter to -1 which mode="drop" discards
@@ -297,12 +391,15 @@ def decode_step(
             * bs + positions % bs
         )
         flat_idx = jnp.where(valid, flat_idx, jnp.int32(-1))
-        ck, cv = _write_kv(cache.k[l], cache.v[l], k, v, flat_idx)
+        ck, cv = _write_kv(cache.k[l], cache.v[l], k, v, flat_idx, mesh)
+        ck = _cons(ck, mesh, None, None, "model", None)
+        cv = _cons(cv, mesh, None, None, "model", None)
         new_k.append(ck)
         new_v.append(cv)
 
         att = _decode_attention(q, ck, cv, tables, ctx_lens, use_kernel,
-                                allowed=allowed, window=cfg.sliding_window)
+                                allowed=allowed, window=cfg.sliding_window,
+                                mesh=mesh)
         out = jnp.einsum("shd,hde->se", att, lp["wo"].astype(x.dtype))
         if cfg.variant == "gpt2":
             out = out + lp["bo"].astype(x.dtype)
@@ -314,12 +411,14 @@ def decode_step(
     x = T._norm(x, params["ln_f_scale"], params.get("ln_f_bias"), cfg)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("se,ev->sv", x, head.astype(x.dtype))
-    return logits.astype(jnp.float32), PagedCache(k=new_k, v=new_v)
+    logits = _cons(logits.astype(jnp.float32), mesh, None, None)
+    return logits, PagedCache(k=new_k, v=new_v)
 
 
 def decode_multi(
     params, cache: PagedCache, tokens, tables, ctx_lens,
     cfg: T.TransformerConfig, n_steps: int, use_kernel: bool = True,
+    mesh: Optional[Mesh] = None,
 ):
     """Fused greedy decode: n_steps tokens per compiled program.
 
@@ -337,7 +436,8 @@ def decode_multi(
 
     def body(carry, _):
         toks, ctx, _, cache = carry
-        logits, cache = decode_step(params, cache, toks, tables, ctx, cfg, use_kernel)
+        logits, cache = decode_step(params, cache, toks, tables, ctx, cfg,
+                                    use_kernel, mesh=mesh)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         # logits ride the CARRY (overwritten per step): stacking them in ys
         # would keep a dead [n_steps, S, V] accumulator live in HBM
@@ -356,7 +456,7 @@ def decode_multi(
 
 def prefill_step(
     params, cache: PagedCache, tokens, n_real, table, cfg: T.TransformerConfig,
-    use_kernel: bool = True,
+    use_kernel: bool = True, mesh: Optional[Mesh] = None,
 ):
     """tokens [Tp] int32 (padded), n_real scalar int32, table [NB] int32 →
     (last-token logits [V], new cache).
@@ -398,8 +498,13 @@ def prefill_step(
         else:
             q = _rope_at(q[0], positions, cfg)[None]
             k = _rope_at(k[0], positions, cfg)[None]
+        q = _cons(q, mesh, None, None, "model", None)
+        k = _cons(k, mesh, None, None, "model", None)
+        v = _cons(v, mesh, None, None, "model", None)
 
-        ck, cv = _write_kv(cache.k[l], cache.v[l], k[0], v[0], flat_idx)
+        ck, cv = _write_kv(cache.k[l], cache.v[l], k[0], v[0], flat_idx, mesh)
+        ck = _cons(ck, mesh, None, None, "model", None)
+        cv = _cons(cv, mesh, None, None, "model", None)
         new_k.append(ck)
         new_v.append(cv)
 
@@ -416,10 +521,21 @@ def prefill_step(
         elif sparse_mask is not None:
             # bucket shorter than a layout block: dense-with-mask fallback
             att = _masked_causal_attention(q, k, v, sparse_mask)
+        elif _heads_shardable(mesh, cfg):
+            # flash kernel per head-shard; GQA grouping stays device-local
+            hs = P(None, None, "model", None)
+            att = _shard_map_kernel(
+                partial(causal_attention,
+                        use_flash=use_kernel and cfg.use_flash,
+                        window=cfg.sliding_window),
+                mesh, in_specs=(hs, hs, hs), out_specs=hs,
+            )(q, k, v)
         else:
-            att = causal_attention(q, k, v,
-                                   use_flash=use_kernel and cfg.use_flash,
-                                   window=cfg.sliding_window)
+            att = causal_attention(
+                q, k, v,
+                # a raw pallas_call cannot consume TP-sharded operands
+                use_flash=use_kernel and cfg.use_flash and _tp_size(mesh) <= 1,
+                window=cfg.sliding_window)
         out = jnp.einsum("bshd,hde->bse", att, lp["wo"].astype(x.dtype))
         if cfg.variant == "gpt2":
             out = out + lp["bo"].astype(x.dtype)
@@ -434,4 +550,5 @@ def prefill_step(
     x_last = T._norm(x_last, params["ln_f_scale"], params.get("ln_f_bias"), cfg)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("se,ev->sv", x_last, head.astype(x_last.dtype))[0]
-    return logits.astype(jnp.float32), PagedCache(k=new_k, v=new_v)
+    logits = _cons(logits.astype(jnp.float32), mesh, None)
+    return logits, PagedCache(k=new_k, v=new_v)

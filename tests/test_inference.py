@@ -546,6 +546,93 @@ class TestSlidingWindowServing:
                 rtol=2e-2, atol=2e-2)
 
 
+class TestTensorParallelServing:
+    """Mesh-sharded (TP) serving vs the single-device engine
+    (ref: inference/engine.py:254 _create_model_parallel_group +
+    v2 sharding helpers model_implementations/sharding/qkv.py — here the
+    mesh 'model' axis + the training rules table do the slicing)."""
+
+    def _pair(self, rng, tp, variant="llama", quant=None, **kw):
+        cfg, params = small_model(variant, n_heads=8, **kw)
+        base = engine_for(cfg, params)
+        tpe = init_inference(
+            params, cfg,
+            dict(max_seq_len=64, kv_block_size=8, num_kv_blocks=32,
+                 min_prefill_bucket=8, max_batch_size=8,
+                 tensor_parallel={"tp_size": tp}),
+            dtype=jnp.float32, quantization=quant)
+        return cfg, base, tpe
+
+    def test_weights_and_cache_actually_sharded(self, rng):
+        _, _, tpe = self._pair(rng, tp=4, n_kv_heads=4)
+        wq = tpe.params["layers"]["wq"]
+        assert "model" in tuple(wq.sharding.spec), wq.sharding
+        # per-device shard is H/tp of the heads dim
+        shard_shape = wq.sharding.shard_shape(wq.shape)
+        assert shard_shape[2] == wq.shape[2] // 4
+        ck = tpe.cache.k[0]
+        assert "model" in tuple(ck.sharding.spec), ck.sharding
+        assert ck.sharding.shard_shape(ck.shape)[2] == ck.shape[2] // 4
+
+    @pytest.mark.parametrize("tp,kw", [
+        (4, {"n_kv_heads": 4}),   # full KV shard
+        (8, {"n_kv_heads": 2}),   # GQA kv < tp: KV replicates, heads shard
+        (2, {}),                  # MHA
+    ])
+    def test_logits_match_single_device(self, rng, tp, kw):
+        cfg, base, tpe = self._pair(rng, tp=tp, **kw)
+        prompts = [np.asarray(rng.integers(0, 128, 11), np.int32),
+                   np.asarray(rng.integers(0, 128, 5), np.int32)]
+        l1 = base.put([0, 1], [p.copy() for p in prompts])
+        l2 = tpe.put([0, 1], [p.copy() for p in prompts])
+        np.testing.assert_allclose(l1, l2, rtol=2e-5, atol=2e-5)
+        for _ in range(4):
+            nxt = np.argmax(l1, -1)
+            assert (np.argmax(l2, -1) == nxt).all()
+            l1 = base.put([0, 1], [nxt[0:1], nxt[1:2]])
+            l2 = tpe.put([0, 1], [nxt[0:1], nxt[1:2]])
+            np.testing.assert_allclose(l1, l2, rtol=2e-5, atol=2e-5)
+
+    def test_tp_generate_matches(self, rng):
+        cfg, base, tpe = self._pair(rng, tp=4, n_kv_heads=4)
+        prompts = [list(rng.integers(0, 128, 7)), list(rng.integers(0, 128, 3))]
+        assert base.generate(prompts, max_new_tokens=6) == tpe.generate(
+            prompts, max_new_tokens=6)
+
+    def test_tp_gpt2_matches(self, rng):
+        cfg, base, tpe = self._pair(rng, tp=4, variant="gpt2")
+        prompts = [list(rng.integers(0, 128, 7))]
+        assert base.generate(prompts, max_new_tokens=5) == tpe.generate(
+            prompts, max_new_tokens=5)
+
+    def test_tp_moe_matches(self, rng):
+        cfg, base, tpe = self._pair(rng, tp=4, n_experts=4, moe_top_k=2)
+        prompts = [list(rng.integers(0, 128, 9))]
+        assert base.generate(prompts, max_new_tokens=5) == tpe.generate(
+            prompts, max_new_tokens=5)
+
+    def test_tp_quantized_matches_tp_ptq(self, rng):
+        """TP x ZeRO-Inference PTQ: the int codes shard like the weight."""
+        cfg, base, tpe = self._pair(rng, tp=4, n_kv_heads=4,
+                                    quant={"bits": 8, "group_size": 16})
+        qbase = init_inference(
+            base.params, cfg,
+            dict(max_seq_len=64, kv_block_size=8, num_kv_blocks=32,
+                 min_prefill_bucket=8, max_batch_size=8),
+            dtype=jnp.float32, quantization={"bits": 8, "group_size": 16})
+        wq = tpe.params["layers"]["wq"]
+        assert "model" in tuple(wq.q.sharding.spec)
+        prompts = [np.asarray(rng.integers(0, 128, 9), np.int32)]
+        l1 = qbase.put([0], [prompts[0].copy()])
+        l2 = tpe.put([0], [prompts[0].copy()])
+        np.testing.assert_allclose(l1, l2, rtol=2e-4, atol=2e-4)
+
+    def test_heads_not_divisible_raises(self, rng):
+        cfg, params = small_model(n_heads=6, d_model=96)
+        with pytest.raises(ValueError, match="divisible"):
+            init_inference(params, cfg, dict(tp_size=4))
+
+
 def test_empty_token_array_raises(rng):
     cfg, params = small_model()
     eng = engine_for(cfg, params)

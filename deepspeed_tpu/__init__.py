@@ -70,3 +70,19 @@ def init_inference(*args, **kwargs):
     from .inference.engine import init_inference as _init_inference
 
     return _init_inference(*args, **kwargs)
+
+
+def init_inference_from_hf(*args, **kwargs):
+    """Serve an HF-format checkpoint directory (build_hf_engine analog,
+    ref: inference/v2/engine_factory.py:67)."""
+    from .inference.engine import init_inference_from_hf as _f
+
+    return _f(*args, **kwargs)
+
+
+def import_external(*args, **kwargs):
+    """HF-format checkpoint → (TransformerConfig, host params tree)
+    (ref: inference/v2/checkpoint/huggingface_engine.py)."""
+    from .utils.hf_checkpoint import import_external as _f
+
+    return _f(*args, **kwargs)

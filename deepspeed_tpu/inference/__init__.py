@@ -1,10 +1,16 @@
-from .engine import InferenceConfig, InferenceEngine, init_inference
+from .engine import (
+    InferenceConfig,
+    InferenceEngine,
+    init_inference,
+    init_inference_from_hf,
+)
 from .ragged import BlockedAllocator, SequenceDescriptor, StateManager
 
 __all__ = [
     "InferenceConfig",
     "InferenceEngine",
     "init_inference",
+    "init_inference_from_hf",
     "BlockedAllocator",
     "SequenceDescriptor",
     "StateManager",

@@ -77,27 +77,29 @@ def _shard_serving_params(params: Any, cfg: T.TransformerConfig,
     from ..parallel import sharding as Sh
     from .quantization import QuantizedWeight
 
-    rules = Sh.make_rules()
+    is_qw = lambda x: isinstance(x, QuantizedWeight)
     specs = T.logical_specs(cfg)
+    # shape-guard against the ARRAY actually placed (int4 codes pack the
+    # last dim 2-per-byte, so the guard must see the packed shape)
+    shapes = jax.tree.map(
+        lambda leaf: leaf.q.shape if is_qw(leaf) else leaf.shape,
+        params, is_leaf=is_qw,
+    )
+    pspecs = Sh.tree_logical_to_mesh(specs, Sh.make_rules(), mesh,
+                                     shapes=shapes)
     repl = NamedSharding(mesh, P())
 
-    def put(spec, leaf):
-        if isinstance(leaf, QuantizedWeight):
-            pspec = Sh.logical_to_mesh_spec(tuple(spec), rules, mesh,
-                                            shape=leaf.q.shape)
+    def put(pspec, leaf):
+        if is_qw(leaf):
             return QuantizedWeight(
                 q=jax.device_put(leaf.q, NamedSharding(mesh, pspec)),
                 scale=jax.device_put(leaf.scale, repl),
                 bits=leaf.bits, dtype_name=leaf.dtype_name,
             )
-        pspec = Sh.logical_to_mesh_spec(tuple(spec), rules, mesh,
-                                        shape=leaf.shape)
         return jax.device_put(leaf, NamedSharding(mesh, pspec))
 
-    is_spec = lambda x: isinstance(x, tuple) and all(
-        s is None or isinstance(s, str) for s in x
-    )
-    return jax.tree.map(put, specs, params, is_leaf=is_spec)
+    return jax.tree.map(put, pspecs, params,
+                        is_leaf=lambda x: isinstance(x, P))
 
 
 class InferenceEngine:
@@ -467,7 +469,31 @@ def init_inference(
                 size = 1
         else:
             size = int(tp)
-        cfg.setdefault("tp_size", size)
+        if "tp_size" in cfg and int(cfg["tp_size"]) != size:
+            raise ValueError(
+                f"conflicting tensor_parallel ({size}) and tp_size "
+                f"({cfg['tp_size']}) in the inference config; drop one"
+            )
+        cfg["tp_size"] = size
     icfg = InferenceConfig(**cfg)
     return InferenceEngine(model_config, params, icfg, dtype,
                            quantization=quantization, mesh=mesh)
+
+
+def init_inference_from_hf(
+    path: str,
+    config: Optional[Dict[str, Any]] = None,
+    dtype=jnp.bfloat16,
+    quantization: Optional[Dict[str, Any]] = None,
+    mesh: Optional[Mesh] = None,
+    **config_overrides,
+) -> InferenceEngine:
+    """Serve an HF-format checkpoint directory: import + init_inference
+    (the build_hf_engine analog, ref: inference/v2/engine_factory.py:67).
+    config_overrides adjust the derived TransformerConfig (e.g.
+    attention_impl, use_flash)."""
+    from ..utils.hf_checkpoint import import_external
+
+    model_cfg, params = import_external(path, **config_overrides)
+    return init_inference(params, model_cfg, config, dtype,
+                          quantization=quantization, mesh=mesh)

@@ -159,6 +159,12 @@ def _write_kv(cache_k, cache_v, k_new, v_new, flat_idx, mesh=None):
             in_specs=(kv, kv, new, new, P(None)),
             out_specs=(kv, kv),
         )(cache_k, cache_v, k_new, v_new, flat_idx)
+    if tp > 1:
+        # KV not divisible: cache/k/v are replicated, but a raw
+        # pallas_call cannot run under the multi-device program — use the
+        # XLA scatter (SPMD partitions it; the ~3ms scatter cost returns
+        # only on this degenerate kv_heads % tp != 0 layout)
+        return _write_kv_xla(cache_k, cache_v, k_new, v_new, flat_idx)
     return paged_kv_write(cache_k, cache_v, k_new, v_new, flat_idx)
 
 

@@ -399,16 +399,71 @@ class InferenceEngine:
         """Free a sequence's KV blocks (ref: engine_v2.py flush:242)."""
         self.state.flush(uid)
 
+    # -- sampling (v1 generate inherits full HF sampling; here the same
+    # -- knobs applied host-side over put() logits, ref:
+    # -- inference/engine.py:613 generate → HF LogitsProcessor chain)
+    @staticmethod
+    def sample_token(
+        logits: np.ndarray,
+        *,
+        temperature: float = 1.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        repetition_penalty: float = 1.0,
+        seen_tokens: Sequence[int] = (),
+        rng: Optional[np.random.Generator] = None,
+    ) -> int:
+        """One next-token draw from a [V] float logits row.
+
+        temperature <= 0 is greedy argmax. top_k/top_p filter before the
+        softmax draw (both may combine). repetition_penalty follows the
+        CTRL rule the reference inherits from HF: a seen token's logit is
+        divided by the penalty when positive, multiplied when negative.
+        """
+        row = np.asarray(logits, np.float64).copy()
+        if repetition_penalty != 1.0 and len(seen_tokens):
+            idx = np.unique(np.asarray(list(seen_tokens), np.int64))
+            pos = row[idx] > 0
+            row[idx] = np.where(pos, row[idx] / repetition_penalty,
+                                row[idx] * repetition_penalty)
+        if temperature <= 0.0:
+            return int(np.argmax(row))
+        row = row / temperature
+        if top_k and 0 < top_k < row.size:
+            kth = np.partition(row, -top_k)[-top_k]
+            row[row < kth] = -np.inf
+        if 0.0 < top_p < 1.0:
+            order = np.argsort(row)[::-1]
+            probs = np.exp(row[order] - row[order[0]])
+            probs /= probs.sum()
+            keep = np.cumsum(probs) - probs < top_p  # always keep top-1
+            row[order[~keep]] = -np.inf
+        probs = np.exp(row - row.max())
+        probs /= probs.sum()
+        gen = rng if rng is not None else np.random.default_rng()
+        return int(gen.choice(row.size, p=probs))
+
     # -- convenience generation (v1 engine.generate parity) --------------
     def generate(
         self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 32,
         eos_token_id: Optional[int] = None,
+        do_sample: bool = False,
+        temperature: float = 1.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        repetition_penalty: float = 1.0,
+        seed: Optional[int] = None,
     ) -> List[List[int]]:
-        """Greedy continuous-batch generation; returns new tokens per
-        prompt (ref: inference/engine.py generate:613 — here generation
-        drives put() exactly as the MII serving loop drives FastGen).
-        uids are allocated disjoint from in-flight sequences so calling
-        generate() never hijacks another caller's context."""
+        """Continuous-batch generation; returns new tokens per prompt
+        (ref: inference/engine.py generate:613 — here generation drives
+        put() exactly as the MII serving loop drives FastGen).
+
+        do_sample=False is greedy argmax (v1 default). Sampling applies
+        temperature/top-k/top-p/repetition-penalty host-side over the
+        returned logits, with an independent per-sequence stream seeded
+        from `seed` so a batch draw is reproducible regardless of batch
+        composition. uids are allocated disjoint from in-flight sequences
+        so calling generate() never hijacks another caller's context."""
         taken = set(self.state.tracked_uids)
         uids, cand = [], 0
         while len(uids) < len(prompts):
@@ -417,15 +472,31 @@ class InferenceEngine:
             cand += 1
         slot_of = {u: i for i, u in enumerate(uids)}
         outs: List[List[int]] = [[] for _ in prompts]
+        seen = {u: list(prompts[slot_of[u]]) for u in uids}
+        rngs = {
+            u: np.random.default_rng(None if seed is None else seed + i)
+            for i, u in enumerate(uids)
+        }
+
+        def pick(u: int, row: np.ndarray) -> int:
+            if not do_sample:
+                return int(np.argmax(row))
+            return self.sample_token(
+                row, temperature=temperature, top_k=top_k, top_p=top_p,
+                repetition_penalty=repetition_penalty,
+                seen_tokens=seen[u], rng=rngs[u],
+            )
+
         live = set(uids)
         logits = self.put(uids, [np.asarray(p, np.int32) for p in prompts])
-        nxt = {u: int(np.argmax(logits[i])) for i, u in enumerate(uids)}
+        nxt = {u: pick(u, logits[i]) for i, u in enumerate(uids)}
         while True:
             batch_uids = sorted(live)
             if not batch_uids:
                 break
             for u in batch_uids:
                 outs[slot_of[u]].append(nxt[u])
+                seen[u].append(nxt[u])
             done = {
                 u for u in batch_uids
                 if (eos_token_id is not None and nxt[u] == eos_token_id)
@@ -437,7 +508,7 @@ class InferenceEngine:
             if not batch_uids:
                 break
             logits = self.put(batch_uids, [np.asarray([nxt[u]]) for u in batch_uids])
-            nxt = {u: int(np.argmax(logits[i])) for i, u in enumerate(batch_uids)}
+            nxt = {u: pick(u, logits[i]) for i, u in enumerate(batch_uids)}
         for u in uids:
             if self.state.get(u) is not None:
                 self.flush(u)

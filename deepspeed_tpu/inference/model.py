@@ -125,9 +125,10 @@ def init_cache(
 
 def _rope_at(x, positions, cfg: T.TransformerConfig):
     """Rotary embedding at per-token positions [T] (decode needs a
-    different position per row, unlike training's contiguous offset)."""
-    D = cfg.head_dim
-    freqs = cfg.rope_theta ** (-jnp.arange(0, D // 2, dtype=jnp.float32) / (D // 2))
+    different position per row, unlike training's contiguous offset).
+    Frequencies come from T.rope_inv_freq so long-context scaling
+    (linear / llama3) matches the training forward exactly."""
+    freqs = T.rope_inv_freq(cfg)
     angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [T, D/2]
     cos, sin = jnp.cos(angles), jnp.sin(angles)
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)  # [T, H, D/2]

@@ -88,8 +88,24 @@ class TransformerConfig:
     # batch-supplied 'random_ltd' token subset; dropped tokens skip them
     # and are re-inserted in order. None disables.
     random_ltd_layer_range: Optional[Tuple[int, int]] = None
+    # RoPE frequency scaling for long-context checkpoints (HF
+    # rope_scaling): "none" | "linear" (positions / factor) | "llama3"
+    # (NTK-style per-band wavelength remap, the Llama-3.x rule).
+    rope_scaling_type: str = "none"
+    rope_scaling_factor: float = 1.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_seq: int = 8192
+    # Explicit head dim for families where head_dim != d_model / n_heads
+    # (Mistral-Nemo / Gemma-class); None derives it.
+    head_dim_override: Optional[int] = None
 
     def __post_init__(self):
+        if self.rope_scaling_type not in ("none", "linear", "llama3"):
+            raise ValueError(
+                f"unsupported rope_scaling_type '{self.rope_scaling_type}' "
+                "(supported: none|linear|llama3)"
+            )
         if self.attention_impl not in ("ulysses", "ring", "sparse"):
             raise ValueError(
                 f"unknown attention_impl '{self.attention_impl}' "
@@ -110,6 +126,8 @@ class TransformerConfig:
 
     @property
     def head_dim(self) -> int:
+        if self.head_dim_override is not None:
+            return self.head_dim_override
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
 
@@ -256,19 +274,42 @@ def _norm(x, scale, bias, cfg: TransformerConfig):
     return out.astype(x.dtype)
 
 
+def rope_inv_freq(cfg: TransformerConfig) -> jnp.ndarray:
+    """Per-band rotary frequencies [D/2], with long-context scaling.
+
+    "linear" divides every frequency by the factor (position
+    interpolation); "llama3" is the Llama-3.x NTK-by-parts rule — long
+    wavelengths compress by the factor, short ones keep full resolution,
+    the middle band interpolates (HF rope_scaling 'llama3' semantics)."""
+    D = cfg.head_dim
+    inv = cfg.rope_theta ** (-jnp.arange(0, D // 2, dtype=jnp.float32) / (D // 2))
+    if cfg.rope_scaling_type == "linear":
+        return inv / cfg.rope_scaling_factor
+    if cfg.rope_scaling_type == "llama3":
+        factor = cfg.rope_scaling_factor
+        lo, hi = cfg.rope_low_freq_factor, cfg.rope_high_freq_factor
+        old = cfg.rope_original_max_seq
+        wavelen = 2.0 * jnp.pi / inv
+        scaled = jnp.where(wavelen > old / lo, inv / factor, inv)
+        smooth = (old / wavelen - lo) / (hi - lo)
+        smoothed = (1.0 - smooth) / factor * inv + smooth * inv
+        mid = (wavelen >= old / hi) & (wavelen <= old / lo)
+        return jnp.where(mid, smoothed, scaled)
+    return inv
+
+
 def _rope(q, k, cfg: TransformerConfig, offset: int = 0, positions=None):
     """Rotary embeddings (ref kernel: csrc/transformer/inference/csrc/
     apply_rotary_pos_emb.cu — on TPU this is pure VPU code XLA fuses).
 
     positions: optional [B, S] token positions (random-LTD subsets keep
     their ORIGINAL positions, ref: basic_layer.py position handling)."""
-    D = cfg.head_dim
     S = q.shape[1]
     if positions is None:
         pos = jnp.arange(offset, offset + S, dtype=jnp.float32)[None, :]  # [1,S]
     else:
         pos = positions.astype(jnp.float32)  # [B,S]
-    freqs = cfg.rope_theta ** (-jnp.arange(0, D // 2, dtype=jnp.float32) / (D // 2))
+    freqs = rope_inv_freq(cfg)
     angles = pos[..., None] * freqs[None, None, :]  # [B|1, S, D/2]
     cos, sin = jnp.cos(angles), jnp.sin(angles)
 

@@ -325,20 +325,25 @@ class ZeroOneSchedule:
     def kind(self, step: int) -> str:
         """Program for 1-indexed global step `step` (call before advance).
 
-        phase 1 (step <= var_freeze_step):
+        phase 1 (step <= var_freeze_step + 1):
           'full'   — exact-sync gradient, update mu AND nu
           'onebit' — 1-bit error-feedback gradient sync, update mu only
-        phase 2 (step > var_freeze_step):
+        phase 2 (later steps):
           'local'  — no communication at all (local step)
           'sync'   — local step + 1-bit momentum reconciliation
+
+        The +1: the reference flips freeze_key only AFTER the step where
+        state['step'] exceeds var_freeze_step completes
+        (ref: runtime/fp16/onebit/zoadam.py freeze_key flip), so it runs
+        one more variance-adapting step than the naive boundary.
         """
-        if step <= self.var_freeze_step:
+        if step <= self.var_freeze_step + 1:
             return "full" if step % self.var_interval == 0 else "onebit"
         return "sync" if step % self.local_interval == 0 else "local"
 
     def advance(self, step: int) -> None:
         """Post-step interval bookkeeping (exponential growth rules)."""
-        if step <= self.var_freeze_step:
+        if step <= self.var_freeze_step + 1:
             if step % self.var_interval == 0:
                 self.var_counter += 1
                 if self.var_counter == self.var_update_scaler:

@@ -179,6 +179,7 @@ class TieredCheckpointEngine:
 
     # --- save path ----------------------------------------------------
     def save(self, save_dir: str, tag: str, state: Any, meta: Dict) -> None:
+        self._tier_cache = None  # new version: re-resolve on next load
         self.fast.save(save_dir, tag, state, meta)
         now = self._clock()
         if (
@@ -225,30 +226,58 @@ class TieredCheckpointEngine:
             shutil.rmtree(os.path.join(save_dir, t), ignore_errors=True)
 
     # --- load path (fast tier first, durable fallback) ----------------
-    def _tier_for(self, load_dir: str, tag: Optional[str]) -> Tuple[CheckpointEngine, str]:
+    def _tier_for(
+        self, load_dir: str, tag: Optional[str]
+    ) -> Tuple[CheckpointEngine, str, str]:
+        """Resolve (engine, root, concrete tag) ONCE per (load_dir, tag)
+        and memoize: a load_checkpoint call fans out into peek_meta +
+        load (+ resolve_tag), and re-resolving per call could route them
+        to different tiers/versions if a retention sweep or an async
+        fast-tier commit lands in between. The one-entry cache is
+        invalidated on every save."""
+        key = (os.path.abspath(load_dir), tag)
+        cached = getattr(self, "_tier_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
         self.fast.wait()
+        val: Optional[Tuple[CheckpointEngine, str, str]] = None
         try:
             resolved = self.fast.resolve_tag(load_dir, tag)
             if os.path.isdir(os.path.join(os.path.abspath(load_dir), resolved, "state")):
-                return self.fast, load_dir
+                val = (self.fast, load_dir, resolved)
         except FileNotFoundError:
             pass
-        if not self.enable_tier_load:
-            # no durable fallback: surface the fast-tier miss directly
-            return self.fast, load_dir
-        return self.durable, self.load_path
+        if val is None:
+            if not self.enable_tier_load:
+                # no durable fallback: surface the fast-tier miss directly
+                val = (self.fast, load_dir,
+                       tag if tag is not None else "")
+                # keep the miss un-cached so the error path stays live
+                return val
+            val = (self.durable, self.load_path,
+                   self.durable.resolve_tag(self.load_path, tag))
+        self._tier_cache = (key, val)
+        return val
 
     def peek_meta(self, load_dir: str, tag: Optional[str]) -> Dict:
-        engine, root = self._tier_for(load_dir, tag)
-        return engine.peek_meta(root, tag)
+        engine, root, resolved = self._tier_for(load_dir, tag)
+        return engine.peek_meta(root, resolved or tag)
 
     def load(self, load_dir: str, tag: Optional[str], template_state: Any):
-        engine, root = self._tier_for(load_dir, tag)
-        return engine.load(root, tag, template_state)
+        engine, root, resolved = self._tier_for(load_dir, tag)
+        try:
+            return engine.load(root, resolved or tag, template_state)
+        finally:
+            # the memo exists to keep ONE load_checkpoint fan-out
+            # (peek_meta → resolve_tag → load) on a single tier/version;
+            # load() always ends the fan-out, so drop it here — a reader
+            # process that never saves must still observe newer tags on
+            # its next load
+            self._tier_cache = None
 
     def resolve_tag(self, load_dir: str, tag: Optional[str]) -> str:
-        engine, root = self._tier_for(load_dir, tag)
-        return engine.resolve_tag(root, tag)
+        engine, root, resolved = self._tier_for(load_dir, tag)
+        return resolved or engine.resolve_tag(root, tag)
 
     def wait(self) -> None:
         self.fast.wait()

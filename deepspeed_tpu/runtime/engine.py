@@ -998,7 +998,7 @@ class DeepSpeedTPUEngine:
 
     def _dispatch_zoadam_step(self, batch) -> Dict[str, Any]:
         s = self.global_steps + 1  # 1-indexed global step
-        if s > self.optimizer.var_freeze_step and not self._zo_transitioned:
+        if s > self.optimizer.var_freeze_step + 1 and not self._zo_transitioned:
             self._zo_transition()
         kind = self._zo_sched.kind(s)
         step_fn = self._zo_programs.get(kind)
@@ -1027,10 +1027,35 @@ class DeepSpeedTPUEngine:
 
         return jax.jit(grad_fn)
 
+    def _zo_live_params(self):
+        """0/1 Adam phase 2: TrainState.params are the last-SYNCED
+        weights; local steps accumulate per-worker drift in
+        opt['worker_u'] (the reference's p.data IS the live local copy).
+        Eval/export therefore expose params + mean_w(worker_u) — the
+        worker-mean live weights — instead of the stale sync point."""
+        opt = self.state.opt or {}
+        wu = opt.get("worker_u")
+        if wu is None:
+            return self.state.params
+        if getattr(self, "_zo_live_fn", None) is None:
+            self._zo_live_fn = jax.jit(
+                lambda p, u: jax.tree.map(
+                    lambda a, b: (
+                        a.astype(jnp.float32) + jnp.mean(b, axis=0)
+                    ).astype(a.dtype),
+                    p, u,
+                )
+            )
+        return self._zo_live_fn(self.state.params, wu)
+
     def _materialized_params(self):
         """Device-ready params; under offload_param=nvme they are read
-        back from the swap files' master sections on demand."""
+        back from the swap files' master sections on demand. Under 0/1
+        Adam phase 2 the per-worker drift is folded in (see
+        _zo_live_params)."""
         if self.state.params is not None:
+            if self._zoadam and getattr(self, "_zo_transitioned", False):
+                return self._zo_live_params()
             return self.state.params
         lp = self.swapper.unflatten(self.swapper.read_lp_params())
         return jax.tree.map(
@@ -1411,7 +1436,7 @@ class DeepSpeedTPUEngine:
             self._zo_sched = self.optimizer.make_schedule()
             self._zo_sched.replay(self.global_steps)
             self._zo_transitioned = (
-                self.global_steps > self.optimizer.var_freeze_step
+                self.global_steps > self.optimizer.var_freeze_step + 1
             )
         return tag, meta.get("client_state", {})
 
@@ -1535,9 +1560,7 @@ class DeepSpeedTPUEngine:
     # ------------------------------------------------------------------
     @property
     def params(self):
-        if self.state.params is None:  # offload_param=nvme
-            return self._materialized_params()
-        return self.state.params
+        return self._materialized_params()
 
     @property
     def train_micro_batch_size_per_gpu(self):

@@ -204,11 +204,15 @@ class NVMeOptimizerSwapper:
             self.aio.wait(t)
         return params_lp, lr
 
-    def read_lp_params(self) -> List[np.ndarray]:
+    def read_lp_params(self, read_ahead: int = 4) -> List[np.ndarray]:
         """Read ONLY the master section of every leaf and cast to the
         compute dtype — the offload_param=nvme re-materialization (params
         are resident nowhere between steps; ref: partitioned_param_swapper
-        swap-in of fp16 partitions). Read-ahead mirrors step()."""
+        swap-in of fp16 partitions).
+
+        A window of `read_ahead` preads is kept in flight so the aio
+        thread pool overlaps disk latency with the per-leaf reshape/cast;
+        host peak stays O(read_ahead leaves), not O(model)."""
         n = len(self._leaf_paths)
 
         def submit_read(i):
@@ -219,12 +223,13 @@ class NVMeOptimizerSwapper:
             return buf, self.aio.async_pread(buf, self._file(path))
 
         out: List[np.ndarray] = []
-        pending = submit_read(0)
+        window = max(1, int(read_ahead))
+        pending = {i: submit_read(i) for i in range(min(window, n))}
         for i in range(n):
-            buf, ticket = pending
+            buf, ticket = pending.pop(i)
+            if i + window < n:
+                pending[i + window] = submit_read(i + window)
             self.aio.wait(ticket)
-            if i + 1 < n:
-                pending = submit_read(i + 1)
             shape = self._shapes[path := self._leaf_paths[i]]
             out.append(
                 buf.reshape(shape).astype(

@@ -110,6 +110,12 @@ class _CheckpointReader:
         if fname not in self._torch_cache:
             import torch
 
+            # keep at most one prior shard resident: shards are read in
+            # roughly layer order, and unbounded caching would hold the
+            # whole model in torch tensors on top of the numpy tree
+            # being built (the "whole model twice" this reader avoids)
+            while len(self._torch_cache) > 1:
+                self._torch_cache.pop(next(iter(self._torch_cache)))
             self._torch_cache[fname] = torch.load(
                 fname, map_location="cpu", weights_only=True
             )
@@ -147,6 +153,29 @@ def config_from_hf(hf: Dict[str, Any], **overrides) -> TransformerConfig:
             tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
             sliding_window=int(hf.get("sliding_window") or 0),
         )
+        if hf.get("head_dim") is not None:
+            kw["head_dim_override"] = int(hf["head_dim"])
+        rs = hf.get("rope_scaling") or None
+        if rs:
+            rtype = rs.get("rope_type", rs.get("type", "?"))
+            if rtype == "linear":
+                kw.update(rope_scaling_type="linear",
+                          rope_scaling_factor=float(rs["factor"]))
+            elif rtype == "llama3":
+                kw.update(
+                    rope_scaling_type="llama3",
+                    rope_scaling_factor=float(rs["factor"]),
+                    rope_low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
+                    rope_high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
+                    rope_original_max_seq=int(
+                        rs.get("original_max_position_embeddings", 8192)),
+                )
+            elif rtype not in ("default", None):
+                # importing anyway would silently mis-rotate every head
+                raise ValueError(
+                    f"unsupported rope_scaling type {rtype!r} (supported: "
+                    "linear, llama3); refusing a silently-wrong import"
+                )
         if arch == "MixtralForCausalLM":
             kw.update(n_experts=hf["num_local_experts"],
                       moe_top_k=hf["num_experts_per_tok"])

@@ -183,6 +183,64 @@ class TestGPT2Import:
         np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
 
 
+class TestRopeScalingAndHeadDim:
+    def test_llama3_rope_scaling_matches_hf(self, rng, tmp_path):
+        """Llama-3.x-class NTK-by-parts scaling imports exactly."""
+        torch.manual_seed(10)
+        m = transformers.LlamaForCausalLM(_tiny_llama_cfg(
+            max_position_embeddings=64,
+            rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                          "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                          "original_max_position_embeddings": 32})).eval()
+        path = _save(m, tmp_path)
+        cfg, params = import_external(path, use_flash=False)
+        assert cfg.rope_scaling_type == "llama3"
+        assert cfg.rope_scaling_factor == 8.0
+        toks = list(rng.integers(0, 128, 40))  # deep enough to exercise bands
+        ref = _torch_logits(m, toks)
+        with jax.default_matmul_precision("highest"):
+            got = np.asarray(T.forward(params, jnp.asarray([toks]), cfg)[0])
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+    def test_linear_rope_scaling_matches_hf(self, rng, tmp_path):
+        torch.manual_seed(11)
+        m = transformers.LlamaForCausalLM(_tiny_llama_cfg(
+            rope_scaling={"rope_type": "linear", "factor": 2.0})).eval()
+        path = _save(m, tmp_path)
+        cfg, params = import_external(path, use_flash=False)
+        assert cfg.rope_scaling_type == "linear"
+        toks = list(rng.integers(0, 128, 17))
+        ref = _torch_logits(m, toks)
+        with jax.default_matmul_precision("highest"):
+            got = np.asarray(T.forward(params, jnp.asarray([toks]), cfg)[0])
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+    def test_unsupported_rope_scaling_raises(self):
+        with pytest.raises(ValueError, match="rope_scaling"):
+            config_from_hf({
+                "architectures": ["LlamaForCausalLM"], "vocab_size": 8,
+                "num_hidden_layers": 1, "num_attention_heads": 2,
+                "hidden_size": 8, "intermediate_size": 8,
+                "rope_scaling": {"rope_type": "yarn", "factor": 4.0}})
+
+    def test_explicit_head_dim_matches_hf(self, rng, tmp_path):
+        """Mistral-Nemo-class head_dim != d_model/n_heads."""
+        torch.manual_seed(12)
+        m = transformers.MistralForCausalLM(transformers.MistralConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=96,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            head_dim=32, max_position_embeddings=64,
+            tie_word_embeddings=False)).eval()
+        path = _save(m, tmp_path)
+        cfg, params = import_external(path, use_flash=False)
+        assert cfg.head_dim == 32 and cfg.d_model == 64
+        toks = list(rng.integers(0, 128, 10))
+        ref = _torch_logits(m, toks)
+        with jax.default_matmul_precision("highest"):
+            got = np.asarray(T.forward(params, jnp.asarray([toks]), cfg)[0])
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
 class TestImportDetails:
     def test_bf16_checkpoint_preserved(self, tmp_path):
         torch.manual_seed(8)

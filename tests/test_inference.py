@@ -633,6 +633,90 @@ class TestTensorParallelServing:
             init_inference(params, cfg, dict(tp_size=4))
 
 
+class TestSampling:
+    """Sampling knobs over put() logits (ref: inference/engine.py:613
+    generate → HF LogitsProcessor semantics)."""
+
+    def test_temperature_zero_is_greedy(self, rng):
+        cfg, params = small_model()
+        eng = engine_for(cfg, params)
+        prompts = [list(rng.integers(0, 128, 7))]
+        greedy = eng.generate([list(prompts[0])], max_new_tokens=6)
+        sampled = eng.generate([list(prompts[0])], max_new_tokens=6,
+                               do_sample=True, temperature=0.0, seed=0)
+        assert greedy == sampled
+
+    def test_top_k_support(self):
+        """Distribution support ⊆ top-k of the (penalized) logits."""
+        logits = np.linspace(-1, 1, 64).astype(np.float32)
+        gen = np.random.default_rng(0)
+        draws = {
+            InferenceEngine.sample_token(logits, temperature=1.0, top_k=5,
+                                         rng=gen)
+            for _ in range(300)
+        }
+        assert draws <= set(range(59, 64)), draws
+
+    def test_top_p_keeps_nucleus_only(self):
+        logits = np.full(32, -10.0, np.float32)
+        logits[3] = 5.0   # p ~ .88 of the pair below
+        logits[17] = 3.0
+        gen = np.random.default_rng(1)
+        draws = {
+            InferenceEngine.sample_token(logits, temperature=1.0, top_p=0.5,
+                                         rng=gen)
+            for _ in range(200)
+        }
+        assert draws == {3}  # nucleus of mass .5 is just the top token
+
+    def test_top_p_one_keeps_all(self):
+        logits = np.zeros(8, np.float32)
+        gen = np.random.default_rng(2)
+        draws = {
+            InferenceEngine.sample_token(logits, temperature=1.0, top_p=1.0,
+                                         rng=gen)
+            for _ in range(400)
+        }
+        assert draws == set(range(8))  # uniform logits, everything reachable
+
+    def test_repetition_penalty_discourages_seen(self):
+        logits = np.ones(16, np.float32)
+        logits[4] = 2.0  # would win greedily
+        # huge penalty on the seen winner drops it below the field of 1.0s
+        tok = InferenceEngine.sample_token(
+            logits, temperature=0.0, repetition_penalty=100.0,
+            seen_tokens=[4])
+        assert tok != 4
+        # negative logits are multiplied (CTRL rule)
+        neg = np.full(4, -1.0, np.float32)
+        neg[2] = -0.5
+        tok = InferenceEngine.sample_token(
+            neg, temperature=0.0, repetition_penalty=4.0, seen_tokens=[2])
+        assert tok != 2
+
+    def test_seeded_draws_reproduce(self, rng):
+        cfg, params = small_model()
+        eng = engine_for(cfg, params)
+        p = list(rng.integers(0, 128, 5))
+        a = eng.generate([list(p)], max_new_tokens=8, do_sample=True,
+                         temperature=1.5, top_k=20, seed=7)
+        b = eng.generate([list(p)], max_new_tokens=8, do_sample=True,
+                         temperature=1.5, top_k=20, seed=7)
+        c = eng.generate([list(p)], max_new_tokens=8, do_sample=True,
+                         temperature=1.5, top_k=20, seed=8)
+        assert a == b
+        assert a != c  # overwhelmingly likely at temp 1.5
+
+    def test_batch_sampling_runs(self, rng):
+        cfg, params = small_model()
+        eng = engine_for(cfg, params)
+        outs = eng.generate(
+            [list(rng.integers(0, 128, 5)), list(rng.integers(0, 128, 3))],
+            max_new_tokens=5, do_sample=True, temperature=0.8, top_p=0.9,
+            repetition_penalty=1.2, seed=3)
+        assert len(outs) == 2 and all(len(o) == 5 for o in outs)
+
+
 def test_empty_token_array_raises(rng):
     cfg, params = small_model()
     eng = engine_for(cfg, params)

@@ -211,12 +211,15 @@ class TestZeroOneAdam:
         for step in range(1, 19):
             kinds.append(s.kind(step))
             s.advance(step)
-        # var_interval: 1,1 (x2) -> 2,2 (x2) -> 4 ... freeze at 10
-        assert kinds[:10] == ["full", "full", "onebit", "full", "onebit",
-                              "full", "onebit", "full", "onebit", "onebit"]
-        # phase 2 (steps 11+): interval 1 for 3 steps -> 2 (14 sync,
-        # 15 local, 16 sync) -> 4 (17,18 local)
-        assert kinds[10:18] == ["sync", "sync", "sync", "sync", "local",
+        # var_interval: 1,1 (x2) -> 2,2 (x2) -> 4 ...; the freeze flips
+        # AFTER step var_freeze_step+1 completes (reference freeze_key
+        # semantics), so step 11 is still a phase-1 step
+        assert kinds[:11] == ["full", "full", "onebit", "full", "onebit",
+                              "full", "onebit", "full", "onebit", "onebit",
+                              "onebit"]
+        # phase 2 (steps 12+): interval 1 for 3 steps -> 2 (15 local,
+        # 16 sync) -> 4 (17,18 local)
+        assert kinds[11:18] == ["sync", "sync", "sync", "local",
                                 "sync", "local", "local"]
         # replay reproduces the live state
         s2 = ZeroOneSchedule(10, 2, 3, 4)
@@ -287,6 +290,26 @@ class TestZeroOneAdam:
                        for p in jax.tree.leaves(engine.state.params))
         assert vol["full"] > 4 * n_params  # fp32 grad exchange
         assert vol["local"] < vol["full"] / 50, vol
+
+    def test_phase2_eval_exposes_live_params(self):
+        """Mid-interval (between syncs) the params property / eval path
+        must fold in the per-worker drift mean, not expose the stale
+        sync point (ADVICE r2; the reference's p.data is live)."""
+        engine = zo_build(betas=[0.9, 0.5], var_freeze_step=1,
+                          local_step_scaler=2, local_step_clipper=4)
+        # vf=1: steps 1-2 phase 1; step 3 sync (counter 1), 4 sync
+        # (counter 2 -> interval 2), 5 local -> drift pending
+        for b in data(1) * 5:
+            engine.train_batch(b)
+        wu = np.asarray(jax.device_get(
+            engine.state.opt["worker_u"]["embed"]))
+        assert np.abs(wu).max() > 0, "expected un-synced local drift"
+        live = np.asarray(jax.device_get(engine.params["embed"]))
+        stale = np.asarray(jax.device_get(engine.state.params["embed"]))
+        assert np.abs(live - stale).max() > 0
+        np.testing.assert_allclose(
+            live, (stale.astype(np.float32) + wu.mean(0)).astype(stale.dtype),
+            rtol=1e-6, atol=1e-6)
 
     def test_checkpoint_resume_replays_schedule(self, tmp_path):
         cfg = dict(betas=[0.9, 0.5], var_freeze_step=3, var_update_scaler=2,

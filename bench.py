@@ -35,12 +35,15 @@ def main():
         # (Llama's real head size) fills the full MXU lane width — at
         # head_dim=64 every attention matmul runs half-wide (measured 2x
         # slower, scripts/profile_bench.py).
-        # remat="full" beats "dots" here (measured 429 vs 445 ms/step):
-        # with the Pallas flash backward, recomputing the cheap elementwise
-        # layer body costs less than the HBM traffic of saving dot outputs.
+        # remat="save_attn_qkv": full remat EXCEPT the flash-attention
+        # residuals (q/k/v/o/lse) — the backward re-runs no attention
+        # work at all. Measured r3 (docs/PROFILE_r03.md): 430.4 ms/step
+        # (remat=full) -> 402.4 ms with this + loss_chunks=16; heavier
+        # policies (dots, +mlp products) LOSE to the HBM traffic they add.
         mcfg = T.TransformerConfig(
             vocab_size=32000, n_layers=24, n_heads=8, d_model=1024,
-            max_seq=2048, variant="llama", remat="full", use_flash=True,
+            max_seq=2048, variant="llama", remat="save_attn_qkv",
+            use_flash=True, flash_block_q=1024, flash_block_k=1024,
         )
         micro_bs, steps, warmup = 8, 16, 3
     else:
@@ -60,7 +63,7 @@ def main():
             "gradient_clipping": 1.0,
             "steps_per_print": 10**9,
         },
-        loss_fn=T.make_loss_fn(mcfg),
+        loss_fn=T.make_loss_fn(mcfg, loss_chunks=16),
         param_init_fn=lambda k: T.init(mcfg, k),
         param_logical_specs=T.logical_specs(mcfg),
     )

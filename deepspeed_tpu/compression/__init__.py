@@ -1,1 +1,7 @@
-from .compress import build_compression, clean_compressed_params, init_compression
+from .compress import (
+    build_compression,
+    clean_compressed_params,
+    init_compression,
+    make_distillation_loss_fn,
+    student_initialization,
+)

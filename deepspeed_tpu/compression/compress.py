@@ -39,14 +39,33 @@ def _match(path: str, patterns) -> bool:
     return any(fnmatch.fnmatch(path, p) or p == "*" for p in patterns)
 
 
-def _fake_quant(w, bits: int):
+def _fake_quant(w, bits):
     """Symmetric per-tensor fake quantization with straight-through
-    gradients (ref: fake_quantizer.cu + QAT path of basic_layer.py)."""
-    qmax = float(2 ** (bits - 1) - 1)
-    absmax = jnp.max(jnp.abs(w))
+    gradients (ref: fake_quantizer.cu + QAT path of basic_layer.py).
+    `bits` may be a traced scalar (bit-decay schedules)."""
+    qmax = jnp.exp2(jnp.asarray(bits, jnp.float32) - 1.0) - 1.0
+    absmax = jnp.max(jnp.abs(w)).astype(jnp.float32)
     scale = jnp.where(absmax > 0, absmax / qmax, 1.0)
-    q = jnp.clip(jnp.round(w / scale), -qmax, qmax) * scale
+    q = (jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -qmax, qmax)
+         * scale).astype(w.dtype)
     return w + jax.lax.stop_gradient(q - w)  # STE
+
+
+def _decayed_bits(step, start_bits: int, target_bits: int, period: int):
+    """Progressive bit narrowing (ref: runtime/quantize.py
+    compute_quantization:129 — one bit is removed each time the step
+    counter crosses q_period, and the period DOUBLES per reduction, 'to
+    go slowly toward the target'). Reductions land at steps p0, 2*p0,
+    4*p0, ...; closed form so it traces branchlessly."""
+    if period <= 0 or start_bits <= target_bits:
+        return jnp.float32(target_bits)
+    s = jnp.maximum(jnp.asarray(step, jnp.float32), 0.0)
+    n_red = jnp.where(
+        s < period, 0.0,
+        jnp.floor(jnp.log2(jnp.maximum(s / period, 1.0))) + 1.0,
+    )
+    return jnp.maximum(jnp.float32(start_bits) - n_red,
+                       jnp.float32(target_bits))
 
 
 def _sparse_mask(w, dense_ratio: float):
@@ -112,12 +131,18 @@ def init_compression(config: Dict[str, Any]):
     for gname, group in (wq.get("different_groups") or {}).items():
         params = group.get("params", {})
         bits = int(params.get("target_bits", params.get("bits", 8)))
-        # schedule_offset gates the start; quantization_period (the
-        # reference's bit-decay cadence) is accepted but has no separate
-        # effect here (bits jump straight to target_bits)
+        # start_bits + quantization_period: the reference's progressive
+        # bit-narrowing (runtime/quantize.py compute_quantization) —
+        # bits walk from start_bits down to target_bits, one bit per
+        # period crossing with the period doubling each time
+        start_bits = int(params.get("start_bits", bits))
+        period = int(params.get("quantization_period", 0))
         offset = int(wq.get("shared_parameters", {}).get("schedule_offset", 0))
         mods = tuple(group.get("modules", ["*"]))
-        rules.append(("qat", mods, {"bits": bits, "offset": offset}))
+        rules.append(("qat", mods, {
+            "bits": bits, "start_bits": start_bits, "period": period,
+            "offset": offset,
+        }))
     if config.get("activation_quantization", {}).get("shared_parameters", {}) \
             .get("enabled") or (config.get("activation_quantization") or {}) \
             .get("different_groups"):
@@ -177,7 +202,8 @@ def build_compression(config: Dict[str, Any]) -> Optional[Callable]:
                 if not _match(name, mods):
                     continue
                 if kind == "qat":
-                    out = _fake_quant(w, prm["bits"])
+                    out = _fake_quant(w, _decayed_bits(
+                        step, prm["start_bits"], prm["bits"], prm["period"]))
                 else:
                     out = w * jax.lax.stop_gradient(
                         _MASKS[kind](w, prm["dense_ratio"]))
@@ -187,6 +213,94 @@ def build_compression(config: Dict[str, Any]) -> Optional[Callable]:
         return jax.tree_util.tree_map_with_path(leaf, params)
 
     return apply
+
+
+def student_initialization(teacher_params, config: Dict[str, Any]):
+    """Initialize a shallower student from chosen teacher layers
+    (ref: compression/compress.py:192 student_initialization — there it
+    copies module-by-module via recursive_getattr over the
+    layer_reduction config; here layers are ONE stacked [L, ...] array,
+    so the whole re-init is a gather on the layer dim plus carrying the
+    non-layer leaves over, the other_module_name copy collapsed).
+
+    config: the compression_training block; uses
+    layer_reduction.{enabled, teacher_layer} (module_name_prefix /
+    other_module_name are module-tree artifacts with no functional
+    analog — every non-layer leaf is copied)."""
+    lr = config.get("layer_reduction") or {}
+    if not lr.get("enabled", False):
+        return teacher_params
+    idx = jnp.asarray(list(lr["teacher_layer"]), jnp.int32)
+    keep = lr.get("keep_number_layers")
+    if keep is not None and int(keep) != int(idx.shape[0]):
+        raise ValueError(
+            f"keep_number_layers {keep} != len(teacher_layer) {idx.shape[0]}"
+        )
+    student = {k: v for k, v in teacher_params.items() if k != "layers"}
+    student["layers"] = jax.tree.map(lambda w: w[idx], teacher_params["layers"])
+    return student
+
+
+def make_distillation_loss_fn(
+    student_cfg, teacher_cfg, teacher_params,
+    alpha: float = 0.5, temperature: float = 2.0, loss_chunks: int = 8,
+):
+    """KD training loss: alpha * CE(student, labels) +
+    (1-alpha) * T^2 * KL(teacher_soft || student_soft).
+
+    The reference's compression pipeline initializes the student
+    (compress.py:192) and leaves the KD objective to the example
+    scripts; with a functional engine the objective IS the hook, so it
+    ships in-tree. Teacher runs under stop_gradient in the same compiled
+    step (one program; XLA overlaps the two forwards). Returns a loss_fn
+    for ds.initialize."""
+    from ..models import transformer as T
+
+    frozen_teacher = jax.tree.map(jax.lax.stop_gradient, teacher_params)
+
+    def loss_fn(params, batch, rng):
+        tokens = batch["tokens"] if isinstance(batch, dict) else batch
+        inp, tgt = tokens[:, :-1], tokens[:, 1:]
+        mask = T._shift_mask(batch if isinstance(batch, dict) else {}, tgt)
+        # ONE student forward feeds both the CE and KD terms; KD runs
+        # chunked over the sequence like _chunked_ce, so no [B,S,V]
+        # fp32 logits tensor is ever resident (student OR teacher)
+        x_s = T.forward_hidden(params, inp, student_cfg, rng)
+        x_t = jax.lax.stop_gradient(
+            T.forward_hidden(frozen_teacher, inp, teacher_cfg, None))
+        head_s = T._lm_head(params, student_cfg)
+        head_t = T._lm_head(frozen_teacher, teacher_cfg)
+        n = T._ce_chunk_count(inp.shape[1], loss_chunks)
+        ce_sum, cnt = T._chunked_ce(x_s, head_s, tgt, mask, n)
+        ce = ce_sum / jnp.maximum(cnt, 1.0)
+
+        B, S, _ = x_s.shape
+        C = S // n
+
+        @jax.checkpoint
+        def kd_chunk(xs_c, xt_c, m_c):
+            s_log = jnp.einsum("bce,ev->bcv", xs_c,
+                               head_s.astype(xs_c.dtype)).astype(jnp.float32)
+            t_log = jnp.einsum("bce,ev->bcv", xt_c,
+                               head_t.astype(xt_c.dtype)).astype(jnp.float32)
+            t_soft = jax.nn.log_softmax(t_log / temperature, axis=-1)
+            s_soft = jax.nn.log_softmax(s_log / temperature, axis=-1)
+            kl = jnp.sum(jnp.exp(t_soft) * (t_soft - s_soft), axis=-1)
+            return jnp.sum(kl * m_c)
+
+        def body(carry, xs):
+            return carry + kd_chunk(*xs), None
+
+        chunks = (
+            x_s.reshape(B, n, C, -1).swapaxes(0, 1),
+            x_t.reshape(B, n, C, -1).swapaxes(0, 1),
+            mask.reshape(B, n, C).swapaxes(0, 1),
+        )
+        kl_sum, _ = jax.lax.scan(body, jnp.float32(0.0), chunks)
+        kl = kl_sum / jnp.maximum(cnt, 1.0)
+        return alpha * ce + (1.0 - alpha) * (temperature ** 2) * kl
+
+    return loss_fn
 
 
 def clean_compressed_params(params, config: Dict[str, Any], step: Optional[int] = None):

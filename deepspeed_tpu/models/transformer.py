@@ -69,8 +69,14 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     tie_embeddings: bool = True
-    remat: str = "none"  # none | full | dots (jax.checkpoint policy)
+    # jax.checkpoint policy: none | full | dots | save_attn |
+    # save_attn_qkv | save_attn_mlp | save_attn_dots (save_attn* keep the
+    # flash residuals so the backward skips the attention re-forward)
+    remat: str = "none"
     use_flash: bool = True  # pallas flash attention on TPU, XLA fallback elsewhere
+    # flash tiling (1024x1024 fastest at S=2048/D=128; 512x1024 at S=16k)
+    flash_block_q: int = 512
+    flash_block_k: int = 1024
     # MoE (ref: deepspeed/moe/layer.py MoE:17 knobs). n_experts > 0 turns
     # every MLP into an expert-parallel MoE FFN.
     n_experts: int = 0
@@ -83,6 +89,11 @@ class TransformerConfig:
     # >1 stores layers stage-partitioned [P, L/P, ...] and routes the
     # forward through runtime/pipe.pipeline_apply.
     pipeline_stages: int = 1
+    # Interleaved (virtual-stage) pipelining: v > 1 stores layers
+    # chunk-partitioned [v, P, L/(vP), ...] and runs the circular
+    # schedule (runtime/pipe.pipeline_apply_circular) — warmup/drain
+    # bubble shrinks ~v (the Megatron interleaved-1F1B analog).
+    pipeline_virtual_stages: int = 1
     # Random-LTD (ref: data_pipeline/data_routing/basic_layer.py
     # RandomLayerTokenDrop:107): layers in [start, end) process only the
     # batch-supplied 'random_ltd' token subset; dropped tokens skip them
@@ -105,6 +116,14 @@ class TransformerConfig:
             raise ValueError(
                 f"unsupported rope_scaling_type '{self.rope_scaling_type}' "
                 "(supported: none|linear|llama3)"
+            )
+        if self.pipeline_virtual_stages > 1 and self.pipeline_stages <= 1:
+            raise ValueError(
+                "pipeline_virtual_stages > 1 requires pipeline_stages > 1"
+            )
+        if self.remat not in REMAT_MODES:
+            raise ValueError(
+                f"unknown remat '{self.remat}' (expected one of {REMAT_MODES})"
             )
         if self.attention_impl not in ("ulysses", "ring", "sparse"):
             raise ValueError(
@@ -237,7 +256,10 @@ def init(cfg: TransformerConfig, rng) -> Dict[str, Any]:
     if cfg.pipeline_stages > 1:
         from ..runtime.pipe import partition_layers
 
-        params["layers"] = partition_layers(params["layers"], cfg.pipeline_stages)
+        params["layers"] = partition_layers(
+            params["layers"], cfg.pipeline_stages,
+            virtual=cfg.pipeline_virtual_stages,
+        )
     return params
 
 
@@ -251,7 +273,12 @@ def logical_specs(cfg: TransformerConfig) -> Dict[str, Any]:
         specs["ln_f_bias"] = ("embed",)
     if not cfg.tie_embeddings:
         specs["lm_head"] = ("embed", "vocab")
-    lead = ("pipe_stage", "layers") if cfg.pipeline_stages > 1 else ("layers",)
+    if cfg.pipeline_stages > 1:
+        lead = (("pipe_virtual", "pipe_stage", "layers")
+                if cfg.pipeline_virtual_stages > 1
+                else ("pipe_stage", "layers"))
+    else:
+        lead = ("layers",)
     specs["layers"] = {
         name: lead + logical for name, (_, logical) in _layer_shapes(cfg).items()
     }
@@ -391,6 +418,14 @@ def _attention_block(x, lp, cfg: TransformerConfig, rng=None, positions=None):
         v = v + lp["bv"].astype(x.dtype)
     else:
         q, k = _rope(q, k, cfg, positions=positions)
+    from jax.ad_checkpoint import checkpoint_name
+
+    # named for remat="save_attn_qkv": saved q/k/v are exactly the flash
+    # custom-vjp residuals, so the attention block's backward needs NO
+    # recompute at all (projections included)
+    q = checkpoint_name(q, "attn_q")
+    k = checkpoint_name(k, "attn_k")
+    v = checkpoint_name(v, "attn_v")
 
     if cfg.attention_impl == "ring":
         from ..parallel.ring_attention import ring_causal_attention
@@ -421,7 +456,9 @@ def _attention_block(x, lp, cfg: TransformerConfig, rng=None, positions=None):
         v = _shard(v, DP, None, ("model", "seq"), None)
 
         out = causal_attention(q, k, v, use_flash=cfg.use_flash,
-                               window=cfg.sliding_window)  # [B,S,H,D]
+                               window=cfg.sliding_window,
+                               block_q=cfg.flash_block_q,
+                               block_k=cfg.flash_block_k)  # [B,S,H,D]
 
     out = _shard(out, DP, "seq", "model", None)
     out = jnp.einsum("bshd,hde->bse", out, lp["wo"].astype(x.dtype))
@@ -437,8 +474,16 @@ def _mlp_block(x, lp, cfg: TransformerConfig, rng=None):
         return _moe_mlp_block(x, lp, cfg, rng)
     h = _act_quant(_norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg), cfg)
     if cfg.variant == "llama":
-        gate = jnp.einsum("bse,ef->bsf", h, lp["w_gate"].astype(x.dtype))
-        up = jnp.einsum("bse,ef->bsf", h, lp["w_in"].astype(x.dtype))
+        from jax.ad_checkpoint import checkpoint_name
+
+        # named for remat="save_attn_mlp": saving the two F-wide products
+        # removes the MLP re-forward (the step's largest recompute)
+        gate = checkpoint_name(
+            jnp.einsum("bse,ef->bsf", h, lp["w_gate"].astype(x.dtype)),
+            "mlp_gate")
+        up = checkpoint_name(
+            jnp.einsum("bse,ef->bsf", h, lp["w_in"].astype(x.dtype)),
+            "mlp_up")
         inner = jax.nn.silu(gate) * up
     else:
         inner = jax.nn.gelu(
@@ -500,11 +545,10 @@ def _moe_mlp_block(x, lp, cfg: TransformerConfig, rng=None):
     return x + out, l_aux
 
 
-_REMAT_POLICIES = {
-    "none": None,
-    "full": None,  # full remat = jax.checkpoint with default policy
-    "dots": "dots_with_no_batch_dims_saveable",
-}
+# valid TransformerConfig.remat values; __post_init__ validates so a
+# typo cannot silently train with no rematerialization
+REMAT_MODES = ("none", "full", "dots", "save_attn", "save_attn_qkv",
+               "save_attn_mlp", "save_attn_dots")
 
 
 def _wants_rng(cfg: TransformerConfig) -> bool:
@@ -558,6 +602,52 @@ def _make_layer_body(cfg: TransformerConfig, use_rng: bool, positions=None,
         layer_body = jax.checkpoint(
             layer_body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
         )
+    elif cfg.remat == "save_attn":
+        # full remat EXCEPT the flash-attention residuals (o, lse — named
+        # in ops/pallas/flash_attention._flash_fwd_rule): the backward
+        # then reuses them instead of re-running the fwd kernel, trading
+        # 2*S*D f32 per layer of HBM for the whole attention re-forward
+        layer_body = jax.checkpoint(
+            layer_body,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "flash_o", "flash_lse"
+            ),
+        )
+    elif cfg.remat == "save_attn_qkv":
+        # save_attn + the rope-rotated q/k/v (the remaining flash
+        # residuals): the attention half of the layer has zero backward
+        # recompute; only the MLP re-forwards. ~2.3GB extra at the 350M
+        # bench shape
+        layer_body = jax.checkpoint(
+            layer_body,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "flash_o", "flash_lse", "attn_q", "attn_k", "attn_v"
+            ),
+        )
+    elif cfg.remat == "save_attn_mlp":
+        # save_attn + the two F-wide MLP products: the backward's only
+        # remaining matmul recompute is the QKV projections (flash
+        # residuals). ~4GB extra HBM at the 350M bench shape — the sweet
+        # spot between save_attn and the too-fat dots policy
+        layer_body = jax.checkpoint(
+            layer_body,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "flash_o", "flash_lse", "mlp_gate", "mlp_up"
+            ),
+        )
+    elif cfg.remat == "save_attn_dots":
+        # additionally keep weight-matmul outputs (no-batch-dim dots):
+        # backward recomputes only cheap elementwise work — highest HBM
+        # footprint short of remat="none"
+        layer_body = jax.checkpoint(
+            layer_body,
+            policy=jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.save_only_these_names(
+                    "flash_o", "flash_lse"
+                ),
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            ),
+        )
     return layer_body
 
 
@@ -591,7 +681,7 @@ def forward_hidden(
         # eval without a pipe mesh) works on the same tree.
         from ..runtime.pipe import unpartition_layers
 
-        layers = unpartition_layers(layers)
+        layers = unpartition_layers(layers, virtual=cfg.pipeline_virtual_stages)
 
     layer_rngs = jax.random.split(rng, cfg.n_layers) if use_rng else None
 
@@ -745,14 +835,21 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, loss_chunks: int = 8):
     The loss is the mean over microbatches of per-microbatch token-mean
     CE — identical to the flat engine's mean-of-micro-losses.
     """
-    from ..runtime.pipe import pipeline_apply, stage_slice_keys
+    from ..runtime.pipe import (
+        pipeline_apply,
+        pipeline_apply_circular,
+        stage_slice_keys,
+    )
 
     n_stage = cfg.pipeline_stages
-    if cfg.n_layers % max(n_stage, 1) != 0:
+    v = cfg.pipeline_virtual_stages
+    if cfg.n_layers % (max(n_stage, 1) * v) != 0:
         raise ValueError(
-            f"n_layers {cfg.n_layers} not divisible by pipeline_stages {n_stage}"
+            f"n_layers {cfg.n_layers} not divisible by pipeline_stages "
+            f"{n_stage} x virtual {v}"
         )
     lps = cfg.n_layers // max(n_stage, 1)
+    lc = lps // v  # layers per chunk (circular schedule)
 
     def loss_fn(params, batch, rng):
         tokens = batch["tokens"]
@@ -774,29 +871,58 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, loss_chunks: int = 8):
         use_rng = rng is not None and _wants_rng(cfg)
         layer_body = _make_layer_body(cfg, use_rng)
 
-        def stage_fn(lp_stage, carry, mb_key, stage_idx):
-            h, aux = carry
-            if use_rng:
-                keys = stage_slice_keys(mb_key, cfg.n_layers, stage_idx, lps)
-                h, l_aux = jax.lax.scan(layer_body, h, (lp_stage, keys))
-            else:
-                h, l_aux = jax.lax.scan(layer_body, h, lp_stage)
-            return h, aux + jnp.sum(l_aux)
-
         carry_in = (x, jnp.zeros((M,), jnp.float32))
         state_spec = (P("pipe", DP, "seq", None), P("pipe"))
         layers = params["layers"]
-        if n_stage <= 1:
-            # degenerate single-stage pipeline: layers stay [L, ...] in
-            # storage; add the [1, L, ...] stage dim at trace time
-            layers = jax.tree.map(lambda l: l[None], layers)
-        hidden, aux = pipeline_apply(
-            stage_fn,
-            layers,
-            carry_in,
-            rng=rng if use_rng else None,
-            state_spec=state_spec,
-        )
+        if v > 1:
+            # circular (interleaved) schedule: stage_fn applies ONE chunk
+            # (lc layers) per chunk-step, selected by the slot's round
+            def chunk_fn(lp_stage, carry, mb_key, stage_idx, rnd):
+                h, aux = carry
+                r = jnp.minimum(rnd, v - 1)  # empty slots clamp (discarded)
+                lp = jax.tree.map(
+                    lambda l: jax.lax.dynamic_index_in_dim(l, r, 0,
+                                                           keepdims=False),
+                    lp_stage,
+                )
+                if use_rng:
+                    # chunk (r, p) covers layers [(r*P+p)*lc, ...+lc):
+                    # split over ALL layers then slice, as the flat model
+                    keys = stage_slice_keys(
+                        mb_key, cfg.n_layers, r * n_stage + stage_idx, lc)
+                    h, l_aux = jax.lax.scan(layer_body, h, (lp, keys))
+                else:
+                    h, l_aux = jax.lax.scan(layer_body, h, lp)
+                return h, aux + jnp.sum(l_aux)
+
+            hidden, aux = pipeline_apply_circular(
+                chunk_fn,
+                layers,
+                carry_in,
+                rng=rng if use_rng else None,
+                state_spec=state_spec,
+            )
+        else:
+            def stage_fn(lp_stage, carry, mb_key, stage_idx):
+                h, aux = carry
+                if use_rng:
+                    keys = stage_slice_keys(mb_key, cfg.n_layers, stage_idx, lps)
+                    h, l_aux = jax.lax.scan(layer_body, h, (lp_stage, keys))
+                else:
+                    h, l_aux = jax.lax.scan(layer_body, h, lp_stage)
+                return h, aux + jnp.sum(l_aux)
+
+            if n_stage <= 1:
+                # degenerate single-stage pipeline: layers stay [L, ...] in
+                # storage; add the [1, L, ...] stage dim at trace time
+                layers = jax.tree.map(lambda l: l[None], layers)
+            hidden, aux = pipeline_apply(
+                stage_fn,
+                layers,
+                carry_in,
+                rng=rng if use_rng else None,
+                state_spec=state_spec,
+            )
 
         # Head/loss: shard microbatches over 'pipe' so the CE work (the
         # reference computes loss only on the last stage) splits across

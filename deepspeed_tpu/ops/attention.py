@@ -71,18 +71,24 @@ _flash_fn = None
 _flash_resolved = False
 
 
-def causal_attention(q, k, v, use_flash: bool = True, window: int = 0):
+def causal_attention(q, k, v, use_flash: bool = True, window: int = 0,
+                     block_q: int = 512, block_k: int = 1024):
     """Causal self-attention, [B,S,H,D] x [B,S,KV,D] -> [B,S,H,D].
 
     GQA KV heads are consumed in-place by the flash kernel (index maps,
     no HBM repeat); only the XLA fallback materializes the repeat.
 
     window > 0 enables a token-exact sliding window (Mistral-class);
-    the flash kernels prune out-of-window blocks from compute AND DMA."""
+    the flash kernels prune out-of-window blocks from compute AND DMA.
+
+    block_q/block_k tune the flash tiling (TransformerConfig
+    flash_block_q/k — 1024x1024 measured fastest at S=2048/D=128,
+    512x1024 at S=16384; docs/PROFILE_r03.md)."""
     if use_flash and q.shape[1] >= 256 and _on_tpu():
         flash = _load_flash()
         if flash is not None:
-            return flash(q, k, v, causal=True, window=window)
+            return flash(q, k, v, causal=True, window=window,
+                         block_q=block_q, block_k=block_k)
     n_rep = q.shape[2] // k.shape[2]
     return _xla_attention(q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep),
                           causal=True, window=window)

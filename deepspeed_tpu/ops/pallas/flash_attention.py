@@ -448,6 +448,14 @@ def _flash(q, k, v, causal, block_q, block_k, H, KV, window):
 
 def _flash_fwd_rule(q, k, v, causal, block_q, block_k, H, KV, window):
     o, lse = _flash_fwd(q, k, v, causal, block_q, block_k, H, KV, window)
+    # Named for remat policies: models/transformer remat="save_attn"
+    # saves exactly these (the kernel's own residuals), so the layer-body
+    # recompute in the backward skips re-running the fwd kernel while
+    # everything else (projections, MLP) still rematerializes.
+    from jax.ad_checkpoint import checkpoint_name
+
+    o = checkpoint_name(o, "flash_o")
+    lse = checkpoint_name(lse, "flash_lse")
     return o, (q, k, v, o, lse)
 
 

@@ -29,12 +29,19 @@ DEFAULT_LOGICAL_RULES: List[Tuple[str, MeshAxes]] = [
     ("heads", "model"),
     ("head_dim", None),
     ("mlp", "model"),
-    ("vocab", "model"),
+    # vocab shards over TP and, under pipeline parallelism, ALSO over
+    # 'pipe': each stage holds V/(model*pipe) embedding/head rows — the
+    # TPU answer to the reference's stage-placing of tied embedding/head
+    # (ref: runtime/pipe/module.py TiedLayerSpec — there stage 0 and P-1
+    # hold the full table and all-reduce its grad; here no stage holds
+    # more than a slice and XLA inserts the gather/psum)
+    ("vocab", ("model", "pipe")),
     ("expert", "expert"),
     ("expert_mlp", "model"),
     ("kv_length", None),
     ("layers", None),  # stacked-layer leading dim (scan-over-layers)
     ("pipe_stage", "pipe"),  # pipeline-stage leading dim (runtime/pipe.py)
+    ("pipe_virtual", None),  # interleave round dim (circular schedule)
 ]
 
 
@@ -72,11 +79,17 @@ def logical_to_mesh_spec(
             mapped = (mapped,)
         live = tuple(ax for ax in mapped if mesh.shape.get(ax, 1) > 1 and ax not in used)
         if shape is not None and live:
-            import numpy as np
-
-            total = int(np.prod([mesh.shape[ax] for ax in live]))
-            if shape[i] % total != 0:
-                live = ()
+            # keep the longest PREFIX of axes whose cumulative product
+            # divides the dim — a non-dividing trailing axis must not
+            # strip the sharding the leading axes still provide (e.g.
+            # vocab 32000 under model=2 x pipe=3 keeps the 2-way shard)
+            kept = []
+            total = 1
+            for ax in live:
+                if shape[i] % (total * mesh.shape[ax]) == 0:
+                    kept.append(ax)
+                    total *= mesh.shape[ax]
+            live = tuple(kept)
         used.update(live)
         if not live:
             out.append(None)

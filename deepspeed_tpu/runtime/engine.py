@@ -1323,6 +1323,7 @@ class DeepSpeedTPUEngine:
             # pipeline layout of the stored layer stack — what
             # load_universal converts across (mesh changes are free)
             "pipeline_stages": int(self.mesh.shape.get("pipe", 1)),
+            "pipeline_virtual_stages": self._pipe_virtual_stages(),
         }
         self.checkpoint_engine.save(save_dir, tag, state_to_save, meta)
         return tag
@@ -1451,6 +1452,15 @@ class DeepSpeedTPUEngine:
         from ..utils.universal_checkpoint import convert_pipeline_layout
 
         meta = self.checkpoint_engine.peek_meta(load_dir, tag)
+        if (int(meta.get("pipeline_virtual_stages", 1)) > 1
+                or self._pipe_virtual_stages() > 1):
+            raise NotImplementedError(
+                "load_universal cannot yet convert interleaved "
+                "(pipeline_virtual_stages > 1) layer layouts across "
+                "pipeline degrees; flatten with "
+                "runtime.pipe.unpartition_layers(..., virtual=v) and "
+                "re-partition for the target engine"
+            )
         if "pipeline_stages" in meta:
             src = int(meta["pipeline_stages"])
         else:
@@ -1470,6 +1480,24 @@ class DeepSpeedTPUEngine:
         # caller deletes out_dir after restore (a converted checkpoint can
         # be model-sized; leaking one per resume would fill /tmp)
         return out_dir, tag, out_dir
+
+    def _pipe_virtual_stages(self) -> int:
+        """Interleave degree of THIS engine's layer stack, read from the
+        stored leaf shapes: a circular stack is [v, P, lc, ...] (dim 1 ==
+        pipe), a plain one [P, L/P, ...] (dim 0 == pipe). The v == P ==
+        L/P corner is ambiguous from shape alone and reads as plain — the
+        load_universal guard errs loud before that matters."""
+        pipe = int(self.mesh.shape.get("pipe", 1))
+        if not self.pipelined or pipe <= 1:
+            return 1
+        layers = (self.state.params or {}).get("layers") if isinstance(
+            self.state.params, dict) else None
+        if not layers:
+            return 1
+        leaf = next(iter(layers.values()))
+        if leaf.ndim >= 2 and leaf.shape[0] != pipe and leaf.shape[1] == pipe:
+            return int(leaf.shape[0])
+        return 1
 
     def _infer_stored_pipeline_stages(self, load_dir: str, tag: Optional[str]) -> int:
         """Stored pipeline degree of a checkpoint without pipeline_stages

@@ -48,14 +48,22 @@ def num_stages(stage_params) -> int:
     return jax.tree.leaves(stage_params)[0].shape[0]
 
 
-def partition_layers(stacked_params, n_stages: int, method: str = "uniform"):
-    """[L, ...] layer-stacked pytree → [P, L/P, ...] stage-partitioned.
+def partition_layers(stacked_params, n_stages: int, method: str = "uniform",
+                     virtual: int = 1):
+    """[L, ...] layer-stacked pytree → stage-partitioned.
 
     The LayerSpec partitioner analog (ref: runtime/pipe/module.py
     _partition_layers:370). The reference offers uniform/parameters/
     regex/profile strategies over heterogeneous nn.Module lists; a
     scanned stack is homogeneous by construction, so 'uniform' is exact
     load balance and the only strategy that changes anything.
+
+    virtual=1: [P, L/P, ...] (contiguous blocks).
+    virtual=v>1: [v, P, L/(v*P), ...] — CYCLIC chunk assignment for the
+    circular (interleaved/virtual-stage) schedule: chunk c = r*P + p runs
+    on physical stage p at round r, the Megatron interleaved placement
+    (ref: runtime/pipe/module.py interleave docs; bubble shrinks ~v, see
+    pipeline_apply_circular).
     """
     if method != "uniform":
         raise NotImplementedError(
@@ -65,21 +73,34 @@ def partition_layers(stacked_params, n_stages: int, method: str = "uniform"):
 
     def reshape(leaf):
         L = leaf.shape[0]
-        if L % n_stages != 0:
+        if L % (n_stages * virtual) != 0:
             raise ValueError(
-                f"layer count {L} not divisible by pipeline stages {n_stages}"
+                f"layer count {L} not divisible by pipeline stages "
+                f"{n_stages} x virtual {virtual}"
+            )
+        if virtual > 1:
+            return leaf.reshape(
+                (virtual, n_stages, L // (n_stages * virtual)) + leaf.shape[1:]
             )
         return leaf.reshape((n_stages, L // n_stages) + leaf.shape[1:])
 
     return jax.tree.map(reshape, stacked_params)
 
 
-def unpartition_layers(stage_params):
-    """[P, L/P, ...] → [L, ...] (for export / checkpoint consolidation)."""
-    return jax.tree.map(
-        lambda leaf: leaf.reshape((leaf.shape[0] * leaf.shape[1],) + leaf.shape[2:]),
-        stage_params,
-    )
+def unpartition_layers(stage_params, virtual: int = 1):
+    """[P, L/P, ...] (virtual=1) or [v, P, lc, ...] (virtual>1) →
+    [L, ...] for export / checkpoint consolidation. The circular
+    layout's row-major (round, stage, slot) order IS layer order, so one
+    reshape inverts both."""
+    lead = 3 if virtual > 1 else 2
+
+    def flat(leaf):
+        n = 1
+        for s in leaf.shape[:lead]:
+            n *= s
+        return leaf.reshape((n,) + leaf.shape[lead:])
+
+    return jax.tree.map(flat, stage_params)
 
 
 def pipeline_apply(
@@ -172,6 +193,168 @@ def pipeline_apply(
     (_, _), ys = jax.lax.scan(body, (state, key_state), (xs_in, mb_keys))
     # Microbatch m surfaces at the last stage on iteration m + P - 1.
     return jax.tree.map(lambda l: l[n_stage - 1 :], ys)
+
+
+def circular_schedule_len(M: int, n_stage: int, virtual: int) -> int:
+    """Scan steps the circular schedule runs: microbatches enter the
+    P-slot ring in waves of P, each occupying its slot for v*P steps;
+    the last microbatch exits at the START of step v*M + P - 1 (at
+    M = k*P), so the scan runs T = v*P*ceil(M/P) + P steps of which
+    T - 1 compute.
+
+    Bubble math (the point of the interleave, ref: Megatron interleaved
+    schedule / runtime/pipe/module.py docs): one chunk-step costs
+    tau/v (a stage's per-microbatch work tau split over v rounds), so
+    wall-clock at M = k*P is (v*M + P - 1) * tau/v = M*tau +
+    (P-1)*tau/v — the (P-1)*tau warmup/drain bubble of the plain
+    schedule divided by v."""
+    return virtual * n_stage * -(-M // n_stage) + n_stage
+
+
+def pipeline_apply_circular(
+    stage_fn: Callable,
+    stage_params: Any,
+    x: Any,
+    rng: Optional[jax.Array] = None,
+    state_spec: Any = None,
+):
+    """Interleaved (virtual-stage) pipeline: the circular schedule.
+
+    stage_params: pytree of [v, P, lc, ...] leaves (partition_layers
+    virtual=v — chunk r*P+p lives on physical stage p, round r). Each
+    microbatch rides the P-slot ring v times; per chunk-step every stage
+    applies ONE chunk (L/(v*P) layers), so the warmup/drain bubble is a
+    (P-1)-chunk-step affair instead of (P-1) full-stage steps — the
+    Megatron interleaved-1F1B bubble reduction expressed as SPMD
+    (ref: runtime/pipe/schedule.py TrainSchedule + Megatron interleaving;
+    here the schedule is the rotation arithmetic, not an instruction
+    list).
+
+    stage_fn(stage_chunks, carry, mb_key, stage_idx, round) -> carry':
+    applies chunk `round` of this stage's [v, lc, ...] local stack.
+    Rounds >= v mark empty slots (their compute is discarded).
+
+    Returns microbatch-major outputs [M, ...].
+    """
+    leaves = jax.tree.leaves(stage_params)
+    v, n_stage = leaves[0].shape[0], leaves[0].shape[1]
+    M = jax.tree.leaves(x)[0].shape[0]
+    Mp = -(-M // n_stage) * n_stage  # pad entries to full waves
+    T = circular_schedule_len(M, n_stage, v)
+
+    # Static entry/exit calendar: microbatch m enters stage 0 at
+    # t = v*P*(m//P) + m%P and exits (arrives back at slot 0 with
+    # round == v) exactly v*P steps later.
+    import numpy as np
+
+    entry_step = np.full((T,), Mp, np.int32)   # Mp = "no entry" sentinel
+    exit_step = np.full((T,), -1, np.int32)
+    for m in range(Mp):
+        e = v * n_stage * (m // n_stage) + (m % n_stage)
+        entry_step[e] = m
+        xe = e + v * n_stage
+        if xe < T and m < M:
+            exit_step[xe] = m
+    entry_idx = jnp.asarray(entry_step)
+    exit_idx = jnp.asarray(exit_step)
+
+    def pad_leaf(leaf):
+        pad = jnp.zeros((Mp - M,) + leaf.shape[1:], leaf.dtype)
+        return jnp.concatenate([leaf, pad], axis=0) if Mp > M else leaf
+
+    xs_in = jax.tree.map(pad_leaf, x)
+
+    if rng is not None:
+        mb_keys = jax.vmap(lambda i: jax.random.fold_in(rng, i))(jnp.arange(Mp))
+    else:
+        mb_keys = jnp.zeros((Mp, 2), jnp.uint32)
+
+    state = jax.tree.map(
+        lambda leaf: jnp.zeros((n_stage,) + leaf.shape[1:], leaf.dtype), x
+    )
+    out_acc = jax.tree.map(
+        lambda leaf: jnp.zeros((Mp,) + leaf.shape[1:], leaf.dtype), x
+    )
+    rounds0 = jnp.full((n_stage,), v, jnp.int32)  # all slots empty
+    key_state = jnp.zeros((n_stage,) + mb_keys.shape[1:], mb_keys.dtype)
+    stage_ids = jnp.arange(n_stage)
+
+    mesh = jax.sharding.get_abstract_mesh()
+    has_pipe = (
+        mesh is not None and not mesh.empty and mesh.shape.get("pipe", 1) > 1
+    )
+    vstage = jax.vmap(
+        stage_fn,
+        in_axes=(1, 0, 0, 0, 0),  # params [v, P, ...] batch over dim 1
+        spmd_axis_name="pipe" if has_pipe else None,
+    )
+
+    def constrain(tree):
+        if state_spec is None or not has_pipe:
+            return tree
+        return jax.tree.map(
+            lambda t, s: jax.lax.with_sharding_constraint(t, s) if s is not None else t,
+            tree,
+            state_spec,
+            is_leaf=lambda n: n is None or _is_spec(n),
+        )
+
+    def body(carry, t_idx):
+        h_state, k_state, rounds, out_acc = carry
+        ent, ext = entry_idx[t_idx], exit_idx[t_idx]
+        done = rounds[0] >= v
+        # Exit: a slot arriving at stage 0 with round == v carries a
+        # finished microbatch (predicated no-op write when ext < 0).
+        out_acc = jax.tree.map(
+            lambda acc, s: jax.lax.dynamic_update_index_in_dim(
+                acc,
+                jnp.where(
+                    done & (ext >= 0),
+                    s[0],
+                    jax.lax.dynamic_index_in_dim(acc, jnp.maximum(ext, 0), 0,
+                                                 keepdims=False),
+                ),
+                jnp.maximum(ext, 0), 0,
+            ),
+            out_acc, h_state,
+        )
+        # LoadMicroBatch into the freed slot (ent == Mp means no entry
+        # this step; the slot stays marked empty).
+        fresh = jax.tree.map(
+            lambda xs: jax.lax.dynamic_index_in_dim(
+                xs, jnp.minimum(ent, Mp - 1), 0, keepdims=False),
+            xs_in,
+        )
+        load = done & (ent < Mp)
+        h_state = jax.tree.map(
+            lambda s, f: s.at[0].set(jnp.where(load, f, s[0])), h_state, fresh
+        )
+        k_state = k_state.at[0].set(
+            jnp.where(load, mb_keys[jnp.minimum(ent, Mp - 1)], k_state[0])
+        )
+        rounds = rounds.at[0].set(jnp.where(load, 0, jnp.minimum(rounds[0], v)))
+        h_state = constrain(h_state)
+        # One chunk on every stage in parallel.
+        new_state = vstage(stage_params, h_state, k_state, stage_ids, rounds)
+        # keep empty slots inert (their compute is garbage)
+        live = (rounds < v)
+        new_state = jax.tree.map(
+            lambda n, o: jnp.where(
+                live.reshape((n_stage,) + (1,) * (n.ndim - 1)), n, o
+            ),
+            new_state, h_state,
+        )
+        # Rotate one stage; the slot wrapping P-1 -> 0 advances a round.
+        h_state = constrain(jax.tree.map(
+            lambda s: jnp.roll(s, 1, axis=0), new_state))
+        k_state = jnp.roll(k_state, 1, axis=0)
+        rounds = jnp.roll(rounds, 1, axis=0).at[0].add(1)
+        return (h_state, k_state, rounds, out_acc), ()
+
+    (h_state, k_state, rounds, out_acc), _ = jax.lax.scan(
+        body, (state, key_state, rounds0, out_acc), jnp.arange(T)
+    )
+    return jax.tree.map(lambda l: l[:M], out_acc)
 
 
 def stage_slice_keys(mb_key, n_layers: int, stage_idx, layers_per_stage: int):

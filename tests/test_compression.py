@@ -17,10 +17,11 @@ from deepspeed_tpu.models import transformer as T
 VOCAB = 128
 
 
-def model_cfg():
-    return T.TransformerConfig(vocab_size=VOCAB, n_layers=2, n_heads=4,
-                               d_model=64, max_seq=32, variant="llama",
-                               use_flash=False)
+def model_cfg(**kw):
+    base = dict(vocab_size=VOCAB, n_layers=2, n_heads=4,
+                d_model=64, max_seq=32, variant="llama", use_flash=False)
+    base.update(kw)
+    return T.TransformerConfig(**base)
 
 
 QAT_CFG = {
@@ -208,3 +209,106 @@ class TestActivationQuantization:
             build_compression({
                 "activation_quantization": {
                     "shared_parameters": {"enabled": True}}})
+
+
+class TestBitDecay:
+    """Progressive bit narrowing (ref: runtime/quantize.py
+    compute_quantization:129 — period doubles per one-bit reduction)."""
+
+    def test_decay_schedule_values(self):
+        from deepspeed_tpu.compression.compress import _decayed_bits
+
+        # start 8 -> target 4, period 100: reductions at 100, 200, 400
+        got = [float(_decayed_bits(s, 8, 4, 100))
+               for s in (0, 99, 100, 199, 200, 399, 400, 10_000)]
+        assert got == [8, 8, 7, 7, 6, 6, 5, 4]
+
+    def test_no_period_means_target_immediately(self):
+        from deepspeed_tpu.compression.compress import _decayed_bits
+
+        assert float(_decayed_bits(0, 8, 4, 0)) == 4.0
+
+    def test_qat_rule_tracks_decay(self):
+        """The applied transform quantizes more coarsely as bits drop."""
+        cfg = {"weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {"g": {"params": {
+                "start_bits": 8, "target_bits": 2,
+                "quantization_period": 10}}}}}
+        apply = build_compression(cfg)
+        rng = np.random.default_rng(0)
+        params = {"w": jnp.asarray(rng.normal(size=(16, 16)), jnp.float32)}
+        early = np.asarray(apply(params, jnp.int32(0))["w"])
+        late = np.asarray(apply(params, jnp.int32(10_000))["w"])
+        # 2-bit lattice has <= 3 distinct magnitudes; 8-bit has many
+        assert len(np.unique(np.abs(late))) <= 3
+        assert len(np.unique(np.abs(early))) > 10
+
+
+class TestKnowledgeDistillation:
+    """Student init + KD loss (ref: compression/compress.py:192
+    student_initialization)."""
+
+    def _teacher(self):
+        cfg = model_cfg()
+        return cfg, T.init(cfg, jax.random.PRNGKey(0))
+
+    def test_student_initialization_gathers_layers(self):
+        from deepspeed_tpu.compression import student_initialization
+
+        tcfg, tparams = self._teacher()
+        student = student_initialization(
+            tparams, {"layer_reduction": {
+                "enabled": True, "keep_number_layers": 1,
+                "teacher_layer": [1]}})
+        np.testing.assert_array_equal(
+            np.asarray(student["layers"]["wq"][0]),
+            np.asarray(tparams["layers"]["wq"][1]))
+        assert student["layers"]["wq"].shape[0] == 1
+        np.testing.assert_array_equal(np.asarray(student["embed"]),
+                                      np.asarray(tparams["embed"]))
+
+    def test_keep_number_mismatch_raises(self):
+        from deepspeed_tpu.compression import student_initialization
+
+        tcfg, tparams = self._teacher()
+        with pytest.raises(ValueError, match="keep_number_layers"):
+            student_initialization(tparams, {"layer_reduction": {
+                "enabled": True, "keep_number_layers": 3,
+                "teacher_layer": [0]}})
+
+    def test_distillation_loss_trains_student(self, rng):
+        from deepspeed_tpu.compression import (
+            make_distillation_loss_fn, student_initialization)
+
+        tcfg, tparams = self._teacher()
+        scfg = model_cfg(n_layers=1)
+        sparams = student_initialization(
+            tparams, {"layer_reduction": {"enabled": True,
+                                          "teacher_layer": [1]}})
+        loss_fn = make_distillation_loss_fn(
+            scfg, tcfg, tparams, alpha=0.5, temperature=2.0)
+        engine = ds.initialize(
+            {"train_micro_batch_size_per_gpu": 2,
+             "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+             "steps_per_print": 10**9},
+            loss_fn=loss_fn,
+            params=sparams,
+            param_logical_specs=T.logical_specs(scfg))
+        batch = {"tokens": rng.integers(
+            0, 128, (engine.config.train_batch_size, 17)).astype(np.int32)}
+        losses = [float(engine.train_batch(batch)["loss"]) for _ in range(8)]
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+
+    def test_alpha_one_is_plain_ce(self, rng):
+        from deepspeed_tpu.compression import make_distillation_loss_fn
+
+        tcfg, tparams = self._teacher()
+        loss_fn = make_distillation_loss_fn(tcfg, tcfg, tparams, alpha=1.0)
+        base = T.make_loss_fn(tcfg)
+        batch = {"tokens": jnp.asarray(
+            rng.integers(0, 128, (2, 17)).astype(np.int32))}
+        a = float(loss_fn(tparams, batch, None))
+        b = float(base(tparams, batch, None))
+        np.testing.assert_allclose(a, b, rtol=1e-6)

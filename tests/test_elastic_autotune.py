@@ -106,6 +106,90 @@ class TestElasticity:
             )
 
 
+class TestElasticResume:
+    """The DSElasticAgent journey (ref: elasticity/elastic_agent.py:28
+    restart-and-continue): train under one world size, kill, rebuild at
+    a DIFFERENT world size from the same elastic config + checkpoint —
+    the global batch re-derives identically and the loss trajectory
+    continues as if uninterrupted."""
+
+    ECFG = {
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 64,
+            "micro_batch_sizes": [2, 4],
+            "min_gpus": 1,
+            "max_gpus": 64,
+        },
+        "steps_per_print": 10**9,
+        "seed": 11,
+    }
+
+    def _model(self):
+        return T.TransformerConfig(vocab_size=VOCAB, n_layers=2, n_heads=4,
+                                   d_model=64, max_seq=32, variant="llama",
+                                   use_flash=False)
+
+    def _engine(self, n_dev):
+        import jax
+
+        from deepspeed_tpu.platform.mesh import build_mesh
+
+        mcfg = self._model()
+        mesh = build_mesh({"data": n_dev}, devices=jax.devices()[:n_dev])
+        return ds.initialize(
+            dict(self.ECFG),
+            loss_fn=T.make_loss_fn(mcfg),
+            param_init_fn=lambda k: T.init(mcfg, k),
+            param_logical_specs=T.logical_specs(mcfg),
+            mesh=mesh,
+        )
+
+    def test_resume_at_smaller_world_continues_trajectory(self, tmp_path):
+        r = np.random.default_rng(3)
+        a = self._engine(8)
+        B = a.config.train_batch_size
+        # elastic derivation must close the triangle at dp=8
+        assert B == (a.config.train_micro_batch_size_per_gpu
+                     * a.config.gradient_accumulation_steps * 8)
+        stream = [
+            {"tokens": r.integers(0, VOCAB, (B, 33)).astype(np.int32)}
+            for _ in range(6)
+        ]
+        for b in stream[:3]:
+            a.train_batch(b)
+        a.save_checkpoint(str(tmp_path))
+        # uninterrupted reference trajectory
+        ref = [float(a.train_batch(b)["loss"]) for b in stream[3:]]
+
+        # "restart" at dp=4: same elastic config re-derives the SAME
+        # global batch with a different micro/gas split
+        b_eng = self._engine(4)
+        assert b_eng.config.train_batch_size == B
+        assert b_eng.config.train_micro_batch_size_per_gpu * \
+            b_eng.config.gradient_accumulation_steps * 4 == B
+        b_eng.load_checkpoint(str(tmp_path))
+        assert b_eng.global_steps == 3
+        got = [float(b_eng.train_batch(b)["loss"]) for b in stream[3:]]
+        # same global batch + fp32 -> the trajectory continues (grad
+        # accumulation order differs, so allclose not equality)
+        np.testing.assert_allclose(got, ref, rtol=2e-4)
+
+    def test_resume_at_larger_world(self, tmp_path):
+        r = np.random.default_rng(4)
+        a = self._engine(2)
+        B = a.config.train_batch_size
+        batch = {"tokens": r.integers(0, VOCAB, (B, 33)).astype(np.int32)}
+        a.train_batch(batch)
+        a.save_checkpoint(str(tmp_path))
+        b_eng = self._engine(8)
+        assert b_eng.config.train_batch_size == B
+        b_eng.load_checkpoint(str(tmp_path))
+        loss = float(b_eng.train_batch(batch)["loss"])
+        assert np.isfinite(loss)
+
+
 class TestAutotuner:
     def test_tune_picks_feasible_config(self, tmp_path):
         mcfg = T.TransformerConfig(vocab_size=VOCAB, n_layers=2, n_heads=4,

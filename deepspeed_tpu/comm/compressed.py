@@ -102,7 +102,17 @@ def compressed_mean(partials, e_worker, e_server, mesh):
     sign_s = jax.lax.optimization_barrier(sign_s)
     sign_all = cst(sign_s, (None, None))
     scale_all = cst(scale_s, (None,))
-    out = (scale_all[:, None] * sign_all.astype(jnp.float32)).reshape(npad)[:n]
+    # barrier the REPLICATED codes and pin the decompressed product
+    # replicated at birth: it must be reconstructed locally from the
+    # gathered codes — otherwise the partitioner computes it sharded (to
+    # please the sharded optimizer-update consumers) and satisfies the
+    # replicated-momentum storage with a 4-byte/param f32 gather,
+    # re-introducing the traffic the int8 hop just saved
+    sign_all, scale_all = jax.lax.optimization_barrier((sign_all, scale_all))
+    prod = scale_all[:, None] * sign_all.astype(jnp.float32)
+    prod = cst(prod, (None, None))
+    prod = jax.lax.optimization_barrier(prod)
+    out = prod.reshape(npad)[:n]
     return out.reshape(shape), e_worker_new, e_server_new
 
 

@@ -202,8 +202,12 @@ def build_compression(config: Dict[str, Any]) -> Optional[Callable]:
                 if not _match(name, mods):
                     continue
                 if kind == "qat":
+                    # decay counts from schedule_offset (the reference's
+                    # q_period counter starts when quantization starts),
+                    # so the warm high-bit phases survive a late offset
                     out = _fake_quant(w, _decayed_bits(
-                        step, prm["start_bits"], prm["bits"], prm["period"]))
+                        step - prm["offset"], prm["start_bits"],
+                        prm["bits"], prm["period"]))
                 else:
                     out = w * jax.lax.stop_gradient(
                         _MASKS[kind](w, prm["dense_ratio"]))
@@ -215,7 +219,9 @@ def build_compression(config: Dict[str, Any]) -> Optional[Callable]:
     return apply
 
 
-def student_initialization(teacher_params, config: Dict[str, Any]):
+def student_initialization(teacher_params, config: Dict[str, Any],
+                           teacher_pipeline_stages: int = 1,
+                           teacher_virtual_stages: int = 1):
     """Initialize a shallower student from chosen teacher layers
     (ref: compression/compress.py:192 student_initialization — there it
     copies module-by-module via recursive_getattr over the
@@ -236,8 +242,15 @@ def student_initialization(teacher_params, config: Dict[str, Any]):
         raise ValueError(
             f"keep_number_layers {keep} != len(teacher_layer) {idx.shape[0]}"
         )
+    layers = teacher_params["layers"]
+    if teacher_pipeline_stages > 1:
+        # a pipelined teacher stores layers stage-partitioned — flatten
+        # so teacher_layer indexes LAYERS, not stage blocks
+        from ..runtime.pipe import unpartition_layers
+
+        layers = unpartition_layers(layers, virtual=teacher_virtual_stages)
     student = {k: v for k, v in teacher_params.items() if k != "layers"}
-    student["layers"] = jax.tree.map(lambda w: w[idx], teacher_params["layers"])
+    student["layers"] = jax.tree.map(lambda w: w[idx], layers)
     return student
 
 

@@ -79,10 +79,11 @@ def logical_to_mesh_spec(
             mapped = (mapped,)
         live = tuple(ax for ax in mapped if mesh.shape.get(ax, 1) > 1 and ax not in used)
         if shape is not None and live:
-            # keep the longest PREFIX of axes whose cumulative product
-            # divides the dim — a non-dividing trailing axis must not
-            # strip the sharding the leading axes still provide (e.g.
-            # vocab 32000 under model=2 x pipe=3 keeps the 2-way shard)
+            # keep every axis whose CUMULATIVE product still divides the
+            # dim (a non-dividing axis is skipped, later ones are still
+            # tried) — one bad axis must not strip the sharding the
+            # others provide (e.g. vocab 32000 under model=2 x pipe=3
+            # keeps the 2-way model shard)
             kept = []
             total = 1
             for ax in live:

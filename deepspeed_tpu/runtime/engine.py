@@ -280,8 +280,15 @@ class DeepSpeedTPUEngine:
             # 1-bit Adam needs per-worker partial gradients (params
             # replicated over the data axes) — ref: onebit/adam.py is
             # likewise an FP16_Optimizer-path feature, not a ZeRO one.
-            if config.zero_stage > 0:
-                raise NotImplementedError("1-bit Adam requires zero stage 0")
+            # 1-bit × ZeRO-1 composes here (master+nu shard over 'zero';
+            # mu/error memories stay replicated — see _build_onebit_step);
+            # higher stages shard grads/params, which the compression hop
+            # fundamentally conflicts with.
+            max_stage = 1 if self._onebit else 0
+            if config.zero_stage > max_stage:
+                raise NotImplementedError(
+                    f"{'1-bit Adam supports zero stages 0-1' if self._onebit else '0/1 Adam requires zero stage 0'}"
+                )
             if config.fp16.enabled:
                 raise NotImplementedError("1-bit Adam: use bf16, not fp16")
             if pipelined or self.mesh.shape.get("expert", 1) > 1:
@@ -496,8 +503,24 @@ class DeepSpeedTPUEngine:
                     lambda _: NamedSharding(mesh, P(("data", "zero"))),
                     opt_struct[k],
                 )
+            elif k == "mu" and self._onebit and self.config.zero_stage >= 1:
+                # 1-bit × ZeRO-1: momentum stays replicated — the local
+                # accumulation b1*mu + (1-b1)*g_w needs the full tree on
+                # every worker, and sharding it would re-introduce an
+                # fp32 allgather per step (master + nu still shard)
+                opt_shardings[k] = shd.tree_shardings(self.param_specs, mesh)
             else:
                 opt_shardings[k] = o_shd
+        # every step program constrains its opt/master outputs to this
+        # layout, so (a) phase-switching optimizers (1-bit warmup →
+        # compressed) never see a layout drift XLA chose for one program
+        # but not the other, and (b) the update math stays SHARDED with
+        # the ZeRO layout instead of gathering fp32 state
+        self._opt_state_shardings = opt_shardings
+        # the fp32 update's natural layout (ZeRO shards) — used by the
+        # finalizer to pin the compute-dtype cast BEFORE the param
+        # regather even when no master is stored
+        self._master_shardings = o_shd
         out_shardings = TrainState(
             step=NamedSharding(mesh, P()),
             params=p_shd,
@@ -697,19 +720,74 @@ class DeepSpeedTPUEngine:
 
         return accumulate
 
+    def _make_finalizer(self):
+        """(new_master, new_opt, new_step, loss_scale, metrics) ->
+        (TrainState, metrics): the shared tail of every compiled step —
+        cast the updated master to the compute dtype under the param
+        storage constraint (the ZeRO allgather point) and rebuild the
+        TrainState. Extracted so the plain/1-bit/0-1-Adam step builders
+        are each just 'produce grads → optimizer stage → finalize'
+        (avoiding the reference engine.py's per-path duplication,
+        ref: runtime/engine.py:180's 3.6k-line fate)."""
+        mesh = self.mesh
+        param_specs = self.param_specs
+        compute_dtype = self.compute_dtype
+        use_master = self._use_master
+        opt_shd = getattr(self, "_opt_state_shardings", None)
+        master_shd = getattr(self, "_master_shardings", None)
+
+        def finish(new_master, new_opt, new_step, loss_scale, metrics):
+            if opt_shd is not None:
+                new_opt = jax.tree.map(
+                    jax.lax.with_sharding_constraint, new_opt, opt_shd
+                )
+            if use_master and master_shd is not None:
+                new_master = jax.tree.map(
+                    jax.lax.with_sharding_constraint, new_master, master_shd
+                )
+
+            def cast_gather(m, store_spec, mshd=None):
+                x = m.astype(compute_dtype)
+                if mshd is not None:
+                    # pin the compute-dtype cast to the SHARDED layout and
+                    # barrier before regathering, so the ZeRO param
+                    # allgather moves bf16, not fp32 (XLA otherwise
+                    # reorders to gather-then-convert)
+                    x = jax.lax.with_sharding_constraint(x, mshd)
+                    x = jax.lax.optimization_barrier(x)
+                return shd.constraint(x, store_spec, mesh)
+
+            if master_shd is not None:
+                new_params = jax.tree.map(
+                    cast_gather, new_master, param_specs, master_shd
+                )
+            else:
+                new_params = jax.tree.map(
+                    cast_gather, new_master, param_specs
+                )
+            state = TrainState(
+                step=new_step,
+                params=new_params,
+                master=new_master if use_master else None,
+                opt=new_opt,
+                loss_scale=loss_scale,
+            )
+            metrics.setdefault("skipped", jnp.zeros((), jnp.int32))
+            return state, metrics
+
+        return finish
+
     def _build_train_step(self):
         cfg = self.config
         optimizer = self.optimizer
         schedule = self.lr_schedule
-        mesh = self.mesh
-        param_specs = self.param_specs
-        compute_dtype = self.compute_dtype
         use_master = self._use_master
         fp16 = cfg.fp16.enabled
         clip = cfg.gradient_clipping
         seed = self._rng_seed
         accumulate = self._make_accumulator()
         fetch_params = self._make_param_fetch()
+        finish = self._make_finalizer()
 
         def step_fn(state: TrainState, batch):
             master = (
@@ -748,18 +826,6 @@ class DeepSpeedTPUEngine:
             else:
                 new_ls = state.loss_scale
 
-            new_params = jax.tree.map(
-                lambda m, s: shd.constraint(m.astype(compute_dtype), s, mesh),
-                new_master,
-                param_specs,
-            )
-            new_state = TrainState(
-                step=new_step,
-                params=new_params,
-                master=new_master if use_master else None,
-                opt=new_opt,
-                loss_scale=new_ls,
-            )
             metrics = {
                 "loss": loss,
                 "grad_norm": grad_norm,
@@ -768,7 +834,7 @@ class DeepSpeedTPUEngine:
             }
             if fp16:
                 metrics["loss_scale"] = new_ls.scale
-            return new_state, metrics
+            return finish(new_master, new_opt, new_step, new_ls, metrics)
 
         return jax.jit(step_fn, donate_argnums=(0,))
 
@@ -855,37 +921,39 @@ class DeepSpeedTPUEngine:
     def _build_onebit_step(self):
         """Compression-phase step for 1-bit Adam: per-worker grads →
         local momentum → error-feedback 1-bit averaged momentum → frozen-
-        variance Adam update (ref: runtime/fp16/onebit/adam.py:210)."""
+        variance Adam update (ref: runtime/fp16/onebit/adam.py:210).
+
+        Composes with ZeRO-1: master + nu are 'zero'-sharded while mu
+        and the error memories stay replicated/worker-major (the local
+        momentum accumulation needs full mu — sharding it would cost an
+        fp32 allgather per step, the very traffic 1-bit removes). The
+        gradient forward then runs off the replicated bf16 params, and
+        the finalizer's cast-under-constraint IS the ZeRO-1 param
+        allgather — independent of the compression hop, as the two paths
+        never exchange full-precision gradients."""
         optimizer = self.optimizer
         schedule = self.lr_schedule
         mesh = self.mesh
-        param_specs = self.param_specs
-        compute_dtype = self.compute_dtype
         use_master = self._use_master
+        zero1 = self.config.zero_stage >= 1
         seed = self._rng_seed
         worker_acc = self._make_worker_accumulator()
+        finish = self._make_finalizer()
 
         def step_fn(state: TrainState, batch):
             master = state.master if use_master else cast_params(state.params, jnp.float32)
+            # ZeRO-1: grads come from the replicated params (the sharded
+            # master would allgather fp32 into the worker shard_map)
+            grad_src = (
+                cast_params(state.params, jnp.float32) if zero1 else master
+            )
             base_rng = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
-            wgrads, losses = worker_acc(master, batch, base_rng)
+            wgrads, losses = worker_acc(grad_src, batch, base_rng)
             loss = jnp.mean(losses)
             new_step = state.step + 1
             lr = schedule(state.step)
             new_master, new_opt = optimizer.compressed_update(
                 wgrads, state.opt, master, lr, new_step, mesh
-            )
-            new_params = jax.tree.map(
-                lambda m, s: shd.constraint(m.astype(compute_dtype), s, mesh),
-                new_master,
-                param_specs,
-            )
-            new_state = TrainState(
-                step=new_step,
-                params=new_params,
-                master=new_master if use_master else None,
-                opt=new_opt,
-                loss_scale=state.loss_scale,
             )
             metrics = {
                 "loss": loss,
@@ -893,9 +961,9 @@ class DeepSpeedTPUEngine:
                 # the uncompressed reduction this phase exists to avoid)
                 "grad_norm": global_grad_norm(new_opt["mu"]),
                 "lr": lr,
-                "skipped": jnp.zeros((), jnp.int32),
             }
-            return new_state, metrics
+            return finish(new_master, new_opt, new_step, state.loss_scale,
+                          metrics)
 
         return jax.jit(step_fn, donate_argnums=(0,))
 
@@ -906,8 +974,6 @@ class DeepSpeedTPUEngine:
         optimizer = self.optimizer
         schedule = self.lr_schedule
         mesh = self.mesh
-        param_specs = self.param_specs
-        compute_dtype = self.compute_dtype
         use_master = self._use_master
         seed = self._rng_seed
         # worker_u is identically zero through phase 1 — build full/onebit
@@ -915,6 +981,7 @@ class DeepSpeedTPUEngine:
         # tree every step
         with_delta = kind in ("local", "sync")
         worker_acc = self._make_worker_accumulator(with_delta=with_delta)
+        finish = self._make_finalizer()
         upd = {
             "full": optimizer.full_update,
             "onebit": optimizer.onebit_update,
@@ -935,18 +1002,6 @@ class DeepSpeedTPUEngine:
             new_step = state.step + 1
             lr = schedule(state.step)
             new_master, new_opt = upd(wgrads, state.opt, master, lr, mesh)
-            new_params = jax.tree.map(
-                lambda m, s: shd.constraint(m.astype(compute_dtype), s, mesh),
-                new_master,
-                param_specs,
-            )
-            new_state = TrainState(
-                step=new_step,
-                params=new_params,
-                master=new_master if use_master else None,
-                opt=new_opt,
-                loss_scale=state.loss_scale,
-            )
             if kind in ("local", "sync"):
                 # per-replica momentum norm: worker_mu is worker-major, so
                 # normalize by sqrt(dp) to stay comparable with the
@@ -963,9 +1018,9 @@ class DeepSpeedTPUEngine:
                 # reduction the local/1-bit phases exist to avoid)
                 "grad_norm": norm,
                 "lr": lr,
-                "skipped": jnp.zeros((), jnp.int32),
             }
-            return new_state, metrics
+            return finish(new_master, new_opt, new_step, state.loss_scale,
+                          metrics)
 
         return jax.jit(step_fn, donate_argnums=(0,))
 

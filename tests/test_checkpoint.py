@@ -6,6 +6,11 @@ import numpy as np
 import deepspeed_tpu as ds
 from deepspeed_tpu.models import transformer as T
 
+# interpreter-/compile-heavy: excluded from the fast lane (-m 'not slow')
+import pytest  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
 VOCAB = 64
 
 

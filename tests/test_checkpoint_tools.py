@@ -21,6 +21,9 @@ from deepspeed_tpu.utils.zero_to_fp32 import (
     get_fp32_state_dict_from_checkpoint,
 )
 
+# interpreter-/compile-heavy: excluded from the fast lane (-m 'not slow')
+pytestmark = pytest.mark.slow
+
 VOCAB = 128
 
 

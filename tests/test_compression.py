@@ -14,6 +14,9 @@ import deepspeed_tpu as ds
 from deepspeed_tpu.compression import build_compression, clean_compressed_params
 from deepspeed_tpu.models import transformer as T
 
+# interpreter-/compile-heavy: excluded from the fast lane (-m 'not slow')
+pytestmark = pytest.mark.slow
+
 VOCAB = 128
 
 

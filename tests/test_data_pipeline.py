@@ -17,6 +17,9 @@ from deepspeed_tpu.runtime.data_pipeline import (
     truncate_to_seqlen,
 )
 
+# interpreter-/compile-heavy: excluded from the fast lane (-m 'not slow')
+pytestmark = pytest.mark.slow
+
 VOCAB = 128
 
 

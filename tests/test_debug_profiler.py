@@ -16,6 +16,9 @@ from deepspeed_tpu.runtime.debug import (
 )
 from deepspeed_tpu.utils.profiler import annotate, capture_step_trace, trace
 
+# interpreter-/compile-heavy: excluded from the fast lane (-m 'not slow')
+pytestmark = pytest.mark.slow
+
 VOCAB = 128
 
 

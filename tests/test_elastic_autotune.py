@@ -18,6 +18,9 @@ from deepspeed_tpu.elasticity import (
 )
 from deepspeed_tpu.models import transformer as T
 
+# interpreter-/compile-heavy: excluded from the fast lane (-m 'not slow')
+pytestmark = pytest.mark.slow
+
 VOCAB = 128
 
 

@@ -7,9 +7,13 @@ import pytest
 
 from deepspeed_tpu.ops.evoformer_attention import evoformer_attention
 from deepspeed_tpu.runtime.indexed_dataset import (
+
     MMapIndexedDataset,
     MMapIndexedDatasetBuilder,
 )
+
+# interpreter-/compile-heavy: excluded from the fast lane (-m 'not slow')
+pytestmark = pytest.mark.slow
 
 
 def dense_oracle(q, k, v, biases):

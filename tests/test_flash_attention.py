@@ -17,10 +17,14 @@ from deepspeed_tpu.ops.attention import _xla_attention, causal_attention
 BLK = 128 if jax.default_backend() == "tpu" else 64
 
 from deepspeed_tpu.ops.pallas.flash_attention import (
+
     _flash_bwd,
     _flash_fwd,
     flash_attention,
 )
+
+# interpreter-/compile-heavy: excluded from the fast lane (-m 'not slow')
+pytestmark = pytest.mark.slow
 
 
 def make_qkv(rng, B=2, S=128, H=2, KV=None, D=64, dtype=jnp.float32):

@@ -12,6 +12,11 @@ import deepspeed_tpu as ds
 from deepspeed_tpu.models import transformer as T
 from deepspeed_tpu.runtime.hybrid_engine import HybridEngine
 
+# interpreter-/compile-heavy: excluded from the fast lane (-m 'not slow')
+import pytest  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
 VOCAB = 128
 
 

@@ -18,9 +18,13 @@ from deepspeed_tpu.inference import (
 )
 from deepspeed_tpu.models import transformer as T
 from deepspeed_tpu.ops.pallas.paged_attention import (
+
     paged_decode_attention,
     paged_decode_attention_xla,
 )
+
+# interpreter-/compile-heavy: excluded from the fast lane (-m 'not slow')
+pytestmark = pytest.mark.slow
 
 
 class TestBlockedAllocator:

@@ -14,6 +14,11 @@ import sys
 
 from deepspeed_tpu.launcher.runner import launch_local
 
+# interpreter-/compile-heavy: excluded from the fast lane (-m 'not slow')
+import pytest  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
 TIMEOUT_S = 420
 
 

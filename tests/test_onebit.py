@@ -19,6 +19,9 @@ from deepspeed_tpu.comm.compressed import (
 )
 from deepspeed_tpu.models import transformer as T
 
+# interpreter-/compile-heavy: excluded from the fast lane (-m 'not slow')
+pytestmark = pytest.mark.slow
+
 VOCAB = 128
 
 
@@ -169,8 +172,9 @@ class TestOnebitAdam:
         assert abs(lo[-1] - la[-1]) / la[-1] < 0.05, (lo[-1], la[-1])
 
     def test_zero_stage_raises(self):
-        with pytest.raises(NotImplementedError, match="zero stage 0"):
-            build(freeze_step=5, zero_optimization={"stage": 1})
+        # stage 1 composes now (TestOnebitZero1); stage 2+ still refuses
+        with pytest.raises(NotImplementedError, match="zero stages 0-1"):
+            build(freeze_step=5, zero_optimization={"stage": 2})
 
 
 def zo_cfg(**opt_kw):
@@ -197,6 +201,67 @@ def zo_build(**opt_kw):
         param_init_fn=lambda k: T.init(mcfg, k),
         param_logical_specs=T.logical_specs(mcfg),
     )
+
+
+class TestOnebitZero1:
+    """1-bit Adam × ZeRO-1 (VERDICT r2 W3: the param allgather is
+    independent of the grad-compression hop, so the combo must compose):
+    master + variance shard over the data axis, momentum/error memories
+    stay replicated/worker-major, and the trajectory matches stage 0."""
+
+    def test_trajectory_matches_stage0(self):
+        batches = data(8)
+        e0 = build(freeze_step=3)
+        l0 = [e0.train_batch(b)["loss"] for b in batches]
+        e1 = build(freeze_step=3, zero_optimization={"stage": 1})
+        l1 = [e1.train_batch(b)["loss"] for b in batches]
+        # warmup (exact Adam) AND compressed phase must both match
+        np.testing.assert_allclose(l1, l0, rtol=2e-4)
+
+    def test_state_layout(self):
+        e = build(freeze_step=2, zero_optimization={"stage": 1},
+                  bf16={"enabled": True})
+        e.train_batch(data(1)[0])
+        opt = e.state.opt
+        master = e.state.master["embed"]
+        nu = opt["nu"]["embed"]
+        mu = opt["mu"]["embed"]
+        # master + nu sharded over the data axes; mu replicated
+        assert master.sharding.shard_shape(master.shape) != master.shape
+        assert nu.sharding.shard_shape(nu.shape) != nu.shape
+        assert mu.sharding.shard_shape(mu.shape) == mu.shape
+        # params replicated (stage-1 storage)
+        p = e.state.params["embed"]
+        assert p.sharding.shard_shape(p.shape) == p.shape
+
+    def test_compressed_phase_no_fp32_grad_exchange(self):
+        """The wire still carries int8 momentum codes + the bf16 param
+        allgather — never a full fp32 gradient reduction."""
+        from deepspeed_tpu.profiling.hlo import collective_volumes
+
+        # bf16 (the supported 1-bit precision): wire = int8 momentum hops
+        # + the 2-byte param allgather of ZeRO-1
+        e = build(freeze_step=1, zero_optimization={"stage": 1},
+                  bf16={"enabled": True})
+        e.train_batch(data(1)[0])  # enter compressed phase
+        b = e.shard_batch(e._reshape_gas(data(1)[0]), leading_accum_dim=True)
+        with jax.sharding.set_mesh(e.mesh):
+            c = e._build_onebit_step().lower(e.state, b).compile()
+        vol = sum(v["bytes"] for v in collective_volumes(c).values())
+        n_params = sum(int(np.prod(p.shape))
+                       for p in jax.tree.leaves(e.state.params))
+        # wire budget: int8 momentum hops (~2 B/param incl. scatter+gather)
+        # + one fp32 materialization of the replicated momentum (~4 B —
+        # the SPMD partitioner computes the decompressed mean sharded for
+        # the ZeRO-sharded update and regathers it for the replicated mu
+        # storage; pinned constraints don't dislodge it at this scale).
+        # Still strictly below a ring fp32 grad allreduce (~8 B/param),
+        # which is what stage-1 WITHOUT compression would move.
+        assert vol < 7 * n_params, (vol, n_params)
+
+    def test_zero2_still_raises(self):
+        with pytest.raises(NotImplementedError, match="zero stages 0-1"):
+            build(freeze_step=2, zero_optimization={"stage": 2})
 
 
 class TestZeroOneAdam:

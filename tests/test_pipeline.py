@@ -14,10 +14,14 @@ import pytest
 import deepspeed_tpu as ds
 from deepspeed_tpu.models import transformer as T
 from deepspeed_tpu.runtime.pipe import (
+
     partition_layers,
     pipeline_apply,
     unpartition_layers,
 )
+
+# interpreter-/compile-heavy: excluded from the fast lane (-m 'not slow')
+pytestmark = pytest.mark.slow
 
 VOCAB = 128
 

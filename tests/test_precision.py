@@ -6,12 +6,18 @@ import numpy as np
 
 from deepspeed_tpu.config.config import FP16Config
 from deepspeed_tpu.runtime.precision import (
+
     clip_grads_by_global_norm,
     found_inf_in_grads,
     global_grad_norm,
     init_loss_scale,
     update_loss_scale,
 )
+
+# interpreter-/compile-heavy: excluded from the fast lane (-m 'not slow')
+import pytest  # noqa: E402
+
+pytestmark = pytest.mark.slow
 
 
 def cfg(**kw):

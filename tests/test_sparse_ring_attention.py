@@ -14,10 +14,14 @@ import deepspeed_tpu as ds
 from deepspeed_tpu.models import transformer as T
 from deepspeed_tpu.ops.attention import causal_attention
 from deepspeed_tpu.ops.sparse_attention import (
+
     SparsityConfig,
     layout_density,
     sparse_causal_attention,
 )
+
+# interpreter-/compile-heavy: excluded from the fast lane (-m 'not slow')
+pytestmark = pytest.mark.slow
 
 VOCAB = 128
 

@@ -86,14 +86,74 @@ class Autotuner:
             "compile_s": compile_s,
         }
 
+    # ------------------------------------------------------------------
+    # candidate space + application
+    # ------------------------------------------------------------------
+    def _apply_candidate(self, cand: Dict[str, Any]) -> Dict[str, Any]:
+        cfg = json.loads(json.dumps(self.base_config))
+        cfg.setdefault("zero_optimization", {})["stage"] = cand["zero_stage"]
+        cfg["train_micro_batch_size_per_gpu"] = cand["micro_batch_size"]
+        cfg.pop("train_batch_size", None)
+        if cand.get("remat") is not None:
+            cfg["activation_checkpointing"] = {
+                "partition_activations": False,
+                "policy": cand["remat"],
+            }
+        if cand.get("offload_optimizer") is not None:
+            cfg.setdefault("zero_optimization", {})["offload_optimizer"] = {
+                "device": cand["offload_optimizer"]
+            }
+        return cfg
+
+    def _run_exp(self, cand: Dict[str, Any], steps: int) -> Dict[str, Any]:
+        exp = dict(cand)
+        try:
+            exp.update(self._measure(self._apply_candidate(cand), steps))
+            exp["ok"] = True
+        except Exception as e:  # OOM / infeasible shape / bad combo
+            exp.update({"ok": False, "error": f"{type(e).__name__}: {e}"})
+        self.results.append(exp)
+        log_dist(f"autotune exp: {exp}", ranks=[0])
+        return exp
+
+    def _flush_results(self):
+        os.makedirs(self.results_dir, exist_ok=True)
+        with open(os.path.join(self.results_dir, "exps.jsonl"), "w") as f:
+            for r in self.results:
+                f.write(json.dumps(r) + "\n")
+
     def tune(
         self,
         zero_stages: Sequence[int] = (0, 1, 2, 3),
         micro_batch_sizes: Optional[Sequence[int]] = None,
         steps: int = 3,
         max_micro_batch: int = 64,
+        strategy: str = "fast",
+        remat_policies: Optional[Sequence[Optional[str]]] = None,
+        offload_devices: Optional[Sequence[Optional[str]]] = None,
+        num_trials: Optional[int] = None,
+        seed: int = 0,
     ) -> Dict[str, Any]:
-        """Grid/fast search → best config dict (ref: autotuner.py tune:404).
+        """Search the config space → best config dict (ref: autotuner.py
+        tune:404 + autotuning/tuner/base_tuner.py strategy classes).
+
+        strategy:
+          'fast'   — the reference's fast mode: zero-stage × micro-batch
+                     doubling with an OOM wall break (remat/offload axes
+                     excluded to keep the sweep short)
+          'grid'   — GridSearchTuner: every combination, including the
+                     TPU-relevant remat and offload_optimizer axes
+          'random' — RandomTuner: num_trials uniform samples of the grid
+          'model'  — ModelBasedTuner: half the budget explores at random,
+                     then an additive performance model (axis-wise mean
+                     deviations over measured points) ranks the rest and
+                     the top predictions are measured
+
+        remat_policies: values for activation_checkpointing.policy
+        (None = leave base config; e.g. ('none','dots','full')).
+        offload_devices: zero_optimization.offload_optimizer.device
+        values (None = leave base; e.g. (None,'cpu')) — the knobs that
+        actually matter on TPU (HBM is the binding constraint).
 
         Results (including failures) land in <results_dir>/exps.jsonl —
         the per-experiment record the reference writes per exp dir.
@@ -108,47 +168,88 @@ class Autotuner:
                 m *= 2
         else:
             mbs = list(micro_batch_sizes)
+        remats = list(remat_policies) if remat_policies else [None]
+        offloads = list(offload_devices) if offload_devices else [None]
 
         best = None
-        for stage in zero_stages:
-            stage_failed = 0
-            for mb in mbs:
-                cfg = json.loads(json.dumps(self.base_config))
-                cfg.setdefault("zero_optimization", {})["stage"] = stage
-                cfg["train_micro_batch_size_per_gpu"] = mb
-                cfg.pop("train_batch_size", None)
-                exp = {"zero_stage": stage, "micro_batch_size": mb}
-                try:
-                    exp.update(self._measure(cfg, steps))
-                    exp["ok"] = True
-                except Exception as e:  # OOM / infeasible shape / bad combo
-                    exp.update({"ok": False, "error": f"{type(e).__name__}: {e}"})
-                    stage_failed += 1
-                self.results.append(exp)
-                log_dist(f"autotune exp: {exp}", ranks=[0])
-                if exp.get("ok") and (
-                    best is None
-                    or exp["samples_per_sec"] > best["samples_per_sec"]
-                ):
-                    best = dict(exp)
-                if self.fast and not exp.get("ok") and stage_failed >= 2:
-                    break  # larger micro batches only get worse (OOM wall)
 
-        os.makedirs(self.results_dir, exist_ok=True)
-        with open(os.path.join(self.results_dir, "exps.jsonl"), "w") as f:
-            for r in self.results:
-                f.write(json.dumps(r) + "\n")
+        def consider(exp):
+            nonlocal best
+            if exp.get("ok") and (
+                best is None or exp["samples_per_sec"] > best["samples_per_sec"]
+            ):
+                best = dict(exp)
 
+        if strategy == "fast":
+            for stage in zero_stages:
+                stage_failed = 0
+                for mb in mbs:
+                    exp = self._run_exp(
+                        {"zero_stage": stage, "micro_batch_size": mb}, steps)
+                    consider(exp)
+                    if self.fast and not exp.get("ok"):
+                        stage_failed += 1
+                        if stage_failed >= 2:
+                            break  # OOM wall: larger micros only get worse
+        elif strategy in ("grid", "random", "model"):
+            import random as _random
+
+            r = _random.Random(seed)
+            grid = [
+                {"zero_stage": st, "micro_batch_size": mb,
+                 "remat": rm, "offload_optimizer": off}
+                for st in zero_stages for mb in mbs
+                for rm in remats for off in offloads
+            ]
+            if strategy == "grid":
+                for cand in grid:
+                    consider(self._run_exp(cand, steps))
+            elif strategy == "random":
+                n = min(num_trials or len(grid), len(grid))
+                for cand in r.sample(grid, n):
+                    consider(self._run_exp(cand, steps))
+            else:
+                # ModelBasedTuner analog: explore, fit, exploit
+                budget = min(num_trials or len(grid), len(grid))
+                explore = grid if budget >= len(grid) else r.sample(
+                    grid, max(budget // 2, 1))
+                measured = {}
+                for cand in explore:
+                    exp = self._run_exp(cand, steps)
+                    consider(exp)
+                    measured[tuple(sorted(cand.items()))] = exp
+                remaining = [g for g in grid
+                             if tuple(sorted(g.items())) not in measured]
+                scored = [e for e in measured.values() if e.get("ok")]
+                if scored and remaining and len(measured) < budget:
+                    gmean = sum(e["samples_per_sec"] for e in scored) / len(scored)
+
+                    def axis_dev(key, val):
+                        pts = [e["samples_per_sec"] for e in scored
+                               if e.get(key) == val]
+                        return (sum(pts) / len(pts) - gmean) if pts else 0.0
+
+                    def predict(c):
+                        return gmean + sum(axis_dev(k, v) for k, v in c.items())
+
+                    remaining.sort(key=predict, reverse=True)
+                    for cand in remaining[: budget - len(measured)]:
+                        consider(self._run_exp(cand, steps))
+        else:
+            raise ValueError(
+                f"unknown strategy '{strategy}' (expected fast|grid|random|model)"
+            )
+
+        self._flush_results()
         if best is None:
             raise RuntimeError(
                 f"autotuning found no feasible config; see {self.results_dir}"
             )
-        tuned = json.loads(json.dumps(self.base_config))
-        tuned.setdefault("zero_optimization", {})["stage"] = best["zero_stage"]
-        tuned["train_micro_batch_size_per_gpu"] = best["micro_batch_size"]
-        tuned.pop("train_batch_size", None)
+        tuned = self._apply_candidate(best)
         log_dist(
-            f"autotune best: stage={best['zero_stage']} micro={best['micro_batch_size']} "
+            f"autotune best ({strategy}): stage={best['zero_stage']} "
+            f"micro={best['micro_batch_size']} "
+            f"remat={best.get('remat')} offload={best.get('offload_optimizer')} "
             f"({best['samples_per_sec']:.1f} samples/s)",
             ranks=[0],
         )

@@ -195,6 +195,7 @@ class InferenceEngine:
         )
         self._use_kernel = jax.default_backend() == "tpu"
         self._prefill_fns: Dict[int, Any] = {}
+        self._prefill_batch_fns: Dict[Tuple[int, int], Any] = {}
         self._decode_fns: Dict[int, Any] = {}
         kv_bytes = sum(x.nbytes for x in self.cache.k + self.cache.v)
         log_dist(
@@ -237,6 +238,25 @@ class InferenceEngine:
 
             self._prefill_fns[tp] = jax.jit(step, donate_argnums=(1,))
         return self._prefill_fns[tp]
+
+    def _prefill_batch_fn(self, bp: int, tp: int):
+        """Compiled cross-prompt prefill for batch bucket bp x token
+        bucket tp — ONE program runs all concurrent prompts (ref:
+        inference/v2 ragged mixed-prefill batches; fixes the per-prompt
+        TTFT pile-up under concurrent arrivals)."""
+        key = (bp, tp)
+        if key not in self._prefill_batch_fns:
+            cfg, use_kernel, deq = self.cfg, self._use_kernel, self._dequant
+            mesh = self.mesh
+
+            def step(params, cache, tokens, n_real, tables):
+                return M.prefill_batch(
+                    deq(params), cache, tokens, n_real, tables, cfg,
+                    use_kernel, mesh=mesh,
+                )
+
+            self._prefill_batch_fns[key] = jax.jit(step, donate_argnums=(1,))
+        return self._prefill_batch_fns[key]
 
     def _decode_fn(self, s: int):
         if s not in self._decode_fns:
@@ -352,7 +372,9 @@ class InferenceEngine:
 
         out = np.zeros((len(uids), self.cfg.vocab_size), np.float32)
 
-        for pos, uid, toks in prefills:
+        if len(prefills) == 1:
+            # single prompt: the tighter per-prompt program (no batch pad)
+            pos, uid, toks = prefills[0]
             n = len(toks)
             self.state.extend(uid, n)
             tp = _bucket(n, self.config.min_prefill_bucket)
@@ -365,6 +387,41 @@ class InferenceEngine:
             )
             self.state.commit(uid, n)
             out[pos] = np.asarray(logits)
+        elif prefills:
+            # concurrent prompts run as compiled WAVES, bucketed in both
+            # tokens (max prompt in the wave) and batch (power of 2) and
+            # capped at max_batch_size prompts per program so one put()
+            # cannot compile an unbounded (bp, tp) activation footprint
+            if not self.can_schedule([u for _, u, _ in prefills],
+                                     [len(t) for _, _, t in prefills]):
+                raise RuntimeError(
+                    "insufficient KV blocks for this prefill wave; free "
+                    "sequences or split the put()"
+                )
+            cap = self.config.max_batch_size
+            for w0 in range(0, len(prefills), cap):
+                wave = prefills[w0:w0 + cap]
+                tp = _bucket(max(len(t) for _, _, t in wave),
+                             self.config.min_prefill_bucket)
+                bp = _bucket(len(wave), 1)
+                toks_b = np.zeros((bp, tp), np.int32)
+                n_real = np.zeros((bp,), np.int32)
+                tables = np.zeros((bp, self.config.blocks_per_seq), np.int32)
+                for row, (pos, uid, toks) in enumerate(wave):
+                    n = len(toks)
+                    self.state.extend(uid, n)
+                    toks_b[row, :n] = toks
+                    n_real[row] = n
+                    tables[row] = self.state.block_table(
+                        [uid], self.config.blocks_per_seq)[0]
+                logits, self.cache = self._prefill_batch_fn(bp, tp)(
+                    self.params, self.cache, self._dev(toks_b),
+                    self._dev(n_real), self._dev(tables),
+                )
+                logits = np.asarray(logits)
+                for row, (pos, uid, toks) in enumerate(wave):
+                    self.state.commit(uid, len(toks))
+                    out[pos] = logits[row]
 
         if decodes:
             sp = _bucket(n_rows, 8)

@@ -184,17 +184,10 @@ def _layer_params(params, l):
 def _sparsity(cfg: T.TransformerConfig):
     """SparsityConfig for a sparse-trained model, else None. Layouts are
     deterministic (seeded), so serving reproduces the train-time block
-    mask exactly — including bigbird's random blocks."""
+    mask exactly — including bigbird/variable random blocks."""
     if cfg.attention_impl != "sparse":
         return None
-    from ..ops.sparse_attention import SparsityConfig
-
-    return SparsityConfig(
-        block=cfg.sparse_block, mode=cfg.sparse_mode,
-        num_local_blocks=cfg.sparse_num_local_blocks,
-        num_global_blocks=cfg.sparse_num_global_blocks,
-        num_random_blocks=cfg.sparse_num_random_blocks,
-    )
+    return cfg.sparsity_config()
 
 
 def _sparse_prefill_mask(scfg, Tp: int) -> jnp.ndarray:
@@ -466,14 +459,34 @@ def prefill_step(
     use_kernel: bool = True, mesh: Optional[Mesh] = None,
 ):
     """tokens [Tp] int32 (padded), n_real scalar int32, table [NB] int32 →
-    (last-token logits [V], new cache).
+    (last-token logits [V], new cache) — single-prompt prefill (the B=1
+    view of prefill_batch)."""
+    n_real = jnp.asarray(n_real, jnp.int32).reshape(1)
+    logits, cache = prefill_batch(
+        params, cache, tokens[None], n_real, table[None], cfg, use_kernel,
+        mesh=mesh,
+    )
+    return logits[0], cache
 
-    Whole-prompt prefill: attention over the prompt itself is plain
-    causal flash (no paged reads — the sequence starts empty); new KV is
-    scattered into the paged cache for the real tokens only. The
-    last-real-token logits are the FastGen logits_gather analog
-    (ref: kernels/ragged_ops/logits_gather/)."""
-    Tp = tokens.shape[0]
+
+def prefill_batch(
+    params, cache: PagedCache, tokens, n_real, tables,
+    cfg: T.TransformerConfig, use_kernel: bool = True,
+    mesh: Optional[Mesh] = None,
+):
+    """Cross-prompt batched prefill: tokens [B, Tp] int32 (padded),
+    n_real [B] int32, tables [B, NB] int32 → (last-real-token logits
+    [B, V], new cache).
+
+    ONE compiled program runs B concurrent prompts — the ragged-batch
+    idea of SplitFuse applied to prefill (ref: inference/v2/kernels/
+    ragged_ops/ mixed prefill batches; VERDICT r2 W4: per-prompt calls
+    made TTFT degrade linearly under concurrent arrivals). Attention
+    over each prompt is plain causal flash (batch dim is natural); new
+    KV rows from every prompt scatter into the paged cache in one RMW
+    call. Rows with n_real == 0 are batch padding (garbage logits,
+    sliced by the caller; their KV writes drop)."""
+    B, Tp = tokens.shape
     bs = cache.block_size
     positions = jnp.arange(Tp, dtype=jnp.int32)
     scfg = _sparsity(cfg)
@@ -481,15 +494,18 @@ def prefill_step(
         _sparse_prefill_mask(scfg, Tp)
         if scfg is not None and Tp % scfg.block != 0 else None
     )
-    x = params["embed"][tokens][None]  # [1, Tp, E] — params-dtype activations
+    x = params["embed"][tokens]  # [B, Tp, E] — params-dtype activations
     if cfg.variant == "gpt2":
         x = x + params["pos_embed"][:Tp].astype(x.dtype)[None]
 
+    # per-row flat cache slots for the real tokens; -1 rows drop
     flat_idx = jnp.where(
-        positions < n_real,
-        table[positions // bs] * bs + positions % bs,
-        jnp.int32(-1),  # dropped by scatter mode="drop"
-    )
+        positions[None, :] < n_real[:, None],
+        jnp.take_along_axis(
+            tables, positions[None, :] // bs, axis=1
+        ) * bs + positions[None, :] % bs,
+        jnp.int32(-1),
+    ).reshape(B * Tp)
 
     new_k, new_v = [], []
     for l in range(cfg.n_layers):
@@ -503,13 +519,17 @@ def prefill_step(
             k = k + lp["bk"].astype(x.dtype)
             v = v + lp["bv"].astype(x.dtype)
         else:
-            q = _rope_at(q[0], positions, cfg)[None]
-            k = _rope_at(k[0], positions, cfg)[None]
+            rot = jax.vmap(_rope_at, in_axes=(0, None, None))
+            q = rot(q, positions, cfg)
+            k = rot(k, positions, cfg)
         q = _cons(q, mesh, None, None, "model", None)
         k = _cons(k, mesh, None, None, "model", None)
         v = _cons(v, mesh, None, None, "model", None)
 
-        ck, cv = _write_kv(cache.k[l], cache.v[l], k[0], v[0], flat_idx, mesh)
+        KVh, Dh = k.shape[2], k.shape[3]
+        ck, cv = _write_kv(cache.k[l], cache.v[l],
+                           k.reshape(B * Tp, KVh, Dh),
+                           v.reshape(B * Tp, KVh, Dh), flat_idx, mesh)
         ck = _cons(ck, mesh, None, None, "model", None)
         cv = _cons(cv, mesh, None, None, "model", None)
         new_k.append(ck)
@@ -549,13 +569,16 @@ def prefill_step(
         x = x + out
 
         h = T._act_quant(T._norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg), cfg)
-        x = x + _mlp(h[0], lp, cfg)[None]
+        E = x.shape[-1]
+        x = x + _mlp(h.reshape(B * Tp, E), lp, cfg).reshape(B, Tp, E)
 
-    # logits for the last REAL token only (logits_gather): slice before
-    # the vocab matmul so the head runs on one token, not Tp
-    x_last = x[0, n_real - 1][None]  # [1, E]
+    # logits for each prompt's last REAL token only (logits_gather):
+    # gather before the vocab matmul so the head runs on B tokens, not B*Tp
+    last = jnp.maximum(n_real - 1, 0)  # [B]; padding rows read pos 0
+    x_last = jnp.take_along_axis(x, last[:, None, None].astype(jnp.int32)
+                                 .repeat(x.shape[-1], axis=2), axis=1)[:, 0]
     x_last = T._norm(x_last, params["ln_f_scale"], params.get("ln_f_bias"), cfg)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("se,ev->sv", x_last, head.astype(x_last.dtype))[0]
-    logits = _cons(logits.astype(jnp.float32), mesh, None)
+    logits = jnp.einsum("be,ev->bv", x_last, head.astype(x_last.dtype))
+    logits = _cons(logits.astype(jnp.float32), mesh, None, None)
     return logits, PagedCache(k=new_k, v=new_v)

@@ -55,10 +55,15 @@ class TransformerConfig:
     # masks the paged decode path to the same window.
     sliding_window: int = 0
     sparse_block: int = 64
-    sparse_mode: str = "fixed"  # fixed | bigbird | dense
+    sparse_mode: str = "fixed"  # fixed | longformer | bigbird | dense | variable
     sparse_num_local_blocks: int = 4
     sparse_num_global_blocks: int = 1
     sparse_num_random_blocks: int = 2
+    # variable-mode layout (ref: VariableSparsityConfig): per-window
+    # local sizes (last repeats) + explicit global block indices/ranges
+    sparse_local_window_blocks: Tuple[int, ...] = (4,)
+    sparse_global_block_indices: Tuple[int, ...] = (0,)
+    sparse_global_block_end_indices: Optional[Tuple[int, ...]] = None
     dropout: float = 0.0
     # QAT activation quantization (ref: compression/basic_layer.py
     # LinearLayer_Compress activation_quantization — there a forward hook
@@ -158,6 +163,25 @@ class TransformerConfig:
             d = int(self.d_model * 8 / 3)
             return ((d + 127) // 128) * 128
         return 4 * self.d_model
+
+    def sparsity_config(self):
+        """SparsityConfig assembled from the sparse_* knobs (one place —
+        the training forward and the serving engine must reproduce the
+        SAME layout)."""
+        from ..ops.sparse_attention import SparsityConfig
+
+        return SparsityConfig(
+            block=self.sparse_block, mode=self.sparse_mode,
+            num_local_blocks=self.sparse_num_local_blocks,
+            num_global_blocks=self.sparse_num_global_blocks,
+            num_random_blocks=self.sparse_num_random_blocks,
+            local_window_blocks=tuple(self.sparse_local_window_blocks),
+            global_block_indices=tuple(self.sparse_global_block_indices),
+            global_block_end_indices=(
+                tuple(self.sparse_global_block_end_indices)
+                if self.sparse_global_block_end_indices is not None else None
+            ),
+        )
 
     def flops_per_token(self, seq_len: Optional[int] = None) -> float:
         """Train-step matmul FLOPs per token for MFU accounting:
@@ -435,14 +459,9 @@ def _attention_block(x, lp, cfg: TransformerConfig, rng=None, positions=None):
         v = _shard(v, DP, "seq", None, None)
         out = ring_causal_attention(q, k, v, use_flash=cfg.use_flash)
     elif cfg.attention_impl == "sparse":
-        from ..ops.sparse_attention import SparsityConfig, sparse_causal_attention
+        from ..ops.sparse_attention import sparse_causal_attention
 
-        scfg = SparsityConfig(
-            block=cfg.sparse_block, mode=cfg.sparse_mode,
-            num_local_blocks=cfg.sparse_num_local_blocks,
-            num_global_blocks=cfg.sparse_num_global_blocks,
-            num_random_blocks=cfg.sparse_num_random_blocks,
-        )
+        scfg = cfg.sparsity_config()
         if q.shape[2] != k.shape[2]:  # GQA: repeat KV for the oracle path
             rep = q.shape[2] // k.shape[2]
             k = jnp.repeat(k, rep, axis=2)

@@ -31,28 +31,50 @@ class SparsityConfig:
     block: int = 64
     # fixed: local window + global prefix; longformer: same layout family
     # (BSLongformerSparsityConfig = sliding window + global tokens);
-    # bigbird: + random earlier blocks; dense: full causal.
+    # bigbird: + random earlier blocks; dense: full causal;
+    # variable: per-window local sizes + explicit global block indices
+    # (ref: sparsity_config.py VariableSparsityConfig:239 — unidirectional
+    # here, matching the causal-LM framework).
     mode: str = "fixed"
     num_local_blocks: int = 4       # sliding window (fixed/longformer)
     num_global_blocks: int = 1      # leading blocks every row attends to
-    num_random_blocks: int = 2      # bigbird random blocks
+    num_random_blocks: int = 2      # bigbird/variable random blocks
+    # variable-mode knobs (reference parameter names):
+    local_window_blocks: Tuple[int, ...] = (4,)
+    global_block_indices: Tuple[int, ...] = (0,)
+    global_block_end_indices: Optional[Tuple[int, ...]] = None
     seed: int = 0
 
-    _MODES = ("fixed", "longformer", "bigbird", "dense")
+    _MODES = ("fixed", "longformer", "bigbird", "dense", "variable")
 
     def __post_init__(self):
         if self.mode not in self._MODES:
             raise ValueError(
                 f"unknown sparsity mode '{self.mode}' (expected {self._MODES})"
             )
+        if self.global_block_end_indices is not None:
+            if len(self.global_block_end_indices) != len(self.global_block_indices):
+                raise ValueError(
+                    "global_block_end_indices must pair 1:1 with "
+                    "global_block_indices (ref: VariableSparsityConfig)"
+                )
+            for s, e in zip(self.global_block_indices,
+                            self.global_block_end_indices):
+                if s >= e:
+                    raise ValueError(
+                        f"global block start {s} must be < end {e}"
+                    )
 
     def layout(self, seq_len: int) -> np.ndarray:
         """[nb, nb] bool, row q-block -> kv-blocks it may attend to
-        (causal: j <= i only)."""
+        (causal: j <= i only). Rows are prefix-stable in nb (serving's
+        decode mask relies on it)."""
         assert seq_len % self.block == 0, (seq_len, self.block)
         nb = seq_len // self.block
         lay = np.zeros((nb, nb), bool)
         rng = np.random.default_rng(self.seed)
+        if self.mode == "variable":
+            return self._variable_layout(nb, lay, rng)
         for i in range(nb):
             if self.mode == "dense":
                 lay[i, : i + 1] = True
@@ -65,6 +87,41 @@ class SparsityConfig:
             lay[i, :g] = True
             if self.mode == "bigbird" and i > 0:
                 # random earlier blocks (ref: BigBirdSparsityConfig)
+                k = min(self.num_random_blocks, i)
+                picks = rng.choice(i, size=k, replace=False)
+                lay[i, picks] = True
+        return lay
+
+    def _variable_layout(self, nb: int, lay: np.ndarray,
+                         rng: np.random.Generator) -> np.ndarray:
+        """VariableSparsityConfig's rule, unidirectional
+        (ref: sparsity_config.py set_local_layout:325 — the window-size
+        list applies to consecutive windows, the last size repeats;
+        set_global_layout:354 — explicit global columns/ranges, rows
+        from the global block down attend to it)."""
+        # local windows: rows in window [s, e) attend cols s..row
+        sizes = list(self.local_window_blocks) or [1]
+        start = 0
+        wi = 0
+        while start < nb:
+            size = sizes[min(wi, len(sizes) - 1)]
+            end = min(start + size, nb)
+            for i in range(start, end):
+                lay[i, start: i + 1] = True
+            start = end
+            wi += 1
+        # global columns: unidirectional → rows >= the global block
+        # attend to it (first_row = idx, ref set_global_layout)
+        ends = (self.global_block_end_indices
+                if self.global_block_end_indices is not None
+                else tuple(g + 1 for g in self.global_block_indices))
+        for s, e in zip(self.global_block_indices, ends):
+            for c in range(min(s, nb), min(e, nb)):
+                lay[c:, c] = True
+        # random earlier blocks (causal), drawn row-ascending so the
+        # layout stays prefix-stable
+        if self.num_random_blocks > 0:
+            for i in range(1, nb):
                 k = min(self.num_random_blocks, i)
                 picks = rng.choice(i, size=k, replace=False)
                 lay[i, picks] = True

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Run the autotuner on the real chip and record the artifact.
+
+VERDICT r2 W6: the autotuner had only ever run on the CPU mesh, where
+RESOURCE_EXHAUSTED pruning and compile-time costs never bite. This
+drives a grid over the knobs that matter on TPU — micro-batch,
+engine-level remat policy, optimizer offload — on a mid-size Llama-class
+model, and writes autotuning_results/exps.jsonl + AUTOTUNE_r03.json at
+the repo root (hardware, winner, and the full experiment record).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+
+    import deepspeed_tpu as ds  # noqa: F401 (backend init)
+    from deepspeed_tpu.autotuning import Autotuner
+    from deepspeed_tpu.models import transformer as T
+    from deepspeed_tpu.platform.accelerator import get_accelerator
+
+    acc = get_accelerator()
+    if not acc.is_tpu():
+        print("not on TPU; refusing to write a hardware artifact",
+              file=sys.stderr)
+        return 1
+
+    # mid-size so each experiment compiles in ~30-60s, while the big
+    # remat=none x mb=16 corner still stresses HBM enough that pruning
+    # paths can fire on a 16 GB chip
+    mcfg = T.TransformerConfig(
+        vocab_size=32000, n_layers=12, n_heads=8, d_model=1024,
+        max_seq=2048, variant="llama", use_flash=True,
+    )
+    r = np.random.default_rng(0)
+
+    def make_batch(n):
+        return {"tokens": r.integers(0, 32000, (n, 2049)).astype(np.int32)}
+
+    tuner = Autotuner(
+        {
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+            "bf16": {"enabled": True},
+            "steps_per_print": 10**9,
+            "autotuning": {"enabled": True},
+        },
+        loss_fn=T.make_loss_fn(mcfg, loss_chunks=16),
+        param_init_fn=lambda k: T.init(mcfg, k),
+        param_logical_specs=T.logical_specs(mcfg),
+        make_batch=make_batch,
+        results_dir="autotuning_results",
+    )
+    t0 = time.perf_counter()
+    # offload_optimizer is deliberately NOT swept here: through the axon
+    # tunnel the host tier lives across the network, so each offloaded
+    # step pays a remote D2H/H2D round trip measured in minutes — not
+    # representative of a host-attached TPU (the offload axis is
+    # exercised on the CPU-mesh lane, tests/test_elastic_autotune.py)
+    best = tuner.tune(
+        zero_stages=(1,),
+        micro_batch_sizes=(4, 8, 16),
+        steps=4,
+        strategy="grid",
+        remat_policies=("none", "dots", "full"),
+    )
+    wall = time.perf_counter() - t0
+
+    artifact = {
+        "hardware": acc.device_name(),
+        "model": "llama-class 12L d1024 seq2048 bf16",
+        "strategy": "grid",
+        "wall_clock_s": round(wall, 1),
+        "n_experiments": len(tuner.results),
+        "n_ok": sum(1 for e in tuner.results if e.get("ok")),
+        "n_pruned": sum(1 for e in tuner.results if not e.get("ok")),
+        "best": {
+            "zero_stage": best["zero_optimization"]["stage"],
+            "micro_batch_size": best["train_micro_batch_size_per_gpu"],
+            "remat": (best.get("activation_checkpointing") or {}).get("policy"),
+            "offload_optimizer": best["zero_optimization"].get(
+                "offload_optimizer", {}).get("device"),
+        },
+        "experiments": tuner.results,
+    }
+    with open("AUTOTUNE_r03.json", "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(json.dumps({k: v for k, v in artifact.items()
+                      if k != "experiments"}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

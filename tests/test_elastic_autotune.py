@@ -234,3 +234,57 @@ class TestAutotuner:
         )
         assert np.isfinite(engine.train_batch(
             make_batch(engine.config.train_batch_size))["loss"])
+
+    def _tuner(self, tmp_path):
+        mcfg = T.TransformerConfig(vocab_size=VOCAB, n_layers=2, n_heads=4,
+                                   d_model=64, max_seq=32, variant="llama",
+                                   use_flash=False)
+        r = np.random.default_rng(0)
+        return Autotuner(
+            {"optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+             "steps_per_print": 10**9,
+             "autotuning": {"enabled": True}},
+            loss_fn=T.make_loss_fn(mcfg),
+            param_init_fn=lambda k: T.init(mcfg, k),
+            param_logical_specs=T.logical_specs(mcfg),
+            make_batch=lambda n: {"tokens": r.integers(
+                0, VOCAB, (n, 33)).astype(np.int32)},
+            results_dir=str(tmp_path),
+        ), mcfg
+
+    def test_grid_explores_remat_and_offload_axes(self, tmp_path):
+        """GridSearchTuner analog over the TPU-relevant knobs
+        (ref: autotuning/tuner/base_tuner.py)."""
+        tuner, _ = self._tuner(tmp_path)
+        best = tuner.tune(zero_stages=(1,), micro_batch_sizes=(2,), steps=1,
+                          strategy="grid",
+                          remat_policies=("none", "dots"),
+                          offload_devices=(None, "cpu"))
+        recs = [json.loads(l) for l in open(os.path.join(tmp_path, "exps.jsonl"))]
+        assert len(recs) == 4  # 1 stage x 1 mb x 2 remat x 2 offload
+        assert {r["remat"] for r in recs} == {"none", "dots"}
+        assert {r["offload_optimizer"] for r in recs} == {None, "cpu"}
+        # the winning knobs land in the tuned config
+        if best.get("activation_checkpointing"):
+            assert best["activation_checkpointing"]["policy"] in ("none", "dots")
+
+    def test_random_respects_trial_budget(self, tmp_path):
+        tuner, _ = self._tuner(tmp_path)
+        tuner.tune(zero_stages=(0, 1), micro_batch_sizes=(1, 2), steps=1,
+                   strategy="random", num_trials=3, seed=1)
+        recs = [json.loads(l) for l in open(os.path.join(tmp_path, "exps.jsonl"))]
+        assert len(recs) == 3
+
+    def test_model_based_explores_then_exploits(self, tmp_path):
+        tuner, mcfg = self._tuner(tmp_path)
+        best = tuner.tune(zero_stages=(0, 1), micro_batch_sizes=(1, 2),
+                          steps=1, strategy="model", num_trials=4, seed=2)
+        recs = [json.loads(l) for l in open(os.path.join(tmp_path, "exps.jsonl"))]
+        assert 2 <= len(recs) <= 4  # half explore + model-ranked exploit
+        assert any(r["ok"] for r in recs)
+        assert best["train_micro_batch_size_per_gpu"] in (1, 2)
+
+    def test_unknown_strategy_raises(self, tmp_path):
+        tuner, _ = self._tuner(tmp_path)
+        with pytest.raises(ValueError, match="strategy"):
+            tuner.tune(strategy="bayes")

@@ -420,6 +420,9 @@ class TestSparseServing:
         ("fixed", {}),
         ("fixed", {"n_kv_heads": 2}),  # GQA
         ("bigbird", {}),
+        ("variable", {"sparse_local_window_blocks": (1, 2),
+                      "sparse_global_block_indices": (0,),
+                      "sparse_num_random_blocks": 1}),
     ])
     def test_matches_sparse_training_forward(self, rng, mode, kw):
         cfg, params = self._model(mode, **kw)
@@ -635,6 +638,83 @@ class TestTensorParallelServing:
         cfg, params = small_model(n_heads=6, d_model=96)
         with pytest.raises(ValueError, match="divisible"):
             init_inference(params, cfg, dict(tp_size=4))
+
+
+class TestBatchedPrefill:
+    """Cross-prompt prefill batching (VERDICT r2 W4): N concurrent
+    prompts run in ONE compiled program, not N."""
+
+    def test_wave_matches_sequential_prefill(self, rng):
+        cfg, params = small_model()
+        a = engine_for(cfg, params)
+        b = engine_for(cfg, params)
+        prompts = [np.asarray(rng.integers(0, 128, n), np.int32)
+                   for n in (5, 11, 3)]
+        # sequential puts (single-prompt path)
+        seq = np.stack([a.put([i], [p.copy()])[0]
+                        for i, p in enumerate(prompts)])
+        # one wave (batched path)
+        wave = b.put([0, 1, 2], [p.copy() for p in prompts])
+        np.testing.assert_allclose(wave, seq, rtol=2e-5, atol=2e-5)
+        # one compiled batch program, no per-prompt programs
+        assert list(b._prefill_batch_fns) == [(4, 16)]
+        assert not b._prefill_fns
+
+    def test_wave_then_decode_consistent(self, rng):
+        """KV written by the batched prefill serves later decodes."""
+        cfg, params = small_model()
+        eng = engine_for(cfg, params)
+        prompts = [list(rng.integers(0, 128, n)) for n in (7, 4)]
+        logits = eng.put([0, 1], [np.asarray(p, np.int32) for p in prompts])
+        toks = [int(np.argmax(logits[i])) for i in range(2)]
+        nxt = eng.put([0, 1], [np.asarray([t]) for t in toks])
+        for i in range(2):
+            ref = oracle_next_logits(params, cfg, prompts[i] + [toks[i]])
+            np.testing.assert_allclose(nxt[i], ref, rtol=2e-2, atol=2e-2)
+
+    def test_wave_capped_at_max_batch_size(self, rng):
+        """A wave larger than max_batch_size splits into bounded
+        programs instead of compiling one unbounded (bp, tp)."""
+        cfg, params = small_model()
+        eng = engine_for(cfg, params, max_batch_size=2, num_kv_blocks=32,
+                         max_seq_len=16)
+        prompts = [np.asarray(rng.integers(0, 128, 5), np.int32)
+                   for _ in range(5)]
+        wave = eng.put(list(range(5)), [p.copy() for p in prompts])
+        seq = np.stack([engine_for(cfg, params).put([9], [p.copy()])[0]
+                        for p in prompts])
+        np.testing.assert_allclose(wave, seq, rtol=2e-5, atol=2e-5)
+        # waves of 2,2,1: (2,8) batch program + the single-prompt path
+        assert (2, 8) in eng._prefill_batch_fns
+        assert all(bp <= 2 for bp, _ in eng._prefill_batch_fns)
+
+    def test_insufficient_blocks_rejected_before_any_state_change(self, rng):
+        """The wave is validated atomically: a put() that cannot be
+        scheduled leaves no tracked uids / reserved blocks behind."""
+        cfg, params = small_model()
+        eng = engine_for(cfg, params, num_kv_blocks=3, kv_block_size=8,
+                         max_seq_len=24)
+        free0 = eng.state.free_blocks
+        with pytest.raises(RuntimeError, match="insufficient KV blocks"):
+            eng.put([0, 1, 2], [np.asarray(rng.integers(0, 128, 9), np.int32)
+                                for _ in range(3)])
+        assert eng.state.free_blocks == free0
+        assert not eng.state.tracked_uids
+
+    def test_tp_batched_prefill(self, rng):
+        """Batched prefill under the serving mesh."""
+        cfg, params = small_model(n_heads=8, n_kv_heads=4)
+        base = engine_for(cfg, params)
+        tpe = init_inference(
+            params, cfg,
+            dict(max_seq_len=64, kv_block_size=8, num_kv_blocks=32,
+                 min_prefill_bucket=8, max_batch_size=8, tp_size=4),
+            dtype=jnp.float32)
+        prompts = [np.asarray(rng.integers(0, 128, n), np.int32)
+                   for n in (6, 9)]
+        l1 = base.put([0, 1], [p.copy() for p in prompts])
+        l2 = tpe.put([0, 1], [p.copy() for p in prompts])
+        np.testing.assert_allclose(l1, l2, rtol=2e-5, atol=2e-5)
 
 
 class TestSampling:

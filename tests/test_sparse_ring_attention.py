@@ -79,6 +79,93 @@ class TestSparseAttention:
         assert layout_density(lay) < 0.5
 
 
+class TestVariableSparsity:
+    """`variable` mode (ref: sparsity_config.py VariableSparsityConfig:239
+    — per-window local sizes, explicit global columns, unidirectional)."""
+
+    def test_local_windows_and_repeat(self):
+        cfg = SparsityConfig(block=32, mode="variable",
+                             local_window_blocks=(1, 2),
+                             global_block_indices=(),
+                             num_random_blocks=0)
+        lay = cfg.layout(32 * 6)  # windows: [0], [1,2], [3,4], [5]
+        # window-internal causal attention only
+        assert lay[0, 0] and not lay[1, 0]
+        assert lay[2, 1] and lay[2, 2] and not lay[2, 0]
+        assert lay[4, 3] and not lay[4, 2]  # last size (2) repeats
+        assert not np.triu(lay, 1).any()
+
+    def test_global_columns_unidirectional(self):
+        cfg = SparsityConfig(block=32, mode="variable",
+                             local_window_blocks=(2,),
+                             global_block_indices=(0, 3),
+                             num_random_blocks=0)
+        lay = cfg.layout(32 * 8)
+        assert lay[:, 0].all()            # col 0 global from row 0 down
+        assert lay[3:, 3].all()           # col 3 global from row 3 down
+        assert not lay[2, 3]              # never above (causal)
+
+    def test_global_ranges(self):
+        cfg = SparsityConfig(block=32, mode="variable",
+                             local_window_blocks=(1,),
+                             global_block_indices=(2,),
+                             global_block_end_indices=(4,),
+                             num_random_blocks=0)
+        lay = cfg.layout(32 * 8)
+        assert lay[4:, 2].all() and lay[4:, 3].all()
+        with pytest.raises(ValueError, match="must pair"):
+            SparsityConfig(mode="variable", global_block_indices=(0, 1),
+                           global_block_end_indices=(1,))
+        with pytest.raises(ValueError, match="must be <"):
+            SparsityConfig(mode="variable", global_block_indices=(3,),
+                           global_block_end_indices=(3,))
+
+    def test_prefix_stable(self):
+        """Decode serving rebuilds the layout at growing nb — rows must
+        not change (the _sparse_decode_allowed contract)."""
+        cfg = SparsityConfig(block=32, mode="variable",
+                             local_window_blocks=(2, 3),
+                             global_block_indices=(0,),
+                             num_random_blocks=1)
+        small, big = cfg.layout(32 * 4), cfg.layout(32 * 8)
+        np.testing.assert_array_equal(big[:4, :4], small)
+
+    def test_matches_dense_masked_oracle(self):
+        cfg = SparsityConfig(block=32, mode="variable",
+                             local_window_blocks=(1, 2),
+                             global_block_indices=(0,),
+                             num_random_blocks=1)
+        q, k, v = qkv()
+        lay = cfg.layout(q.shape[1])
+        got = sparse_causal_attention(q, k, v, cfg)
+        want = dense_masked_oracle(q, k, v, lay, cfg.block)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_variable_model_trains(self):
+        mcfg = T.TransformerConfig(
+            vocab_size=128, n_layers=2, n_heads=4, d_model=64, max_seq=128,
+            variant="llama", use_flash=False, attention_impl="sparse",
+            sparse_mode="variable", sparse_block=32,
+            sparse_local_window_blocks=(1, 2),
+            sparse_global_block_indices=(0,),
+            sparse_num_random_blocks=0)
+        import deepspeed_tpu as ds
+
+        engine = ds.initialize(
+            {"train_micro_batch_size_per_gpu": 2,
+             "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+             "steps_per_print": 10**9},
+            loss_fn=T.make_loss_fn(mcfg),
+            param_init_fn=lambda k: T.init(mcfg, k),
+            param_logical_specs=T.logical_specs(mcfg))
+        r = np.random.default_rng(0)
+        batch = {"tokens": r.integers(
+            0, 128, (engine.config.train_batch_size, 129)).astype(np.int32)}
+        losses = [float(engine.train_batch(batch)["loss"]) for _ in range(6)]
+        assert losses[-1] < losses[0]
+
+
 class TestRingAttention:
     def _mesh(self, seq=4):
         devs = np.array(jax.devices()[: seq * 2]).reshape(1, 2, 1, 1, seq, 1)

@@ -166,12 +166,13 @@ def _serving_bench(mcfg, train_engine):
         ptoks[:] = r.integers(0, mcfg.vocab_size, ttft_len)
         eng.state.extend(max_batch, ttft_len)  # scratch uid
         table = eng.state.block_table([max_batch], eng.config.blocks_per_seq)[0]
-        pf = eng._prefill_fn(ttft_len)
+        pf = eng._prefill_batch_fn(1, ttft_len)
         ts = []
         for i in range(trials + 1):
             t0 = time.perf_counter()
-            lg, eng.cache = pf(eng.params, eng.cache, eng._dev(ptoks),
-                               eng._dev(np.int32(ttft_len)), eng._dev(table))
+            lg, eng.cache = pf(eng.params, eng.cache, eng._dev(ptoks[None]),
+                               eng._dev(np.asarray([ttft_len], np.int32)),
+                               eng._dev(table[None]))
             np.asarray(jax.device_get(lg.ravel()[:1]))
             if i:  # drop the compile trial
                 ts.append((time.perf_counter() - t0) * 1e3)

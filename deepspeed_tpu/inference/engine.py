@@ -13,8 +13,9 @@ RaggedInferenceEngineConfig:137). Differences driven by XLA:
   dense padded token buffers + block tables + context lengths; all
   raggedness lives in the host-side StateManager (inference/ragged.py).
 - one forward pass per put() for the decode set (all sequences advance
-  one token in a single compiled program); prefills run one compiled
-  call per prompt.
+  one token in a single compiled program); concurrent prefills run as
+  compiled WAVES — one program per (batch-bucket, token-bucket), capped
+  at max_batch_size prompts (the SplitFuse mixed-batch idea).
 
 v1-engine parity (ref: deepspeed/inference/engine.py:39): init_inference
 constructs this engine; greedy `generate` is provided for parity with
@@ -155,13 +156,20 @@ class InferenceEngine:
                 )
         if model_config.attention_impl == "sparse":
             # sparse-trained models serve with the train-time block layout
-            # reproduced exactly (inference/model.py _sparsity); decode
-            # runs the XLA paged path — the Pallas kernel has no layout
-            # mask yet (ulysses/ring are exact attention and serve dense)
+            # reproduced exactly (inference/model.py _sparsity). Decode
+            # runs the Pallas kernel with a per-slot layout bitmap when
+            # cache blocks nest inside layout blocks (and no TP mesh);
+            # otherwise the XLA paged path carries the per-position mask.
+            kernel_ok = (
+                jax.default_backend() == "tpu"
+                and model_config.sparse_block % self.config.kv_block_size == 0
+                and self.mesh is None
+            )
             log_dist(
                 "serving block-sparse attention "
-                f"(mode={model_config.sparse_mode}); decode uses the XLA "
-                "paged path",
+                f"(mode={model_config.sparse_mode}); decode uses the "
+                f"{'Pallas layout-masked' if kernel_ok else 'XLA'} paged "
+                "path",
                 ranks=[0],
             )
         if model_config.variant == "gpt2":
@@ -194,7 +202,6 @@ class InferenceEngine:
             dtype, mesh=self.mesh,
         )
         self._use_kernel = jax.default_backend() == "tpu"
-        self._prefill_fns: Dict[int, Any] = {}
         self._prefill_batch_fns: Dict[Tuple[int, int], Any] = {}
         self._decode_fns: Dict[int, Any] = {}
         kv_bytes = sum(x.nbytes for x in self.cache.k + self.cache.v)
@@ -225,20 +232,6 @@ class InferenceEngine:
         self.params = cast
 
     # -- compiled-step caches -------------------------------------------
-    def _prefill_fn(self, tp: int):
-        if tp not in self._prefill_fns:
-            cfg, use_kernel, deq = self.cfg, self._use_kernel, self._dequant
-            mesh = self.mesh
-
-            def step(params, cache, tokens, n_real, table):
-                return M.prefill_step(
-                    deq(params), cache, tokens, n_real, table, cfg, use_kernel,
-                    mesh=mesh,
-                )
-
-            self._prefill_fns[tp] = jax.jit(step, donate_argnums=(1,))
-        return self._prefill_fns[tp]
-
     def _prefill_batch_fn(self, bp: int, tp: int):
         """Compiled cross-prompt prefill for batch bucket bp x token
         bucket tp — ONE program runs all concurrent prompts (ref:
@@ -372,33 +365,21 @@ class InferenceEngine:
 
         out = np.zeros((len(uids), self.cfg.vocab_size), np.float32)
 
-        if len(prefills) == 1:
-            # single prompt: the tighter per-prompt program (no batch pad)
-            pos, uid, toks = prefills[0]
-            n = len(toks)
-            self.state.extend(uid, n)
-            tp = _bucket(n, self.config.min_prefill_bucket)
-            table = self.state.block_table([uid], self.config.blocks_per_seq)[0]
-            padded = np.zeros((tp,), np.int32)
-            padded[:n] = toks
-            logits, self.cache = self._prefill_fn(tp)(
-                self.params, self.cache, self._dev(padded),
-                self._dev(np.int32(n)), self._dev(table),
-            )
-            self.state.commit(uid, n)
-            out[pos] = np.asarray(logits)
-        elif prefills:
-            # concurrent prompts run as compiled WAVES, bucketed in both
+        if prefills:
+            # prompts run as compiled WAVES (a solo prompt is a bp=1
+            # wave — one code path, one compile cache), bucketed in both
             # tokens (max prompt in the wave) and batch (power of 2) and
-            # capped at max_batch_size prompts per program so one put()
-            # cannot compile an unbounded (bp, tp) activation footprint
+            # capped so one put() cannot compile an unbounded (bp, tp)
+            # activation footprint
             if not self.can_schedule([u for _, u, _ in prefills],
                                      [len(t) for _, _, t in prefills]):
                 raise RuntimeError(
                     "insufficient KV blocks for this prefill wave; free "
                     "sequences or split the put()"
                 )
-            cap = self.config.max_batch_size
+            # largest power of two <= max_batch_size, so the bp bucket
+            # can never exceed the configured ceiling
+            cap = 1 << (self.config.max_batch_size.bit_length() - 1)
             for w0 in range(0, len(prefills), cap):
                 wave = prefills[w0:w0 + cap]
                 tp = _bucket(max(len(t) for _, _, t in wave),

@@ -235,6 +235,20 @@ def _sparse_decode_allowed(scfg, positions, n_slots: int) -> jnp.ndarray:
     return rows[:, kv_blk]
 
 
+def _sparse_decode_allowed_slots(scfg, positions, n_blocks: int,
+                                 bs: int) -> jnp.ndarray:
+    """[S, NB] bool at CACHE-BLOCK granularity for the Pallas decode
+    kernel's layout mask (scalar prefetch). Valid only when
+    scfg.block % bs == 0 — then every cache block lies inside exactly
+    one layout block, so the block-granular skip is exact."""
+    sblk = scfg.block
+    nb_sparse = -(-(n_blocks * bs) // sblk)
+    lay = jnp.asarray(scfg.layout(nb_sparse * sblk))
+    rows = lay[positions // sblk]  # [S, nb_sparse]
+    slot_sparse = (jnp.arange(n_blocks) * bs) // sblk  # [NB]
+    return rows[:, slot_sparse]
+
+
 def _mlp(h, lp, cfg: T.TransformerConfig):
     """FFN over [T, E] tokens — dense or MoE (Mixtral-class serving).
 
@@ -307,11 +321,17 @@ def _mlp(h, lp, cfg: T.TransformerConfig):
 
 
 def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None,
-                      window: int = 0, mesh=None):
+                      allowed_slots=None, window: int = 0, mesh=None):
+    if allowed_slots is not None and use_kernel and _tp_size(mesh) <= 1:
+        # block-sparse serving on the Pallas kernel: the layout rides in
+        # as a per-slot bitmap (scalar prefetch) and pruned slots skip
+        # compute entirely
+        return paged_decode_attention(q, ck, cv, table, ctx, window=window,
+                                      allowed_slots=allowed_slots)
     if allowed is not None:
-        # block-sparse serving runs the XLA path: the Pallas decode kernel
-        # does not take an arbitrary layout mask. (window is passed through
-        # for completeness — the config forbids sparse+sliding_window, so
+        # layout finer than the cache blocks (or TP mesh): XLA path with
+        # the per-position mask. (window is passed through for
+        # completeness — the config forbids sparse+sliding_window, so
         # both masks never actually combine today.)
         return paged_decode_attention_xla(q, ck, cv, table, ctx,
                                           allowed=allowed, window=window)
@@ -357,11 +377,17 @@ def decode_step(
     valid = ctx_lens > 0
     positions = jnp.maximum(ctx_lens - 1, 0)  # [S] this token's position
     scfg = _sparsity(cfg)
-    allowed = (
-        _sparse_decode_allowed(scfg, positions,
-                               tables.shape[1] * cache.block_size)
-        if scfg is not None else None
-    )
+    allowed = allowed_slots = None
+    if scfg is not None:
+        if (use_kernel and _tp_size(mesh) <= 1
+                and scfg.block % cache.block_size == 0):
+            # cache blocks nest inside layout blocks → exact block-
+            # granular skip inside the Pallas kernel
+            allowed_slots = _sparse_decode_allowed_slots(
+                scfg, positions, tables.shape[1], cache.block_size)
+        else:
+            allowed = _sparse_decode_allowed(
+                scfg, positions, tables.shape[1] * cache.block_size)
     x = params["embed"][tokens]  # [S, E] — activations in the params dtype
     if cfg.variant == "gpt2":
         x = x + params["pos_embed"][positions].astype(x.dtype)
@@ -398,8 +424,8 @@ def decode_step(
         new_v.append(cv)
 
         att = _decode_attention(q, ck, cv, tables, ctx_lens, use_kernel,
-                                allowed=allowed, window=cfg.sliding_window,
-                                mesh=mesh)
+                                allowed=allowed, allowed_slots=allowed_slots,
+                                window=cfg.sliding_window, mesh=mesh)
         out = jnp.einsum("shd,hde->se", att, lp["wo"].astype(x.dtype))
         if cfg.variant == "gpt2":
             out = out + lp["bo"].astype(x.dtype)

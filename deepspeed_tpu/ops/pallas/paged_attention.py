@@ -39,9 +39,12 @@ def _win_jbase_decode(ctx, window: int, block_size: int):
 
 
 def _decode_kernel(
-    tbl_ref, ctx_ref,  # scalar prefetch: [S, NB] block table, [S] ctx lens
+    tbl_ref, ctx_ref, allow_ref,  # scalar prefetch: [S, NB] block table,
+    # [S] ctx lens, [S, NB] allowed-slot bitmap (block-sparse; all-ones
+    # sentinel when dense)
     q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc,
     *, block_size: int, scale: float, n_kv: int, gp: int, window: int,
+    sparse: bool,
 ):
     s = pl.program_id(0)
     j = pl.program_id(1)  # table slot (sequential; window-relative)
@@ -61,6 +64,10 @@ def _decode_kernel(
     else:
         j_abs = j
         needed = j * block_size < ctx
+    if sparse:
+        # block-sparse layout row: slots outside the layout are skipped
+        # entirely (compute AND their DMA is clamped to a resident tile)
+        needed = jnp.logical_and(needed, allow_ref[s, j_abs] != 0)
 
     @pl.when(needed)
     def _compute():
@@ -99,7 +106,7 @@ def _decode_kernel(
 
 
 def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens,
-                           window: int = 0):
+                           window: int = 0, allowed_slots=None):
     """One-token-per-sequence attention over the paged KV cache.
 
     q: [S, H, D] (the new token's queries, KV already written)
@@ -109,6 +116,12 @@ def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens,
       with 0 are batch padding (output is garbage, sliced by the caller)
     window > 0: token-exact sliding window (Mistral-class serving) — the
       slot grid shrinks to ~window/block_size steps per sequence
+    allowed_slots: optional [S, NB] int32/bool — block-sparse serving:
+      slot j of sequence s participates only when nonzero (the layout
+      row at cache-block granularity; requires the sparse block size to
+      be a multiple of the cache block size so each cache block falls in
+      ONE layout block). Skipped slots cost no compute and their DMA is
+      clamped to a resident tile.
     returns: [S, H, D]
     """
     S, H, D = q.shape
@@ -117,27 +130,39 @@ def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens,
     G = H // KV
     Gp = max(G, 8)  # sublane-pad tiny query blocks
     scale = 1.0 / (D**0.5)
+    sparse = allowed_slots is not None
+    allow = (allowed_slots.astype(jnp.int32) if sparse
+             else jnp.ones((S, NB), jnp.int32))
 
     qg = q.reshape(S, KV, G, D)
     if Gp != G:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, Gp - G), (0, 0)))
 
-    def kv_index(s, j, tbl_ref, ctx_ref):
+    def kv_index(s, j, tbl_ref, ctx_ref, allow_ref):
         last = jnp.maximum(ctx_ref[s] - 1, 0) // bs
         if window > 0:
             j = _win_jbase_decode(ctx_ref[s], window, bs) + j
-        return (tbl_ref[s, jnp.minimum(j, last)], 0, 0, 0)
+        j = jnp.minimum(j, last)
+        if sparse:
+            # layout-skipped slots revisit the last block instead of
+            # streaming their own — like the causal clamp, repeat visits
+            # to a resident tile cost no DMA, so sparse decode saves
+            # bandwidth as well as compute
+            j = jnp.where(allow_ref[s, j] != 0, j, last)
+        return (tbl_ref[s, j], 0, 0, 0)
 
     NBw = min(NB, pl.cdiv(window, bs) + 1) if window > 0 else NB
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(S, NBw),
         in_specs=[
-            pl.BlockSpec((1, KV, Gp, D), lambda s, j, tbl, ctx: (s, 0, 0, 0)),
+            pl.BlockSpec((1, KV, Gp, D),
+                         lambda s, j, tbl, ctx, al: (s, 0, 0, 0)),
             pl.BlockSpec((1, bs, KV, D), kv_index),
             pl.BlockSpec((1, bs, KV, D), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, KV, Gp, D), lambda s, j, tbl, ctx: (s, 0, 0, 0)),
+        out_specs=pl.BlockSpec((1, KV, Gp, D),
+                               lambda s, j, tbl, ctx, al: (s, 0, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((KV * Gp, D), jnp.float32),
             pltpu.VMEM((KV * Gp, 1), jnp.float32),
@@ -147,12 +172,12 @@ def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens,
     out = pl.pallas_call(
         functools.partial(
             _decode_kernel, block_size=bs, scale=scale, n_kv=KV, gp=Gp,
-            window=window,
+            window=window, sparse=sparse,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, KV, Gp, D), q.dtype),
         interpret=_interpret(),
-    )(block_table, ctx_lens, qg, k_cache, v_cache)
+    )(block_table, ctx_lens, allow, qg, k_cache, v_cache)
     return out[:, :, :G, :].reshape(S, H, D)
 
 

@@ -568,8 +568,64 @@ def init_inference(
 
     Tensor parallelism: pass an explicit mesh, config["tp_size"]=N, or
     the reference's spelling config["tensor_parallel"]={"tp_size": N}
-    (ref: inference/config.py DeepSpeedTPConfig)."""
+    (ref: inference/config.py DeepSpeedTPConfig).
+
+    Reference v1 config keys (ref: inference/config.py
+    DeepSpeedInferenceConfig) are understood: `dtype` maps to the engine
+    dtype ('int8' additionally enables weight PTQ), `max_out_tokens` →
+    max_seq_len, kernel-injection/CUDA-graph knobs are no-ops on TPU
+    (kernels are always the Pallas/XLA path), and `checkpoint` points to
+    init_inference_from_hf."""
     cfg = dict(config or {})
+    if "checkpoint" in cfg:
+        raise NotImplementedError(
+            "config['checkpoint']: load external checkpoints with "
+            "init_inference_from_hf(path, ...) (HF safetensors/bin), or "
+            "pass params restored via the TRAINING engine's "
+            "load_checkpoint (runtime/engine.py) into init_inference"
+        )
+    if "injection_policy" in cfg or "injection_policy_tuple" in cfg:
+        raise NotImplementedError(
+            "injection_policy: TPU sharding is a rules table, not module "
+            "surgery — override parallel/sharding.py rules instead"
+        )
+    dt = cfg.pop("dtype", None)
+    if dt is not None:
+        try:
+            # dtype OBJECTS (jnp.bfloat16, np.float16, np.dtype(...)) —
+            # the natural spellings in a JAX codebase
+            name = np.dtype(dt).name
+        except TypeError:
+            # strings ('fp16') and torch.dtype reprs ('torch.float16')
+            name = str(dt).split(".")[-1].lower()
+        if name in ("int8",):
+            # ZeRO-Inference weight-only PTQ is the int8 serving path
+            quantization = quantization or {"bits": 8, "group_size": 128}
+            dtype = jnp.bfloat16
+        elif name in ("float16", "fp16", "half", "bfloat16", "bf16"):
+            # fp16 serving maps to bf16 (TPU's 16-bit matmul format)
+            dtype = jnp.bfloat16
+        elif name in ("float32", "fp32", "float"):
+            dtype = jnp.float32
+        else:
+            raise ValueError(f"unsupported inference dtype {dt!r}")
+    if "max_out_tokens" in cfg:
+        mot = int(cfg.pop("max_out_tokens"))
+        if "max_seq_len" in cfg and int(cfg["max_seq_len"]) != mot:
+            raise ValueError(
+                f"conflicting max_out_tokens ({mot}) and max_seq_len "
+                f"({cfg['max_seq_len']}) in the inference config; drop one"
+            )
+        cfg["max_seq_len"] = mot
+    for noop in ("replace_with_kernel_inject", "replace_method",
+                 "enable_cuda_graph", "triangular_masking",
+                 "use_triton", "triton_autotune"):
+        if cfg.pop(noop, None):
+            log_dist(
+                f"inference config '{noop}' is a no-op on TPU (the "
+                "Pallas/XLA kernels are always the serving path)",
+                ranks=[0],
+            )
     tp = cfg.pop("tensor_parallel", None)
     if tp is not None:
         if isinstance(tp, dict):

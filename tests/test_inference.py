@@ -846,6 +846,42 @@ class TestSampling:
         assert len(outs) == 2 and all(len(o) == 5 for o in outs)
 
 
+class TestV1ConfigCompat:
+    """Reference DeepSpeedInferenceConfig keys map onto the TPU engine
+    (ref: inference/config.py) instead of failing as pydantic extras."""
+
+    def test_dtype_and_noop_keys(self, rng):
+        cfg, params = small_model()
+        eng = init_inference(params, cfg, {
+            "dtype": "fp16", "replace_with_kernel_inject": True,
+            "enable_cuda_graph": True, "max_out_tokens": 48,
+            "max_batch_size": 8, "kv_block_size": 8, "num_kv_blocks": 32,
+            "min_prefill_bucket": 8})
+        assert eng._dtype == jnp.bfloat16  # fp16 → bf16 on TPU
+        assert eng.config.max_seq_len == 48
+        out = eng.generate([list(rng.integers(0, 128, 5))], max_new_tokens=3)
+        assert len(out[0]) == 3
+
+    def test_int8_dtype_enables_ptq(self, rng):
+        cfg, params = small_model()
+        eng = init_inference(params, cfg, {
+            "dtype": "int8", "max_batch_size": 8, "kv_block_size": 8,
+            "num_kv_blocks": 32, "min_prefill_bucket": 8, "max_seq_len": 48})
+        from deepspeed_tpu.inference.quantization import QuantizedWeight
+
+        assert isinstance(eng.params["layers"]["wq"], QuantizedWeight)
+
+    def test_checkpoint_key_points_to_hf_import(self):
+        cfg, params = small_model()
+        with pytest.raises(NotImplementedError, match="init_inference_from_hf"):
+            init_inference(params, cfg, {"checkpoint": "/some/path.json"})
+
+    def test_injection_policy_points_to_rules(self):
+        cfg, params = small_model()
+        with pytest.raises(NotImplementedError, match="rules table"):
+            init_inference(params, cfg, {"injection_policy": {"x": "y"}})
+
+
 def test_empty_token_array_raises(rng):
     cfg, params = small_model()
     eng = engine_for(cfg, params)

@@ -605,7 +605,9 @@ def init_inference(
         elif name in ("float16", "fp16", "half", "bfloat16", "bf16"):
             # fp16 serving maps to bf16 (TPU's 16-bit matmul format)
             dtype = jnp.bfloat16
-        elif name in ("float32", "fp32", "float"):
+        elif name in ("float32", "fp32", "float", "float64", "double"):
+            # float64 spellings (np.dtype('float') → 'float64', torch
+            # double) clamp to f32 — TPU has no f64 serving path
             dtype = jnp.float32
         else:
             raise ValueError(f"unsupported inference dtype {dt!r}")

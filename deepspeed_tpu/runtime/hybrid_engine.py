@@ -61,10 +61,15 @@ class HybridEngine:
             self._served_params = params
 
     def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int,
-                 eos_token_id: Optional[int] = None) -> List[List[int]]:
+                 eos_token_id: Optional[int] = None,
+                 **sampling) -> List[List[int]]:
+        """RLHF rollout. Sampling knobs (do_sample / temperature / top_k /
+        top_p / repetition_penalty / seed) pass through to the serving
+        engine — PPO exploration needs sampled rollouts, not argmax
+        (ref: DeepSpeed-Chat actor generate runs HF sampling)."""
         self._refresh()
         return self._infer.generate(prompts, max_new_tokens,
-                                    eos_token_id=eos_token_id)
+                                    eos_token_id=eos_token_id, **sampling)
 
     # -- training phase: plain engine surface ---------------------------
     def train_batch(self, batch) -> Dict[str, float]:

@@ -61,6 +61,21 @@ class TestHybridEngine:
         out1 = hybrid.generate(prompts, max_new_tokens=4)
         assert out1 != out0  # updated policy decodes differently
 
+    def test_sampled_rollouts(self):
+        """PPO exploration: sampled rollouts pass through the hybrid
+        surface, reproducible under a seed (ref: DeepSpeed-Chat actor
+        generate runs HF sampling)."""
+        hybrid = build_hybrid()
+        r = np.random.default_rng(2)
+        prompts = [list(r.integers(0, VOCAB, 6)) for _ in range(2)]
+        a = hybrid.generate(prompts, max_new_tokens=6, do_sample=True,
+                            temperature=1.2, top_k=30, seed=5)
+        b = hybrid.generate(prompts, max_new_tokens=6, do_sample=True,
+                            temperature=1.2, top_k=30, seed=5)
+        c = hybrid.generate(prompts, max_new_tokens=6, do_sample=True,
+                            temperature=1.2, top_k=30, seed=6)
+        assert a == b and a != c
+
     def test_generation_serves_current_weights(self):
         """Hybrid output == fresh inference engine over the same params."""
         from deepspeed_tpu.inference import init_inference

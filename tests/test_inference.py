@@ -18,7 +18,6 @@ from deepspeed_tpu.inference import (
 )
 from deepspeed_tpu.models import transformer as T
 from deepspeed_tpu.ops.pallas.paged_attention import (
-
     paged_decode_attention,
     paged_decode_attention_xla,
 )

@@ -65,37 +65,64 @@ def _bucket(n: int, lo: int) -> int:
     return b
 
 
+def _prepared_specs(prepared: Any, cfg: T.TransformerConfig) -> Any:
+    """Logical-axis tree matching a PREPARED serving tree (M.prepare
+    layout: per-layer list, unfused under TP)."""
+    # top-level entries come from the training spec table (one source of
+    # truth; prepare() leaves them untouched)
+    top = {k: v for k, v in T.logical_specs(cfg).items() if k != "layers"}
+    specs: Dict[str, Any] = {k: top[k] for k in prepared if k != "layers"}
+    moe = cfg.n_experts > 0
+    lspecs = []
+    for lp in prepared["layers"]:
+        d = {}
+        for name in lp:
+            if moe and name in M._MOE_SPECS:
+                d[name] = M._MOE_SPECS[name]
+            else:
+                d[name] = M._SERVING_SPECS[name][1]
+        lspecs.append(d)
+    specs["layers"] = lspecs
+    return specs
+
+
 def _shard_serving_params(params: Any, cfg: T.TransformerConfig,
                           mesh: Mesh) -> Any:
-    """device_put the served weight tree with the training rules table
+    """device_put the PREPARED weight tree with the training rules table
     (parallel/sharding.py — heads/mlp/vocab over 'model'), shape-guarded
     per leaf so e.g. 2 GQA kv-heads under tp=8 replicate instead of
     failing. Quantized leaves shard their int codes by the same logical
-    spec (group scales replicate — they are small and the pairing of a
-    sharded scale dim with packed codes is not worth the bookkeeping).
+    spec (scales replicate — they are small and the pairing of a sharded
+    scale dim with packed codes is not worth the bookkeeping).
     ref: inference/engine.py:331 sharded checkpoint load + AutoTP slicing
     — here sharding is a placement, not a tensor-surgery pass."""
     from ..parallel import sharding as Sh
-    from .quantization import QuantizedWeight
+    from .quantization import ChannelQuantWeight, QuantizedWeight
 
-    is_qw = lambda x: isinstance(x, QuantizedWeight)
-    specs = T.logical_specs(cfg)
+    is_q = lambda x: isinstance(x, (QuantizedWeight, ChannelQuantWeight))
+    specs = _prepared_specs(params, cfg)
     # shape-guard against the ARRAY actually placed (int4 codes pack the
     # last dim 2-per-byte, so the guard must see the packed shape)
     shapes = jax.tree.map(
-        lambda leaf: leaf.q.shape if is_qw(leaf) else leaf.shape,
-        params, is_leaf=is_qw,
+        lambda leaf: leaf.q.shape if is_q(leaf) else leaf.shape,
+        params, is_leaf=is_q,
     )
     pspecs = Sh.tree_logical_to_mesh(specs, Sh.make_rules(), mesh,
                                      shapes=shapes)
     repl = NamedSharding(mesh, P())
 
     def put(pspec, leaf):
-        if is_qw(leaf):
+        if isinstance(leaf, QuantizedWeight):
             return QuantizedWeight(
                 q=jax.device_put(leaf.q, NamedSharding(mesh, pspec)),
                 scale=jax.device_put(leaf.scale, repl),
                 bits=leaf.bits, dtype_name=leaf.dtype_name,
+            )
+        if isinstance(leaf, ChannelQuantWeight):
+            return ChannelQuantWeight(
+                q=jax.device_put(leaf.q, NamedSharding(mesh, pspec)),
+                scale=jax.device_put(leaf.scale, repl),
+                dtype_name=leaf.dtype_name,
             )
         return jax.device_put(leaf, NamedSharding(mesh, pspec))
 
@@ -184,26 +211,50 @@ class InferenceEngine:
                     "lower max_seq_len so its bucket fits"
                 )
         self._dtype = dtype
-        self._quantization = quantization
-        if quantization:
+        self._quantization = dict(quantization) if quantization else None
+        self._per_channel = bool(self._quantization
+                                 and self._quantization.pop("per_channel",
+                                                            False))
+        if self._quantization is not None:
+            unknown = set(self._quantization) - {"bits", "group_size",
+                                                 "min_ndim"}
+            if unknown:
+                raise TypeError(
+                    f"unknown quantization keys {sorted(unknown)}; expected "
+                    "bits / group_size / min_ndim / per_channel"
+                )
+        if self._per_channel and int(quantization.get("bits", 8)) != 8:
+            raise ValueError(
+                "per_channel quantization is int8-only (int4 uses the "
+                "groupwise memory path)"
+            )
+        if quantization and not self._per_channel:
             from .quantization import dequantize_tree
 
             self._dequant = dequantize_tree
         else:
+            # per-channel codes feed the matmuls directly (M._wmm); no
+            # step-entry dequant pass
             self._dequant = lambda p: p
+        self._prepare_fn = None
         self.refresh_params(params)
         self.state = StateManager(
             num_blocks=self.config.num_kv_blocks,
             block_size=self.config.kv_block_size,
             max_tracked=self.config.max_tracked_sequences,
         )
+        # one RESERVED scratch block past the allocator's range: fused
+        # write+attend RMWs every decode row's newest block, so padding
+        # rows need a target that can never alias a live sequence
+        self.pad_block = self.config.num_kv_blocks
         self.cache = M.init_cache(
-            model_config, self.config.num_kv_blocks, self.config.kv_block_size,
-            dtype, mesh=self.mesh,
+            model_config, self.config.num_kv_blocks + 1,
+            self.config.kv_block_size, dtype, mesh=self.mesh,
         )
         self._use_kernel = jax.default_backend() == "tpu"
         self._prefill_batch_fns: Dict[Tuple[int, int], Any] = {}
-        self._decode_fns: Dict[int, Any] = {}
+        # keyed (batch_width, unique_rows)
+        self._decode_fns: Dict[Tuple[int, bool], Any] = {}
         kv_bytes = sum(x.nbytes for x in self.cache.k + self.cache.v)
         log_dist(
             f"inference engine: {self.config.num_kv_blocks} KV blocks x "
@@ -215,21 +266,36 @@ class InferenceEngine:
     def refresh_params(self, params: Any) -> None:
         """(Re)point the served weight tree — the hybrid-engine shared-
         weights path (ref: runtime/hybrid_engine.py): after training
-        steps, generation serves the updated arrays without copying
-        (the cast is a no-op when training compute dtype == serve dtype;
-        quantized engines re-quantize)."""
-        cast = jax.tree.map(
-            lambda p: p.astype(self._dtype)
-            if jnp.issubdtype(p.dtype, jnp.floating) else p,
-            params,
-        )
-        if self._quantization:
-            from .quantization import quantize_for_inference
+        steps, generation serves the updated arrays (quantized engines
+        re-quantize). The tree is cast and converted to the SERVING
+        layout (M.prepare: per-layer unstacked, fused GEMMs — see
+        inference/model.py docstring) in one compiled transform."""
+        if self._prepare_fn is None:
+            cfg, dtype = self.cfg, self._dtype
+            fuse = self.mesh is None
+            per_channel = self._per_channel
+            qz = self._quantization
 
-            cast = quantize_for_inference(cast, **self._quantization)
+            def xform(p):
+                cast = jax.tree.map(
+                    lambda x: x.astype(dtype)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                    p,
+                )
+                prep = M.prepare(cast, cfg, fuse=fuse)
+                if per_channel:
+                    prep = M.quantize_prepared(prep, cfg)
+                elif qz:
+                    from .quantization import quantize_for_inference
+
+                    prep = quantize_for_inference(prep, **qz)
+                return prep
+
+            self._prepare_fn = jax.jit(xform)
+        prepared = self._prepare_fn(params)
         if self.mesh is not None:
-            cast = _shard_serving_params(cast, self.cfg, self.mesh)
-        self.params = cast
+            prepared = _shard_serving_params(prepared, self.cfg, self.mesh)
+        self.params = prepared
 
     # -- compiled-step caches -------------------------------------------
     def _prefill_batch_fn(self, bp: int, tp: int):
@@ -251,19 +317,20 @@ class InferenceEngine:
             self._prefill_batch_fns[key] = jax.jit(step, donate_argnums=(1,))
         return self._prefill_batch_fns[key]
 
-    def _decode_fn(self, s: int):
-        if s not in self._decode_fns:
+    def _decode_fn(self, s: int, unique_rows: bool = False):
+        key = (s, unique_rows)
+        if key not in self._decode_fns:
             cfg, use_kernel, deq = self.cfg, self._use_kernel, self._dequant
             mesh = self.mesh
 
             def step(params, cache, tokens, tables, ctx):
                 return M.decode_step(
                     deq(params), cache, tokens, tables, ctx, cfg, use_kernel,
-                    mesh=mesh,
+                    mesh=mesh, unique_rows=unique_rows,
                 )
 
-            self._decode_fns[s] = jax.jit(step, donate_argnums=(1,))
-        return self._decode_fns[s]
+            self._decode_fns[key] = jax.jit(step, donate_argnums=(1,))
+        return self._decode_fns[key]
 
     def decode_multi_fn(self, s: int, n_steps: int):
         """Compiled fused greedy decode (model.decode_multi) for batch
@@ -408,14 +475,15 @@ class InferenceEngine:
             sp = _bucket(n_rows, 8)
             toks = np.zeros((sp,), np.int32)
             ctx = np.zeros((sp,), np.int32)  # pad rows: ctx 0 = inert
-            tables = np.zeros((sp, self.config.blocks_per_seq), np.int32)
+            tables = np.full((sp, self.config.blocks_per_seq),
+                             self.pad_block, np.int32)
             last_row: List[int] = []  # each chunk's final row index
             row = 0
             for pos, uid, chunk in decodes:
                 base = self.state.get(uid).seen_tokens
                 self.state.extend(uid, len(chunk))
                 table = self.state.block_table(
-                    [uid], self.config.blocks_per_seq
+                    [uid], self.config.blocks_per_seq, self.pad_block,
                 )[0]
                 for j, tok in enumerate(chunk):
                     toks[row] = int(tok)
@@ -423,7 +491,11 @@ class InferenceEngine:
                     tables[row] = table
                     row += 1
                 last_row.append(row - 1)
-            logits, self.cache = self._decode_fn(sp)(
+            # single-token rows are all DISTINCT sequences → the fused
+            # write+attend kernel applies; multi-token chunks share a
+            # table across rows and keep the separate write kernel
+            unique = all(len(c) == 1 for _, _, c in decodes)
+            logits, self.cache = self._decode_fn(sp, unique)(
                 self.params, self.cache, self._dev(toks),
                 self._dev(tables), self._dev(ctx),
             )

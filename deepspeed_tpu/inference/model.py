@@ -4,19 +4,34 @@ TPU-native redesign of the FastGen model layer
 (ref: inference/v2/model_implementations/inference_model_base.py:45
 DSInferenceModelBase + inference_transformer_base.py — there, per-layer
 CUDA kernels write QKV into the paged cache (linear_blocked_kv_rotary)
-and run blocked flash; here the same dataflow is jnp scatter for the KV
-write + the Pallas paged decode kernel / flash prefill kernel).
+and run blocked flash; here the same dataflow is a fused Pallas
+write+attend kernel over the paged arena).
 
-Weights are the SAME pytree as models/transformer (one model family, two
-execution modes — the reference needs a separate inference module zoo
-because its training and inference kernels differ; here both consume the
-functional params dict).
+Weights are the SAME pytree as models/transformer, passed through
+`prepare()` into the SERVING layout (one model family, two execution
+modes — the reference needs a separate inference module zoo because its
+training and inference kernels differ; here both consume the functional
+params dict):
+
+- layers are UNSTACKED into a python list of per-layer dicts. The
+  training layout stacks layers [L, ...] for `lax.scan`; serving decode
+  unrolls layers, and XLA materializes a per-step HBM copy of every
+  static slice of a stacked array inside the decode loop (measured
+  0.36 ms/step of pure slice copies on the 350M flagship — 16% of the
+  step). Separate per-layer arrays stream straight into their GEMMs.
+- Q/K/V projections fuse into one [E, H+2KV, D] GEMM and the llama
+  gate/up pair into one [E, 2F] GEMM (decode is launch-bound at small
+  batch; fewer, fatter MXU ops). Under a TP mesh weights stay UNFUSED:
+  splitting a 'model'-sharded fused output would insert collectives.
+- weights may be per-channel int8 (quantization.ChannelQuantWeight):
+  the matmul consumes the codes directly (XLA fuses the dequant convert
+  into the dot — int8 bytes from HBM) and scales the output.
 
 Cache: per layer, k and v as [num_blocks, block_size, KV_heads,
 head_dim] — one cache page is a contiguous (block_size, KV, D) tile
 (single large DMA in the kernels); TP shards the KV dim. All cache
-mutation goes through the Pallas RMW write kernel on donated buffers so
-the arena is updated in place.
+mutation goes through Pallas RMW kernels on donated buffers so the
+arena is updated in place.
 """
 
 from functools import partial
@@ -31,8 +46,156 @@ from ..ops.attention import causal_attention
 from ..ops.pallas.paged_attention import (
     paged_decode_attention,
     paged_decode_attention_xla,
+    paged_decode_fused,
     paged_kv_write,
+    supports_fused_v2,
 )
+from .quantization import ChannelQuantWeight, channel_quantize
+
+
+# ---------------------------------------------------------------------------
+# serving weight layout
+# ---------------------------------------------------------------------------
+
+def is_prepared(params) -> bool:
+    return isinstance(params.get("layers"), (list, tuple))
+
+
+def prepare(params: Dict[str, Any], cfg: T.TransformerConfig,
+            fuse: bool = True) -> Dict[str, Any]:
+    """Training layout -> serving layout (see module docstring).
+
+    fuse=False keeps wq/wk/wv and w_gate/w_in separate — required under
+    a TP mesh where the fused output dim would be 'model'-sharded and
+    the split would reshard. Call once (e.g. under jit at
+    refresh_params time), NOT inside a per-token compiled step: the
+    concats copy the weight tree."""
+    if is_prepared(params):
+        return params
+    out = {k: v for k, v in params.items() if k != "layers"}
+    st = params["layers"]
+    lead = jax.tree.leaves(st)[0]
+    L = cfg.n_layers
+    if lead.shape[0] != L:
+        raise ValueError(
+            f"serving expects flat [n_layers, ...] stacked layers "
+            f"(got leading dim {lead.shape[0]} != {L}; merge pipeline "
+            "partitions before serving)"
+        )
+    layers = []
+    for l in range(L):
+        lp = {name: w[l] for name, w in st.items()}
+        if fuse:
+            lp["w_qkv"] = jnp.concatenate(
+                [lp.pop("wq"), lp.pop("wk"), lp.pop("wv")], axis=1)
+            if "bq" in lp:
+                lp["b_qkv"] = jnp.concatenate(
+                    [lp.pop("bq"), lp.pop("bk"), lp.pop("bv")], axis=0)
+            if cfg.n_experts == 0 and "w_gate" in lp:
+                lp["w_gi"] = jnp.concatenate(
+                    [lp.pop("w_gate"), lp.pop("w_in")], axis=1)
+        layers.append(lp)
+    out["layers"] = layers
+    return out
+
+
+# per-layer serving weight name -> (contract_ndim, logical axes) for
+# per-channel quantization and TP sharding of the PREPARED layout
+_SERVING_SPECS = {
+    "w_qkv": (1, ("embed", "heads", "head_dim")),
+    "wq": (1, ("embed", "heads", "head_dim")),
+    "wk": (1, ("embed", "heads", "head_dim")),
+    "wv": (1, ("embed", "heads", "head_dim")),
+    "wo": (2, ("heads", "head_dim", "embed")),
+    "w_gi": (1, ("embed", "mlp")),
+    "w_gate": (1, ("embed", "mlp")),
+    "w_in": (1, ("embed", "mlp")),
+    "w_out": (1, ("mlp", "embed")),
+    "b_qkv": (None, ("heads", "head_dim")),
+    "bq": (None, ("heads", "head_dim")),
+    "bk": (None, ("heads", "head_dim")),
+    "bv": (None, ("heads", "head_dim")),
+    "bo": (None, ("embed",)),
+    "b_in": (None, ("mlp",)),
+    "b_out": (None, ("embed",)),
+    "ln1_scale": (None, ("embed",)),
+    "ln1_bias": (None, ("embed",)),
+    "ln2_scale": (None, ("embed",)),
+    "ln2_bias": (None, ("embed",)),
+    # MoE expert stacks (never per-channel-quantized; X leading dim)
+    "w_router": (None, ("embed", None)),
+}
+_MOE_SPECS = {
+    "w_in": ("expert", "embed", "expert_mlp"),
+    "w_out": ("expert", "expert_mlp", "embed"),
+    "w_gate": ("expert", "embed", "expert_mlp"),
+    "b_in": ("expert", "expert_mlp"),
+    "b_out": ("expert", "embed"),
+}
+
+
+def quantize_prepared(prepared: Dict[str, Any],
+                      cfg: T.TransformerConfig) -> Dict[str, Any]:
+    """Per-channel int8 over the prepared tree (the decode SPEED path;
+    see ChannelQuantWeight). Embedding quantizes per ROW so one scale
+    serves both the lookup and the tied-logits contraction. Norm
+    scales, biases, the position table, and MoE expert stacks stay full
+    precision."""
+    out = dict(prepared)
+    out["embed"] = channel_quantize(prepared["embed"], 1, scale_first=True)
+    if "lm_head" in prepared:
+        out["lm_head"] = channel_quantize(prepared["lm_head"], 1)
+    moe = cfg.n_experts > 0
+    layers = []
+    for lp in prepared["layers"]:
+        nlp = dict(lp)
+        for name, w in lp.items():
+            spec = _SERVING_SPECS.get(name)
+            if spec is None or spec[0] is None:
+                continue
+            if moe and name in ("w_gate", "w_in", "w_out"):
+                continue  # expert stacks: keep fp (scanned, not hot)
+            nlp[name] = channel_quantize(w, spec[0])
+        layers.append(nlp)
+    out["layers"] = layers
+    return out
+
+
+def _wmm(eq: str, x, w):
+    """einsum with a weight that may be per-channel int8: codes feed the
+    dot (convert fuses into the MXU operand stream — int8 HBM bytes),
+    the per-output-channel scale is an elementwise epilogue."""
+    if isinstance(w, ChannelQuantWeight):
+        y = jnp.einsum(eq, x, w.q.astype(x.dtype))
+        return y * w.scale.astype(x.dtype)
+    return jnp.einsum(eq, x, w.astype(x.dtype))
+
+
+def _embed_rows(embed, tokens):
+    if isinstance(embed, ChannelQuantWeight):
+        dt = jnp.dtype(embed.dtype_name)
+        return (embed.q[tokens].astype(dt)
+                * embed.scale[tokens][..., None].astype(dt))
+    return embed[tokens]
+
+
+def _lm_logits(x, params, cfg: T.TransformerConfig):
+    """Final-norm'd activations [.., E] -> f32 logits [.., V]. Tied
+    embeddings contract WITHOUT materializing embed.T (ref r3 profile:
+    the transpose showed up as per-step HBM copies)."""
+    if cfg.tie_embeddings:
+        emb = params["embed"]
+        if isinstance(emb, ChannelQuantWeight):
+            y = jnp.einsum("...e,ve->...v", x, emb.q.astype(x.dtype))
+            return y.astype(jnp.float32) * emb.scale
+        return jnp.einsum("...e,ve->...v", x, emb.astype(x.dtype)
+                          ).astype(jnp.float32)
+    head = params["lm_head"]
+    if isinstance(head, ChannelQuantWeight):
+        y = jnp.einsum("...e,ev->...v", x, head.q.astype(x.dtype))
+        return y.astype(jnp.float32) * head.scale
+    return jnp.einsum("...e,ev->...v", x, head.astype(x.dtype)
+                      ).astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------
@@ -170,15 +333,16 @@ def _write_kv(cache_k, cache_v, k_new, v_new, flat_idx, mesh=None):
 
 
 def _write_kv_xla(cache_k, cache_v, k_new, v_new, flat_idx):
-    """jnp scatter oracle for paged_kv_write (tests)."""
+    """jnp scatter oracle for paged_kv_write (tests + CPU/TP fallback).
+
+    -1 slots must be DROPPED: jax wraps negative indices even under
+    mode="drop" (only out-of-bounds drops), so map them past the arena
+    first — otherwise pad rows would overwrite the last cache slot."""
     NBLK, bs, KV, D = cache_k.shape
-    ck = cache_k.reshape(NBLK * bs, KV, D).at[flat_idx].set(k_new, mode="drop")
-    cv = cache_v.reshape(NBLK * bs, KV, D).at[flat_idx].set(v_new, mode="drop")
+    idx = jnp.where(flat_idx < 0, NBLK * bs, flat_idx)
+    ck = cache_k.reshape(NBLK * bs, KV, D).at[idx].set(k_new, mode="drop")
+    cv = cache_v.reshape(NBLK * bs, KV, D).at[idx].set(v_new, mode="drop")
     return ck.reshape(NBLK, bs, KV, D), cv.reshape(NBLK, bs, KV, D)
-
-
-def _layer_params(params, l):
-    return {name: w[l] for name, w in params["layers"].items()}
 
 
 def _sparsity(cfg: T.TransformerConfig):
@@ -252,6 +416,9 @@ def _sparse_decode_allowed_slots(scfg, positions, n_blocks: int,
 def _mlp(h, lp, cfg: T.TransformerConfig):
     """FFN over [T, E] tokens — dense or MoE (Mixtral-class serving).
 
+    Dense llama uses the fused [E, 2F] gate|up GEMM when the prepared
+    layout carries it (see prepare()).
+
     MoE serving is CAPACITY-FREE exact top-k: every token gets its full
     expert mix — no train-time capacity drops (those are a training-
     throughput artifact; ref: sharded_moe.py top1/top2gating keep the
@@ -266,15 +433,18 @@ def _mlp(h, lp, cfg: T.TransformerConfig):
     optimization lever for huge prefills."""
     if cfg.n_experts == 0:
         if cfg.variant == "llama":
-            inner = jax.nn.silu(
-                jnp.einsum("te,ef->tf", h, lp["w_gate"].astype(h.dtype))
-            ) * jnp.einsum("te,ef->tf", h, lp["w_in"].astype(h.dtype))
+            if "w_gi" in lp:
+                gi = _wmm("te,ef->tf", h, lp["w_gi"])
+                F = gi.shape[-1] // 2
+                inner = jax.nn.silu(gi[:, :F]) * gi[:, F:]
+            else:
+                inner = jax.nn.silu(_wmm("te,ef->tf", h, lp["w_gate"])) \
+                    * _wmm("te,ef->tf", h, lp["w_in"])
         else:
             inner = jax.nn.gelu(
-                jnp.einsum("te,ef->tf", h, lp["w_in"].astype(h.dtype))
-                + lp["b_in"].astype(h.dtype)
+                _wmm("te,ef->tf", h, lp["w_in"]) + lp["b_in"].astype(h.dtype)
             )
-        out = jnp.einsum("tf,fe->te", inner, lp["w_out"].astype(h.dtype))
+        out = _wmm("tf,fe->te", inner, lp["w_out"])
         if cfg.variant == "gpt2":
             out = out + lp["b_out"].astype(h.dtype)
         return out
@@ -321,24 +491,32 @@ def _mlp(h, lp, cfg: T.TransformerConfig):
 
 
 def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None,
-                      allowed_slots=None, window: int = 0, mesh=None):
+                      allowed_slots=None, window: int = 0, mesh=None,
+                      k_new=None, v_new=None, slots=None):
+    """k_new/v_new/slots non-None selects the FUSED write+attend kernel
+    (single-token decode rows; ck/cv are the PRE-write arenas and the
+    returned (att, ck, cv) includes the in-kernel RMW)."""
+    fused = k_new is not None
     if allowed_slots is not None and use_kernel and _tp_size(mesh) <= 1:
         # block-sparse serving on the Pallas kernel: the layout rides in
         # as a per-slot bitmap (scalar prefetch) and pruned slots skip
         # compute entirely
         return paged_decode_attention(q, ck, cv, table, ctx, window=window,
-                                      allowed_slots=allowed_slots)
+                                      allowed_slots=allowed_slots,
+                                      k_new=k_new, v_new=v_new, slots=slots)
     if allowed is not None:
         # layout finer than the cache blocks (or TP mesh): XLA path with
         # the per-position mask. (window is passed through for
         # completeness — the config forbids sparse+sliding_window, so
         # both masks never actually combine today.)
+        assert not fused
         return paged_decode_attention_xla(q, ck, cv, table, ctx,
                                           allowed=allowed, window=window)
     tp = _tp_size(mesh)
     H, KV = q.shape[1], ck.shape[2]
     if tp > 1 and H % tp == 0 and KV % tp == 0:
         # heads are device-local: run the kernel (or its oracle) per shard
+        assert not fused
         fn = partial(paged_decode_attention if use_kernel
                      else paged_decode_attention_xla, window=window)
         qs = P(None, "model", None)
@@ -349,9 +527,17 @@ def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None,
             out_specs=qs,
         )(q, ck, cv, table, ctx)
     if use_kernel and tp <= 1:
-        return paged_decode_attention(q, ck, cv, table, ctx, window=window)
+        if fused and supports_fused_v2(q.shape[-1]):
+            # per-sequence grid + manual block DMA: the dense decode hot
+            # path (live blocks only, 2KB row writes instead of 256KB
+            # block RMW through the output pipeline)
+            return paged_decode_fused(q, ck, cv, table, ctx,
+                                      k_new, v_new, slots, window=window)
+        return paged_decode_attention(q, ck, cv, table, ctx, window=window,
+                                      k_new=k_new, v_new=v_new, slots=slots)
     # under a TP mesh with non-divisible heads, the XLA path lets SPMD
     # partition freely (a raw pallas_call over sharded operands cannot)
+    assert not fused
     return paged_decode_attention_xla(q, ck, cv, table, ctx, window=window)
 
 
@@ -362,6 +548,7 @@ def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None,
 def decode_step(
     params, cache: PagedCache, tokens, tables, ctx_lens, cfg: T.TransformerConfig,
     use_kernel: bool = True, mesh: Optional[Mesh] = None,
+    unique_rows: bool = False,
 ):
     """tokens [S] int32, tables [S, NB] int32, ctx_lens [S] int32 (context
     length INCLUDING the new token) → (logits [S, V], new cache).
@@ -369,9 +556,18 @@ def decode_step(
     ref: engine_v2.py put→model.forward decode path; one compiled program
     per (S, NB) shape. mesh: TP serving — params/cache arrive sharded
     over 'model' and constraints keep activations head-sharded between
-    the column-parallel QKV and row-parallel output projections."""
+    the column-parallel QKV and row-parallel output projections.
+
+    unique_rows=True asserts every row is a distinct sequence (no
+    chunked-continuation rows sharing a block table) — this enables the
+    fused write+attend kernel, halving Pallas launches per layer. The
+    caller must also guarantee padding rows' tables point at a reserved
+    scratch block (engine: pad_block), since the fused kernel's
+    write-back touches each row's target block."""
     S = tokens.shape[0]
-    E, KV, D, bs = cfg.d_model, cfg.kv_heads, cfg.head_dim, cache.block_size
+    if not is_prepared(params):
+        params = prepare(params, cfg, fuse=mesh is None)
+    H, KV, D, bs = cfg.n_heads, cfg.kv_heads, cfg.head_dim, cache.block_size
     # rows with ctx_lens == 0 are batch padding: their KV write is dropped
     # and their (garbage) logits are sliced off by the engine
     valid = ctx_lens > 0
@@ -388,45 +584,66 @@ def decode_step(
         else:
             allowed = _sparse_decode_allowed(
                 scfg, positions, tables.shape[1] * cache.block_size)
-    x = params["embed"][tokens]  # [S, E] — activations in the params dtype
+    x = _embed_rows(params["embed"], tokens)  # [S, E]
     if cfg.variant == "gpt2":
         x = x + params["pos_embed"][positions].astype(x.dtype)
 
+    # fused write+attend only on the single-device kernel path (the
+    # shard_map TP path and the XLA fallbacks keep the separate write)
+    fuse_write = (
+        unique_rows and use_kernel and _tp_size(mesh) <= 1
+        and allowed is None
+    )
+
+    # per-row flat slot: each row has its own table; padding rows
+    # scatter to -1 which mode="drop" discards
+    flat_idx = (
+        jnp.take_along_axis(tables, (positions // bs)[:, None], axis=1)[:, 0]
+        * bs + positions % bs
+    )
+    flat_idx = jnp.where(valid, flat_idx, jnp.int32(-1))
+
     new_k, new_v = [], []
-    for l in range(cfg.n_layers):
-        lp = _layer_params(params, l)
+    for lp in params["layers"]:
         h = T._act_quant(T._norm(x, lp["ln1_scale"], lp.get("ln1_bias"), cfg), cfg)
-        q = jnp.einsum("se,ehd->shd", h, lp["wq"].astype(x.dtype))
-        k = jnp.einsum("se,ehd->shd", h, lp["wk"].astype(x.dtype))
-        v = jnp.einsum("se,ehd->shd", h, lp["wv"].astype(x.dtype))
-        if cfg.variant == "gpt2":
-            q = q + lp["bq"].astype(x.dtype)
-            k = k + lp["bk"].astype(x.dtype)
-            v = v + lp["bv"].astype(x.dtype)
+        if "w_qkv" in lp:
+            qkv = _wmm("se,ehd->shd", h, lp["w_qkv"])
+            if "b_qkv" in lp:
+                qkv = qkv + lp["b_qkv"].astype(x.dtype)
+            q, k, v = jnp.split(qkv, [H, H + KV], axis=1)
         else:
+            q = _wmm("se,ehd->shd", h, lp["wq"])
+            k = _wmm("se,ehd->shd", h, lp["wk"])
+            v = _wmm("se,ehd->shd", h, lp["wv"])
+            if "bq" in lp:
+                q = q + lp["bq"].astype(x.dtype)
+                k = k + lp["bk"].astype(x.dtype)
+                v = v + lp["bv"].astype(x.dtype)
+        if cfg.variant != "gpt2":
             q = _rope_at(q, positions, cfg)
             k = _rope_at(k, positions, cfg)
         q = _cons(q, mesh, None, "model", None)
         k = _cons(k, mesh, None, "model", None)
         v = _cons(v, mesh, None, "model", None)
 
-        # per-row flat slot: each row has its own table; padding rows
-        # scatter to -1 which mode="drop" discards
-        flat_idx = (
-            jnp.take_along_axis(tables, (positions // bs)[:, None], axis=1)[:, 0]
-            * bs + positions % bs
-        )
-        flat_idx = jnp.where(valid, flat_idx, jnp.int32(-1))
-        ck, cv = _write_kv(cache.k[l], cache.v[l], k, v, flat_idx, mesh)
-        ck = _cons(ck, mesh, None, None, "model", None)
-        cv = _cons(cv, mesh, None, None, "model", None)
+        ck_in, cv_in = cache.k[len(new_k)], cache.v[len(new_k)]
+        if fuse_write:
+            att, ck, cv = _decode_attention(
+                q, ck_in, cv_in, tables, ctx_lens, use_kernel,
+                allowed_slots=allowed_slots, window=cfg.sliding_window,
+                mesh=mesh, k_new=k, v_new=v, slots=flat_idx,
+            )
+        else:
+            ck, cv = _write_kv(ck_in, cv_in, k, v, flat_idx, mesh)
+            ck = _cons(ck, mesh, None, None, "model", None)
+            cv = _cons(cv, mesh, None, None, "model", None)
+            att = _decode_attention(q, ck, cv, tables, ctx_lens, use_kernel,
+                                    allowed=allowed,
+                                    allowed_slots=allowed_slots,
+                                    window=cfg.sliding_window, mesh=mesh)
         new_k.append(ck)
         new_v.append(cv)
-
-        att = _decode_attention(q, ck, cv, tables, ctx_lens, use_kernel,
-                                allowed=allowed, allowed_slots=allowed_slots,
-                                window=cfg.sliding_window, mesh=mesh)
-        out = jnp.einsum("shd,hde->se", att, lp["wo"].astype(x.dtype))
+        out = _wmm("shd,hde->se", att, lp["wo"])
         if cfg.variant == "gpt2":
             out = out + lp["bo"].astype(x.dtype)
         x = x + out
@@ -435,16 +652,15 @@ def decode_step(
         x = x + _mlp(h, lp, cfg)
 
     x = T._norm(x, params["ln_f_scale"], params.get("ln_f_bias"), cfg)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("se,ev->sv", x, head.astype(x.dtype))
-    logits = _cons(logits.astype(jnp.float32), mesh, None, None)
+    logits = _lm_logits(x, params, cfg)
+    logits = _cons(logits, mesh, None, None)
     return logits, PagedCache(k=new_k, v=new_v)
 
 
 def decode_multi(
     params, cache: PagedCache, tokens, tables, ctx_lens,
     cfg: T.TransformerConfig, n_steps: int, use_kernel: bool = True,
-    mesh: Optional[Mesh] = None,
+    mesh: Optional[Mesh] = None, unique_rows: bool = True,
 ):
     """Fused greedy decode: n_steps tokens per compiled program.
 
@@ -452,18 +668,23 @@ def decode_multi(
     host dispatches once per n_steps instead of per token, amortizing
     dispatch/scheduling latency (the SplitFuse-era "fixed work per
     forward" idea applied along time). Block tables must already cover
-    ctx_lens + n_steps positions.
+    ctx_lens + n_steps positions. Rows are by construction distinct
+    sequences (each advances its own context), so the fused
+    write+attend kernel applies (see decode_step unique_rows).
 
     Returns (generated [n_steps, S] int32, final logits [S, V], cache).
     """
 
     S = tokens.shape[0]
     V = cfg.vocab_size
+    if not is_prepared(params):
+        params = prepare(params, cfg, fuse=mesh is None)
 
     def body(carry, _):
         toks, ctx, _, cache = carry
         logits, cache = decode_step(params, cache, toks, tables, ctx, cfg,
-                                    use_kernel, mesh=mesh)
+                                    use_kernel, mesh=mesh,
+                                    unique_rows=unique_rows)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         # logits ride the CARRY (overwritten per step): stacking them in ys
         # would keep a dead [n_steps, S, V] accumulator live in HBM
@@ -513,6 +734,9 @@ def prefill_batch(
     call. Rows with n_real == 0 are batch padding (garbage logits,
     sliced by the caller; their KV writes drop)."""
     B, Tp = tokens.shape
+    if not is_prepared(params):
+        params = prepare(params, cfg, fuse=mesh is None)
+    H, KV = cfg.n_heads, cfg.kv_heads
     bs = cache.block_size
     positions = jnp.arange(Tp, dtype=jnp.int32)
     scfg = _sparsity(cfg)
@@ -520,7 +744,7 @@ def prefill_batch(
         _sparse_prefill_mask(scfg, Tp)
         if scfg is not None and Tp % scfg.block != 0 else None
     )
-    x = params["embed"][tokens]  # [B, Tp, E] — params-dtype activations
+    x = _embed_rows(params["embed"], tokens)  # [B, Tp, E]
     if cfg.variant == "gpt2":
         x = x + params["pos_embed"][:Tp].astype(x.dtype)[None]
 
@@ -534,17 +758,22 @@ def prefill_batch(
     ).reshape(B * Tp)
 
     new_k, new_v = [], []
-    for l in range(cfg.n_layers):
-        lp = _layer_params(params, l)
+    for lp in params["layers"]:
         h = T._act_quant(T._norm(x, lp["ln1_scale"], lp.get("ln1_bias"), cfg), cfg)
-        q = jnp.einsum("bse,ehd->bshd", h, lp["wq"].astype(x.dtype))
-        k = jnp.einsum("bse,ehd->bshd", h, lp["wk"].astype(x.dtype))
-        v = jnp.einsum("bse,ehd->bshd", h, lp["wv"].astype(x.dtype))
-        if cfg.variant == "gpt2":
-            q = q + lp["bq"].astype(x.dtype)
-            k = k + lp["bk"].astype(x.dtype)
-            v = v + lp["bv"].astype(x.dtype)
+        if "w_qkv" in lp:
+            qkv = _wmm("bse,ehd->bshd", h, lp["w_qkv"])
+            if "b_qkv" in lp:
+                qkv = qkv + lp["b_qkv"].astype(x.dtype)
+            q, k, v = jnp.split(qkv, [H, H + KV], axis=2)
         else:
+            q = _wmm("bse,ehd->bshd", h, lp["wq"])
+            k = _wmm("bse,ehd->bshd", h, lp["wk"])
+            v = _wmm("bse,ehd->bshd", h, lp["wv"])
+            if "bq" in lp:
+                q = q + lp["bq"].astype(x.dtype)
+                k = k + lp["bk"].astype(x.dtype)
+                v = v + lp["bv"].astype(x.dtype)
+        if cfg.variant != "gpt2":
             rot = jax.vmap(_rope_at, in_axes=(0, None, None))
             q = rot(q, positions, cfg)
             k = rot(k, positions, cfg)
@@ -553,6 +782,7 @@ def prefill_batch(
         v = _cons(v, mesh, None, None, "model", None)
 
         KVh, Dh = k.shape[2], k.shape[3]
+        l = len(new_k)
         ck, cv = _write_kv(cache.k[l], cache.v[l],
                            k.reshape(B * Tp, KVh, Dh),
                            v.reshape(B * Tp, KVh, Dh), flat_idx, mesh)
@@ -589,7 +819,7 @@ def prefill_batch(
                 # a raw pallas_call cannot consume TP-sharded operands
                 use_flash=use_kernel and cfg.use_flash and _tp_size(mesh) <= 1,
                 window=cfg.sliding_window)
-        out = jnp.einsum("bshd,hde->bse", att, lp["wo"].astype(x.dtype))
+        out = _wmm("bshd,hde->bse", att, lp["wo"])
         if cfg.variant == "gpt2":
             out = out + lp["bo"].astype(x.dtype)
         x = x + out
@@ -604,7 +834,6 @@ def prefill_batch(
     x_last = jnp.take_along_axis(x, last[:, None, None].astype(jnp.int32)
                                  .repeat(x.shape[-1], axis=2), axis=1)[:, 0]
     x_last = T._norm(x_last, params["ln_f_scale"], params.get("ln_f_bias"), cfg)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("be,ev->bv", x_last, head.astype(x_last.dtype))
-    logits = _cons(logits.astype(jnp.float32), mesh, None, None)
+    logits = _lm_logits(x_last, params, cfg)
+    logits = _cons(logits, mesh, None, None)
     return logits, PagedCache(k=new_k, v=new_v)

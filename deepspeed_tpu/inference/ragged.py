@@ -148,9 +148,12 @@ class StateManager:
         self.allocator.free(seq.blocks)
 
     # -- device views ----------------------------------------------------
-    def block_table(self, uids: List[int], max_blocks: int) -> np.ndarray:
-        """Dense [len(uids), max_blocks] int32 block table (padded 0)."""
-        out = np.zeros((len(uids), max_blocks), np.int32)
+    def block_table(self, uids: List[int], max_blocks: int,
+                    pad_block: int = 0) -> np.ndarray:
+        """Dense [len(uids), max_blocks] int32 block table. Unused slots
+        fill with pad_block — the engine passes its reserved scratch
+        block so fused-kernel pad rows never touch a live block."""
+        out = np.full((len(uids), max_blocks), pad_block, np.int32)
         for i, uid in enumerate(uids):
             blocks = self._seqs[uid].blocks
             if len(blocks) > max_blocks:

@@ -39,13 +39,20 @@ def _win_jbase_decode(ctx, window: int, block_size: int):
 
 
 def _decode_kernel(
-    tbl_ref, ctx_ref, allow_ref,  # scalar prefetch: [S, NB] block table,
-    # [S] ctx lens, [S, NB] allowed-slot bitmap (block-sparse; all-ones
-    # sentinel when dense)
-    q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc,
-    *, block_size: int, scale: float, n_kv: int, gp: int, window: int,
-    sparse: bool,
+    tbl_ref, ctx_ref, allow_ref, slot_ref,  # scalar prefetch: [S, NB]
+    # block table, [S] ctx lens, [S, NB] allowed-slot bitmap (block-
+    # sparse; all-ones sentinel when dense), [S] write slots (fused
+    # write+attend; all -1 sentinel when not fused)
+    q_ref, *rest,
+    block_size: int, scale: float, n_kv: int, gp: int, window: int,
+    sparse: bool, fused: bool,
 ):
+    if fused:
+        (kn_ref, vn_ref, k_ref, v_ref,
+         o_ref, ck_out, cv_out, acc_sc, m_sc, l_sc) = rest
+    else:
+        k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc = rest
+        kn_ref = vn_ref = ck_out = cv_out = None
     s = pl.program_id(0)
     j = pl.program_id(1)  # table slot (sequential; window-relative)
     nb = pl.num_programs(1)
@@ -57,13 +64,19 @@ def _decode_kernel(
         acc_sc[:] = jnp.zeros_like(acc_sc)
 
     ctx = ctx_ref[s]
+    last = jnp.maximum(ctx - 1, 0) // block_size
+    # fused: the cache holds only positions < ctx-1 (the new token rides
+    # in as its own column below) — a block with no OLD live column is
+    # skipped entirely, which also keeps the online softmax away from
+    # the all-masked NaN corner (ctx==1, or a token opening a new block)
+    eff_ctx = ctx - 1 if fused else ctx
     if window > 0:
         # grid walks only the ~window/bs slots inside the window
         j_abs = _win_jbase_decode(ctx, window, block_size) + j
-        needed = j_abs * block_size < ctx
+        needed = j_abs * block_size < eff_ctx
     else:
         j_abs = j
-        needed = j * block_size < ctx
+        needed = j * block_size < eff_ctx
     if sparse:
         # block-sparse layout row: slots outside the layout are skipped
         # entirely (compute AND their DMA is clamped to a resident tile)
@@ -76,7 +89,14 @@ def _decode_kernel(
         cols = j_abs * block_size + jax.lax.broadcasted_iota(
             jnp.int32, (gp, block_size), 1
         )
-        live = cols < ctx
+        # fused: the new token's row is NOT in the cache yet — mask its
+        # position (ctx-1) out here; its contribution enters as a single
+        # extra online-softmax column at the final grid step below. This
+        # keeps the per-block compute identical to the non-fused kernel
+        # (an earlier variant folded the row into the loaded block with
+        # a (bs, KV, D) select at EVERY grid step — ~10us/call of VPU
+        # time at decode widths).
+        live = cols < eff_ctx
         if window > 0:
             live = jnp.logical_and(live, cols >= ctx - window)
         for h in range(n_kv):
@@ -94,6 +114,44 @@ def _decode_kernel(
             acc_sc[row] = acc_sc[row] * corr + _dot(p.astype(v.dtype), v[:, h, :])
             m_sc[row] = m_new
 
+    if fused:
+        slot = slot_ref[s]
+
+        @pl.when(jnp.logical_and(j == nb - 1, slot >= 0))
+        def _new_token_column():
+            # the new token's score as a 1-column online-softmax update,
+            # straight from the VMEM-resident kn/vn rows
+            for h in range(n_kv):
+                q = q_ref[0, h]  # (Gp, D)
+                stn = (jnp.sum(q * kn_ref[0, h][None, :], axis=1,
+                               keepdims=True) * scale
+                       ).astype(jnp.float32)  # (Gp, 1)
+                row = slice(h * gp, (h + 1) * gp)
+                m_prev = m_sc[row]
+                m_new = jnp.maximum(m_prev, stn)
+                p = jnp.exp(stn - m_new)
+                corr = jnp.exp(m_prev - m_new)
+                l_sc[row] = l_sc[row] * corr + p
+                acc_sc[row] = (acc_sc[row] * corr
+                               + p * vn_ref[0, h][None, :].astype(jnp.float32))
+                m_sc[row] = m_new
+
+        @pl.when(j == nb - 1)
+        def _store():
+            # at the final step the index clamp guarantees the loaded
+            # block IS the write target (tbl[s, last]); RMW the new
+            # token's row into it once. Pad rows (slot -1) write the
+            # loaded block back unchanged — their table points at the
+            # reserved scratch block, never a live one.
+            kb = k_ref[0]
+            vb = v_ref[0]
+            rowm = jax.lax.broadcasted_iota(
+                jnp.int32, (block_size, 1, 1), 0
+            ) == jnp.maximum(slot, 0) % block_size
+            wmask = jnp.logical_and(slot >= 0, rowm)
+            ck_out[0] = jnp.where(wmask, kn_ref[0][None], kb)
+            cv_out[0] = jnp.where(wmask, vn_ref[0][None], vb)
+
     @pl.when(j == nb - 1)
     def _finalize():
         l = l_sc[:]
@@ -106,10 +164,11 @@ def _decode_kernel(
 
 
 def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens,
-                           window: int = 0, allowed_slots=None):
+                           window: int = 0, allowed_slots=None,
+                           k_new=None, v_new=None, slots=None):
     """One-token-per-sequence attention over the paged KV cache.
 
-    q: [S, H, D] (the new token's queries, KV already written)
+    q: [S, H, D] (the new token's queries)
     k_cache/v_cache: [num_blocks, block_size, KV, D]
     block_table: [S, NB] int32 — cache block ids per sequence
     ctx_lens: [S] int32 — context length INCLUDING the new token; rows
@@ -122,7 +181,18 @@ def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens,
       be a multiple of the cache block size so each cache block falls in
       ONE layout block). Skipped slots cost no compute and their DMA is
       clamped to a resident tile.
-    returns: [S, H, D]
+    k_new/v_new [S, KV, D] + slots [S]: FUSED write+attend — the new
+      token's KV is folded into its target block in VMEM (attention sees
+      it) and the block is RMW'd back to the arena, replacing the
+      separate paged_kv_write call (which cost a second kernel launch
+      per layer; decode at small batch is launch-bound). Returns
+      (out, new_k_cache, new_v_cache) with the caches aliased in place.
+      REQUIRES: distinct sequences per row (no chunked-continuation
+      rows sharing a table — their writes would race across grid steps)
+      and pad rows (ctx 0 / slot -1) pointing at a reserved scratch
+      block, since each row's target block is written back even when
+      nothing changed. The write slot must be ctx-1's flat slot.
+    returns: [S, H, D] (fused: (out, k_cache, v_cache))
     """
     S, H, D = q.shape
     NBLK, bs, KV, _ = k_cache.shape
@@ -131,14 +201,17 @@ def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens,
     Gp = max(G, 8)  # sublane-pad tiny query blocks
     scale = 1.0 / (D**0.5)
     sparse = allowed_slots is not None
+    fused = k_new is not None
     allow = (allowed_slots.astype(jnp.int32) if sparse
              else jnp.ones((S, NB), jnp.int32))
+    slots_arr = (slots.astype(jnp.int32) if fused
+                 else jnp.full((S,), -1, jnp.int32))
 
     qg = q.reshape(S, KV, G, D)
     if Gp != G:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, Gp - G), (0, 0)))
 
-    def kv_index(s, j, tbl_ref, ctx_ref, allow_ref):
+    def kv_index(s, j, tbl_ref, ctx_ref, allow_ref, slot_ref):
         last = jnp.maximum(ctx_ref[s] - 1, 0) // bs
         if window > 0:
             j = _win_jbase_decode(ctx_ref[s], window, bs) + j
@@ -151,33 +224,64 @@ def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens,
             j = jnp.where(allow_ref[s, j] != 0, j, last)
         return (tbl_ref[s, j], 0, 0, 0)
 
+    def row_index(s, j, tbl_ref, ctx_ref, allow_ref, slot_ref):
+        return (s, 0, 0)
+
+    def q_index(s, j, tbl_ref, ctx_ref, allow_ref, slot_ref):
+        return (s, 0, 0, 0)
+
+    def tgt_index(s, j, tbl_ref, ctx_ref, allow_ref, slot_ref):
+        # constant in j: the sequence's NEWEST block — flushed once
+        last = jnp.maximum(ctx_ref[s] - 1, 0) // bs
+        return (tbl_ref[s, last], 0, 0, 0)
+
     NBw = min(NB, pl.cdiv(window, bs) + 1) if window > 0 else NB
+    kv_spec = pl.BlockSpec((1, bs, KV, D), kv_index)
+    in_specs = [pl.BlockSpec((1, KV, Gp, D), q_index)]
+    if fused:
+        in_specs += [pl.BlockSpec((1, KV, D), row_index),
+                     pl.BlockSpec((1, KV, D), row_index)]
+    in_specs += [kv_spec, kv_spec]
+    o_spec = pl.BlockSpec((1, KV, Gp, D), q_index)
+    o_shape = jax.ShapeDtypeStruct((S, KV, Gp, D), q.dtype)
+    if fused:
+        tgt_spec = pl.BlockSpec((1, bs, KV, D), tgt_index)
+        out_specs = [o_spec, tgt_spec, tgt_spec]
+        out_shape = [o_shape,
+                     jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+                     jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype)]
+        # args: (4 scalar-prefetch), q, kn, vn, k_cache, v_cache
+        aliases = {7: 1, 8: 2}
+    else:
+        out_specs = o_spec
+        out_shape = o_shape
+        aliases = {}
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(S, NBw),
-        in_specs=[
-            pl.BlockSpec((1, KV, Gp, D),
-                         lambda s, j, tbl, ctx, al: (s, 0, 0, 0)),
-            pl.BlockSpec((1, bs, KV, D), kv_index),
-            pl.BlockSpec((1, bs, KV, D), kv_index),
-        ],
-        out_specs=pl.BlockSpec((1, KV, Gp, D),
-                               lambda s, j, tbl, ctx, al: (s, 0, 0, 0)),
+        in_specs=in_specs,
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((KV * Gp, D), jnp.float32),
             pltpu.VMEM((KV * Gp, 1), jnp.float32),
             pltpu.VMEM((KV * Gp, 1), jnp.float32),
         ],
     )
-    out = pl.pallas_call(
+    call = pl.pallas_call(
         functools.partial(
             _decode_kernel, block_size=bs, scale=scale, n_kv=KV, gp=Gp,
-            window=window, sparse=sparse,
+            window=window, sparse=sparse, fused=fused,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((S, KV, Gp, D), q.dtype),
+        out_shape=out_shape,
+        input_output_aliases=aliases,
         interpret=_interpret(),
-    )(block_table, ctx_lens, allow, qg, k_cache, v_cache)
+    )
+    if fused:
+        out, ck, cv = call(block_table, ctx_lens, allow, slots_arr, qg,
+                           k_new, v_new, k_cache, v_cache)
+        return out[:, :, :G, :].reshape(S, H, D), ck, cv
+    out = call(block_table, ctx_lens, allow, slots_arr, qg, k_cache, v_cache)
     return out[:, :, :G, :].reshape(S, H, D)
 
 
@@ -211,6 +315,227 @@ def paged_decode_attention_xla(q, k_cache, v_cache, block_table, ctx_lens,
     logits = jnp.where(mask[:, None, :], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("shk,skhd->shd", probs, v)
+
+
+# ---------------------------------------------------------------------------
+# fused decode v2: per-sequence grid, manual-DMA block loop
+# ---------------------------------------------------------------------------
+
+def _decode_fused_kernel(
+    tbl_ref, ctx_ref, slot_ref,                     # scalar prefetch
+    q_ref, kn_ref, vn_ref, k_any, v_any,            # inputs (caches in HBM)
+    o_ref, ck_any, cv_any,                          # outputs (caches aliased)
+    bufk, bufv, wsem, lsem,                         # scratch
+    *, n_seqs: int, block_size: int, scale: float, n_kv: int, gp: int,
+    window: int,
+):
+    """ONE grid step for the whole decode batch. The KV arenas stay in
+    HBM (memory_space=ANY); per sequence, a fori_loop walks ONLY the
+    live blocks of its table, double-buffering block DMAs. Dead table
+    slots cost nothing, every new token's row is DMA'd straight into its
+    cache slot upfront (2 KB each, vs RMW-ing whole 256 KB blocks
+    through the output pipeline), and each new token's attention
+    contribution enters as one extra online-softmax column from VMEM.
+    Sequences are unrolled; sequence s+1's first block DMA is issued
+    before sequence s computes (buffer sets alternate by sequence
+    parity), so the common short-context case never stalls on DMA.
+    A (S, NB)-grid kernel variant measured 31 us/call at S=8, NB=4 on
+    v5e — sequencing cost per table slot, live or not; this shape costs
+    ~13 us."""
+    bs = block_size
+    D = q_ref.shape[-1]
+
+    def jbase_of(ctx):
+        return (jnp.maximum(ctx - window, 0) // bs) if window > 0 else 0
+
+    def nblk_of(ctx):
+        return pl.cdiv(jnp.maximum(ctx - 1, 0), bs)
+
+    def load(s, bufset, j, buf_slot):
+        blk = tbl_ref[s, j]
+        pltpu.make_async_copy(k_any.at[blk], bufk.at[bufset, buf_slot],
+                              lsem.at[bufset, buf_slot, 0]).start()
+        pltpu.make_async_copy(v_any.at[blk], bufv.at[bufset, buf_slot],
+                              lsem.at[bufset, buf_slot, 1]).start()
+
+    def prefetch_first(s):
+        ctx = ctx_ref[s]
+        jb = jbase_of(ctx)
+
+        @pl.when(jb < nblk_of(ctx))
+        def _():
+            load(s, s % 2, jb, jb % 2)
+
+    prefetch_first(0)
+    for s in range(n_seqs):
+        if s + 1 < n_seqs:
+            prefetch_first(s + 1)
+        ctx = ctx_ref[s]
+        slot = slot_ref[s]
+        L = jnp.maximum(ctx - 1, 0)      # old tokens in the cache
+        bufset = s % 2
+
+        def body(j, carry, s=s, ctx=ctx, L=L, bufset=bufset):
+            ms, ls, accs = carry  # per-head tuples: (Gp,1),(Gp,1),(Gp,D)
+            bslot = j % 2
+
+            @pl.when(j + 1 < nblk_of(ctx))
+            def _prefetch_next():
+                load(s, bufset, j + 1, (j + 1) % 2)
+
+            pltpu.make_async_copy(k_any.at[0], bufk.at[bufset, bslot],
+                                  lsem.at[bufset, bslot, 0]).wait()
+            pltpu.make_async_copy(v_any.at[0], bufv.at[bufset, bslot],
+                                  lsem.at[bufset, bslot, 1]).wait()
+            kb = bufk[bufset, bslot]  # (bs, KV, D)
+            vb = bufv[bufset, bslot]
+            cols = j * bs + jax.lax.broadcasted_iota(jnp.int32, (gp, bs), 1)
+            live = cols < L
+            if window > 0:
+                live = jnp.logical_and(live, cols >= ctx - window)
+            ms2, ls2, accs2 = [], [], []
+            for h in range(n_kv):
+                q = q_ref[s, h]  # (Gp, D)
+                st = _dot(q, kb[:, h, :], trans_b=True) * scale  # (Gp, bs)
+                st = jnp.where(live, st, NEG_INF)
+                m_new = jnp.maximum(ms[h], jnp.max(st, axis=1, keepdims=True))
+                p = jnp.exp(st - m_new)
+                corr = jnp.exp(ms[h] - m_new)
+                ls2.append(ls[h] * corr + jnp.sum(p, axis=1, keepdims=True))
+                accs2.append(accs[h] * corr + _dot(p.astype(vb.dtype),
+                                                   vb[:, h, :]))
+                ms2.append(m_new)
+            return tuple(ms2), tuple(ls2), tuple(accs2)
+
+        init = (
+            tuple(jnp.full((gp, 1), NEG_INF, jnp.float32)
+                  for _ in range(n_kv)),
+            tuple(jnp.zeros((gp, 1), jnp.float32) for _ in range(n_kv)),
+            tuple(jnp.zeros((gp, D), jnp.float32) for _ in range(n_kv)),
+        )
+        ms, ls, accs = jax.lax.fori_loop(jbase_of(ctx), nblk_of(ctx),
+                                         body, init)
+
+        # this sequence's new row -> its cache slot, started only AFTER
+        # its own block loads are consumed: the write may tear bf16
+        # values mid-DMA, and although the row's column is masked out of
+        # the softmax, 0 * NaN from a torn load would still poison the
+        # accumulator. Other sequences' loads never touch this block
+        # (rows are distinct sequences). Waited at kernel end.
+        @pl.when(slot >= 0)
+        def _write_row(s=s, slot=slot):
+            blk = slot // bs
+            off = slot % bs
+            pltpu.make_async_copy(kn_ref.at[s], ck_any.at[blk, off],
+                                  wsem.at[s, 0]).start()
+            pltpu.make_async_copy(vn_ref.at[s], cv_any.at[blk, off],
+                                  wsem.at[s, 1]).start()
+
+        # the new token's own column (kn/vn are VMEM-resident inputs)
+        def newcol(carry, s=s):
+            ms, ls, accs = carry
+            ms2, ls2, accs2 = [], [], []
+            for h in range(n_kv):
+                q = q_ref[s, h]
+                stn = (jnp.sum(q * kn_ref[s, h][None, :], axis=1,
+                               keepdims=True) * scale).astype(jnp.float32)
+                m_new = jnp.maximum(ms[h], stn)
+                p = jnp.exp(stn - m_new)
+                corr = jnp.exp(ms[h] - m_new)
+                ls2.append(ls[h] * corr + p)
+                accs2.append(accs[h] * corr
+                             + p * vn_ref[s, h][None, :].astype(jnp.float32))
+                ms2.append(m_new)
+            return tuple(ms2), tuple(ls2), tuple(accs2)
+
+        ms, ls, accs = jax.lax.cond(slot >= 0, newcol, lambda c: c,
+                                    (ms, ls, accs))
+
+        for h in range(n_kv):
+            l_safe = jnp.where(ls[h] == 0.0, 1.0, ls[h])
+            o_ref[s, h] = (accs[h] / l_safe).astype(o_ref.dtype)
+
+    for s in range(n_seqs):
+        @pl.when(slot_ref[s] >= 0)
+        def _wait_row(s=s):
+            blk = slot_ref[s] // bs
+            off = slot_ref[s] % bs
+            pltpu.make_async_copy(kn_ref.at[s], ck_any.at[blk, off],
+                                  wsem.at[s, 0]).wait()
+            pltpu.make_async_copy(vn_ref.at[s], cv_any.at[blk, off],
+                                  wsem.at[s, 1]).wait()
+
+
+def supports_fused_v2(head_dim: int) -> bool:
+    """The per-sequence-grid kernel's row-write DMA needs lane-aligned
+    (KV, D) slices."""
+    return head_dim % 128 == 0
+
+
+def paged_decode_fused(q, k_cache, v_cache, block_table, ctx_lens,
+                       k_new, v_new, slots, window: int = 0):
+    """Fused single-token decode: write the batch's new KV rows into the
+    paged arenas AND attend over them, one kernel launch. The dense hot
+    path of the serving engine (sparse layouts keep _decode_kernel's
+    bitmap grid).
+
+    Same contract as paged_decode_attention's fused mode: rows are
+    DISTINCT sequences; ctx INCLUDES the new token; slots [S] are the
+    new tokens' flat cache slots (-1 = pad row, nothing written).
+    Returns (out [S, H, D], k_cache, v_cache) with the arenas updated in
+    place (donate them).
+
+    Requires head_dim % 128 == 0: the per-row (KV, D) write DMA must be
+    lane-aligned (D=64 models route to paged_decode_attention's fused
+    mode instead — see supports_fused_v2)."""
+    S, H, D = q.shape
+    NBLK, bs, KV, _ = k_cache.shape
+    G = H // KV
+    Gp = max(G, 8)
+    scale = 1.0 / (D**0.5)
+
+    qg = q.reshape(S, KV, G, D)
+    if Gp != G:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, Gp - G), (0, 0)))
+
+    vmem = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(1,),
+        in_specs=[
+            vmem(), vmem(), vmem(),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            vmem(),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, 2, bs, KV, D), k_cache.dtype),
+            pltpu.VMEM((2, 2, bs, KV, D), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((S, 2)),
+            pltpu.SemaphoreType.DMA((2, 2, 2)),
+        ],
+    )
+    out, ck, cv = pl.pallas_call(
+        functools.partial(
+            _decode_fused_kernel, n_seqs=S, block_size=bs, scale=scale,
+            n_kv=KV, gp=Gp, window=window,
+        ),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((S, KV, Gp, D), q.dtype),
+            jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+            jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+        ],
+        # args: 3 scalar prefetch, q, kn, vn, k_cache, v_cache
+        input_output_aliases={6: 1, 7: 2},
+        interpret=_interpret(),
+    )(block_table, ctx_lens, slots.astype(jnp.int32), qg,
+      k_new, v_new, k_cache, v_cache)
+    return out[:, :, :G, :].reshape(S, H, D), ck, cv
 
 
 # ---------------------------------------------------------------------------

@@ -55,8 +55,11 @@ class HybridEngine:
         # reference alive prevents that reuse in the first place
         params = self.engine.state.params
         if self._served_params is not params:
-            # no copy: the inference engine serves the training arrays
-            # (cast is a no-op when training compute dtype == serve dtype)
+            # refresh_params materializes the SERVING-layout copy of the
+            # weights (per-layer unstacked, fused QKV/gate-up — see
+            # inference/model.prepare): during generation both trees are
+            # resident, the price of the decode-speed layout. Size the
+            # HBM budget for train tree + serve tree at RLHF scale.
             self._infer.refresh_params(params)
             self._served_params = params
 

@@ -32,6 +32,7 @@ def initialize(
     has_aux: bool = False,
     init_rng=None,
     pipelined: bool = False,
+    pipeline_virtual_stages: Optional[int] = None,
 ) -> DeepSpeedTPUEngine:
     """Build a training engine (ref: deepspeed/__init__.py:69 initialize).
 
@@ -63,6 +64,7 @@ def initialize(
         param_init_fn=param_init_fn,
         init_rng=init_rng,
         pipelined=pipelined,
+        pipeline_virtual_stages=pipeline_virtual_stages,
     )
 
 

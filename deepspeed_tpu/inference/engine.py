@@ -332,25 +332,65 @@ class InferenceEngine:
             self._decode_fns[key] = jax.jit(step, donate_argnums=(1,))
         return self._decode_fns[key]
 
-    def decode_multi_fn(self, s: int, n_steps: int):
-        """Compiled fused greedy decode (model.decode_multi) for batch
-        width `s` — the one construction site that applies the engine's
-        dequant wrapper, mirroring _decode_fn."""
-        key = (s, n_steps)
+    def decode_multi_fn(self, s: int, n_steps: int, sampling=None,
+                        with_presence: bool = False):
+        """Compiled fused decode (model.decode_multi) for batch width
+        `s` — the one construction site that applies the engine's
+        dequant wrapper, mirroring _decode_fn. sampling: a
+        sampling.SamplingConfig compiled into the program (None =
+        greedy); with_presence adds the [s, vocab] repetition-penalty
+        bitmap to the carried state."""
+        key = (s, n_steps, None if sampling is None else sampling.key(),
+               with_presence)
         if not hasattr(self, "_decode_multi_fns"):
             self._decode_multi_fns = {}
         if key not in self._decode_multi_fns:
             cfg, use_kernel, deq = self.cfg, self._use_kernel, self._dequant
             mesh = self.mesh
 
-            def step(params, cache, tokens, tables, ctx):
-                return M.decode_multi(
-                    deq(params), cache, tokens, tables, ctx, cfg,
-                    n_steps=n_steps, use_kernel=use_kernel, mesh=mesh,
-                )
+            if sampling is None:
+                def step(params, cache, tokens, tables, ctx):
+                    return M.decode_multi(
+                        deq(params), cache, tokens, tables, ctx, cfg,
+                        n_steps=n_steps, use_kernel=use_kernel, mesh=mesh,
+                    )
+            elif with_presence:
+                def step(params, cache, tokens, tables, ctx, keys, step0,
+                         presence):
+                    return M.decode_multi(
+                        deq(params), cache, tokens, tables, ctx, cfg,
+                        n_steps=n_steps, use_kernel=use_kernel, mesh=mesh,
+                        sampling=sampling, keys=keys, step0=step0,
+                        presence=presence,
+                    )
+            else:
+                def step(params, cache, tokens, tables, ctx, keys, step0):
+                    return M.decode_multi(
+                        deq(params), cache, tokens, tables, ctx, cfg,
+                        n_steps=n_steps, use_kernel=use_kernel, mesh=mesh,
+                        sampling=sampling, keys=keys, step0=step0,
+                    )
 
             self._decode_multi_fns[key] = jax.jit(step, donate_argnums=(1,))
         return self._decode_multi_fns[key]
+
+    def _sample_fn(self, scfg, with_presence: bool):
+        """Compiled sampling epilogue over a [n, V] logits batch (the
+        put()/prefill token-return path)."""
+        from .sampling import sample_tokens
+
+        key = (scfg.key(), with_presence)
+        if not hasattr(self, "_sample_fns"):
+            self._sample_fns = {}
+        if key not in self._sample_fns:
+            if with_presence:
+                fn = lambda lg, keys, steps, pres: sample_tokens(
+                    lg, scfg, keys, steps, presence=pres)
+            else:
+                fn = lambda lg, keys, steps: sample_tokens(
+                    lg, scfg, keys, steps)
+            self._sample_fns[key] = jax.jit(fn)
+        return self._sample_fns[key]
 
     def _dev(self, x):
         """Host array → device, replicated over the serving mesh (so the
@@ -384,15 +424,56 @@ class InferenceEngine:
             need += max(0, -(-(seen + n) // self.state.block_size) - have)
         return need <= self.state.free_blocks
 
+    # -- per-row PRNG streams: key = fold_in(base(seed), uid), draw
+    # -- counter = the sampled token's POSITION (seen_tokens at draw
+    # -- time) — batch composition never affects a sequence's stream
+    def _row_keys(self, seed: int, uids_arr: np.ndarray):
+        if not hasattr(self, "_key_fn"):
+            self._key_fn = jax.jit(
+                lambda base, u: jax.vmap(
+                    jax.random.fold_in, in_axes=(None, 0))(base, u)
+            )
+        return self._key_fn(jax.random.PRNGKey(seed),
+                            jnp.asarray(uids_arr, jnp.uint32))
+
     # -- the engine step (ref: engine_v2.py put:107) ---------------------
     def put(
-        self, uids: Sequence[int], tokens: Sequence[np.ndarray]
-    ) -> np.ndarray:
+        self, uids: Sequence[int], tokens: Sequence[np.ndarray],
+        return_tokens: bool = False,
+        sampling: Optional[Dict[str, Any]] = None,
+        seed: int = 0,
+        presence: Optional[np.ndarray] = None,
+        strict: bool = True,
+        sampling_streams: Optional[Sequence[int]] = None,
+    ) -> Any:
         """Run one engine step over a ragged batch.
 
         New uids carry their whole prompt; known uids carry exactly one
         continuation token. Returns next-token logits [len(uids), vocab]
-        in input order."""
+        in input order — or, with return_tokens=True, SAMPLED token ids
+        [len(uids)] int32: the sampling chain runs on device and only
+        the ids cross to the host (the reference gathers logits /
+        samples device-side too: inference/v2 logits_gather + the MII
+        sampling contract; round 3 shipped [batch, vocab] fp32 per step).
+
+        sampling: SamplingConfig kwargs (do_sample/temperature/top_k/
+        top_p/repetition_penalty); greedy when omitted. seed + stream +
+        position define the draw (deterministic, batch-independent);
+        the stream id defaults to the uid, overridable per input row
+        via sampling_streams (generate() passes its slot indices so a
+        fixed seed reproduces regardless of which uids were free).
+        presence: optional [len(uids), vocab] uint8 seen-token bitmap,
+        required when repetition_penalty != 1 (the engine tracks counts,
+        not token sets — generate() builds it from its own history).
+
+        strict=True (default) raises BEFORE any state mutation when the
+        batch's new prompts don't fit the KV pool (decode rows in the
+        same call are not run either — re-issue after freeing).
+        strict=False instead admits prompts per-uid while capacity
+        lasts (the v2 scheduler's defer-individual-prompts behavior,
+        ref: inference/v2/scheduling_utils.py) and returns
+        (results, rejected_uids); rejected prompts' rows are zeros and
+        their sequences untouched."""
         uids = list(uids)
         tokens = [np.atleast_1d(np.asarray(t, np.int32)) for t in tokens]
         if len(uids) != len(set(uids)):
@@ -430,25 +511,93 @@ class InferenceEngine:
                 f"{self.config.max_batch_size}; split the put()"
             )
 
+        scfg = None
+        if return_tokens:
+            from .sampling import SamplingConfig
+
+            scfg = SamplingConfig(**(sampling or {}))
+            if scfg.needs_presence and presence is None:
+                raise ValueError(
+                    "repetition_penalty needs the seen-token bitmap: pass "
+                    "presence=[len(uids), vocab] uint8 (generate() builds "
+                    "it from its own history)"
+                )
+            tok_out = np.zeros((len(uids),), np.int32)
+            stream_of = {u: (sampling_streams[i]
+                             if sampling_streams is not None else u)
+                         for i, u in enumerate(uids)}
+
+        def sample_rows(logits_all, rows, row_uids, row_steps, row_pos):
+            """Sample the bucketed logits [bucket, V] in place: real
+            rows listed in `rows`; pad rows sample garbage that is
+            never read. Working on the BUCKET keeps one compiled
+            epilogue per bucket width instead of one per exact row
+            count (r4 review finding)."""
+            bucket = logits_all.shape[0]
+            streams = np.zeros((bucket,), np.uint32)
+            steps = np.zeros((bucket,), np.int32)
+            streams[np.asarray(rows)] = [stream_of[u] for u in row_uids]
+            steps[np.asarray(rows)] = row_steps
+            keys = self._row_keys(seed, streams)
+            if presence is not None and scfg.needs_presence:
+                pres = np.zeros((bucket, presence.shape[1]), presence.dtype)
+                pres[np.asarray(rows)] = presence[np.asarray(row_pos)]
+                toks = self._sample_fn(scfg, True)(
+                    logits_all, keys, self._dev(steps), self._dev(pres))
+            else:
+                toks = self._sample_fn(scfg, False)(logits_all, keys,
+                                                    self._dev(steps))
+            tok_out[np.asarray(row_pos)] = np.asarray(toks)[np.asarray(rows)]
+
         out = np.zeros((len(uids), self.cfg.vocab_size), np.float32)
 
+        rejected: List[int] = []
+        if prefills:
+            if not self.can_schedule([u for _, u, _ in prefills],
+                                     [len(t) for _, _, t in prefills]):
+                if strict:
+                    # nothing has been mutated yet (decodes run after) —
+                    # the caller can free sequences and re-issue the put
+                    raise RuntimeError(
+                        "insufficient KV blocks for this prefill wave; "
+                        "free sequences, split the put(), or use "
+                        "strict=False for per-prompt admission"
+                    )
+                # per-prompt admission (ref: the v2 scheduler defers
+                # individual prompts rather than failing the batch):
+                # admit in arrival order while capacity lasts
+                admitted = []
+                for pos, uid, toks in prefills:
+                    if self.can_schedule(
+                        [u for _, u, _ in admitted] + [uid],
+                        [len(t) for _, _, t in admitted] + [len(toks)],
+                    ):
+                        admitted.append((pos, uid, toks))
+                    else:
+                        rejected.append(uid)
+                prefills = admitted
         if prefills:
             # prompts run as compiled WAVES (a solo prompt is a bp=1
             # wave — one code path, one compile cache), bucketed in both
             # tokens (max prompt in the wave) and batch (power of 2) and
             # capped so one put() cannot compile an unbounded (bp, tp)
-            # activation footprint
-            if not self.can_schedule([u for _, u, _ in prefills],
-                                     [len(t) for _, _, t in prefills]):
-                raise RuntimeError(
-                    "insufficient KV blocks for this prefill wave; free "
-                    "sequences or split the put()"
-                )
+            # activation footprint. Waves are GROUPED BY TOKEN BUCKET
+            # (length-sorted): prompts sharing a power-of-two bucket run
+            # together, so one long straggler no longer inflates every
+            # short prompt's padding to its bucket (r3 advisor finding —
+            # the compute cost of a wave is bp * bucket(max member)).
+            prefills.sort(key=lambda pu: len(pu[2]))
+            groups: Dict[int, List[Tuple[int, int, np.ndarray]]] = {}
+            for pu in prefills:
+                groups.setdefault(
+                    _bucket(len(pu[2]), self.config.min_prefill_bucket), []
+                ).append(pu)
             # largest power of two <= max_batch_size, so the bp bucket
             # can never exceed the configured ceiling
             cap = 1 << (self.config.max_batch_size.bit_length() - 1)
-            for w0 in range(0, len(prefills), cap):
-                wave = prefills[w0:w0 + cap]
+            waves = [g[w0:w0 + cap] for _, g in sorted(groups.items())
+                     for w0 in range(0, len(g), cap)]
+            for wave in waves:
                 tp = _bucket(max(len(t) for _, _, t in wave),
                              self.config.min_prefill_bucket)
                 bp = _bucket(len(wave), 1)
@@ -466,10 +615,20 @@ class InferenceEngine:
                     self.params, self.cache, self._dev(toks_b),
                     self._dev(n_real), self._dev(tables),
                 )
-                logits = np.asarray(logits)
                 for row, (pos, uid, toks) in enumerate(wave):
                     self.state.commit(uid, len(toks))
-                    out[pos] = logits[row]
+                if return_tokens:
+                    sample_rows(
+                        logits,
+                        list(range(len(wave))),
+                        [uid for _, uid, _ in wave],
+                        [len(toks) for _, _, toks in wave],
+                        [pos for pos, _, _ in wave],
+                    )
+                else:
+                    logits = np.asarray(logits)
+                    for row, (pos, uid, toks) in enumerate(wave):
+                        out[pos] = logits[row]
 
         if decodes:
             sp = _bucket(n_rows, 8)
@@ -499,11 +658,25 @@ class InferenceEngine:
                 self.params, self.cache, self._dev(toks),
                 self._dev(tables), self._dev(ctx),
             )
-            logits = np.asarray(logits[:n_rows])
             for (pos, uid, chunk), lr in zip(decodes, last_row):
                 self.state.commit(uid, len(chunk))
-                out[pos] = logits[lr]
-        return out
+            if return_tokens:
+                sample_rows(
+                    logits,
+                    last_row,
+                    [uid for _, uid, _ in decodes],
+                    [self.state.get(uid).seen_tokens
+                     for _, uid, _ in decodes],
+                    [pos for pos, _, _ in decodes],
+                )
+            else:
+                logits_np = np.asarray(logits[:n_rows])
+                for (pos, uid, chunk), lr in zip(decodes, last_row):
+                    out[pos] = logits_np[lr]
+        result = tok_out if return_tokens else out
+        if not strict:
+            return result, rejected
+        return result
 
     def flush(self, uid: int) -> None:
         """Free a sequence's KV blocks (ref: engine_v2.py flush:242)."""
@@ -563,17 +736,35 @@ class InferenceEngine:
         top_p: float = 1.0,
         repetition_penalty: float = 1.0,
         seed: Optional[int] = None,
+        chunk: int = 8,
     ) -> List[List[int]]:
         """Continuous-batch generation; returns new tokens per prompt
-        (ref: inference/engine.py generate:613 — here generation drives
-        put() exactly as the MII serving loop drives FastGen).
+        (ref: inference/engine.py generate:613).
 
-        do_sample=False is greedy argmax (v1 default). Sampling applies
-        temperature/top-k/top-p/repetition-penalty host-side over the
-        returned logits, with an independent per-sequence stream seeded
-        from `seed` so a batch draw is reproducible regardless of batch
-        composition. uids are allocated disjoint from in-flight sequences
-        so calling generate() never hijacks another caller's context."""
+        Rides FUSED multi-step decode: after the prefill, tokens are
+        produced in compiled chunks of `chunk` steps — sampling
+        (temperature/top-k/top-p/repetition-penalty, gumbel-max draw)
+        runs INSIDE the decode program with per-sequence PRNG streams
+        (key = fold_in(seed, uid), counter = token position), so the
+        host sees only [chunk, batch] token ids per dispatch — never
+        [batch, vocab] logits (round 3's per-step serving tax). The
+        draw for a given (seed, uid, position) is independent of batch
+        composition; a fixed seed reproduces the sequence exactly
+        (tests/test_sampling.py replays it with a host oracle).
+
+        top-p nucleus mass is computed over the top-256 candidates
+        (sampling.SamplingConfig.cand_width) — exact whenever the
+        nucleus fits, which at serving temperatures it does.
+
+        uids are allocated disjoint from in-flight sequences so calling
+        generate() never hijacks another caller's context."""
+        from .sampling import SamplingConfig, presence_from_prompts
+
+        scfg = SamplingConfig(
+            do_sample=do_sample, temperature=temperature, top_k=top_k,
+            top_p=top_p, repetition_penalty=repetition_penalty)
+        seed_val = (int(np.random.default_rng().integers(2**31))
+                    if seed is None else int(seed))
         taken = set(self.state.tracked_uids)
         uids, cand = [], 0
         while len(uids) < len(prompts):
@@ -582,43 +773,117 @@ class InferenceEngine:
             cand += 1
         slot_of = {u: i for i, u in enumerate(uids)}
         outs: List[List[int]] = [[] for _ in prompts]
-        seen = {u: list(prompts[slot_of[u]]) for u in uids}
-        rngs = {
-            u: np.random.default_rng(None if seed is None else seed + i)
-            for i, u in enumerate(uids)
-        }
+        V = self.cfg.vocab_size
+        pres = (presence_from_prompts(prompts, V, len(prompts))
+                if scfg.needs_presence else None)
+        skw = dict(do_sample=do_sample, temperature=temperature,
+                   top_k=top_k, top_p=top_p,
+                   repetition_penalty=repetition_penalty)
 
-        def pick(u: int, row: np.ndarray) -> int:
-            if not do_sample:
-                return int(np.argmax(row))
-            return self.sample_token(
-                row, temperature=temperature, top_k=top_k, top_p=top_p,
-                repetition_penalty=repetition_penalty,
-                seen_tokens=seen[u], rng=rngs[u],
+        # prefill + first token (sampled on device). Streams key by SLOT
+        # index, not uid: uid allocation depends on what else is in
+        # flight, and a fixed seed must reproduce regardless (r4 review
+        # finding).
+        first = self.put(uids, [np.asarray(p, np.int32) for p in prompts],
+                         return_tokens=True, sampling=skw, seed=seed_val,
+                         presence=pres,
+                         sampling_streams=list(range(len(uids))))
+        pending = {u: int(first[slot_of[u]]) for u in uids}
+        live = list(uids)
+
+        def accept(u: int, tok: int) -> bool:
+            """Append tok; False once the sequence is finished."""
+            sl = slot_of[u]
+            outs[sl].append(tok)
+            if pres is not None:
+                pres[sl, tok] = 1
+            return not (
+                (eos_token_id is not None and tok == eos_token_id)
+                or len(outs[sl]) >= max_new_tokens
             )
 
-        live = set(uids)
-        logits = self.put(uids, [np.asarray(p, np.int32) for p in prompts])
-        nxt = {u: pick(u, logits[i]) for i, u in enumerate(uids)}
-        while True:
-            batch_uids = sorted(live)
-            if not batch_uids:
+        while live:
+            live = [u for u in live if accept(u, pending[u])]
+            live = [u for u in live
+                    if self.state.get(u).seen_tokens + 1
+                    < self.config.max_seq_len]
+            if not live:
                 break
-            for u in batch_uids:
-                outs[slot_of[u]].append(nxt[u])
-                seen[u].append(nxt[u])
-            done = {
-                u for u in batch_uids
-                if (eos_token_id is not None and nxt[u] == eos_token_id)
-                or len(outs[slot_of[u]]) >= max_new_tokens
-                or self.state.get(u).seen_tokens + 1 >= self.config.max_seq_len
-            }
-            live -= done
-            batch_uids = sorted(live)
-            if not batch_uids:
+            # chunk size: bounded by every live sequence's remaining
+            # budget (output count and context capacity) so one compiled
+            # program serves the whole batch
+            C = min(
+                chunk,
+                min(max_new_tokens - len(outs[slot_of[u]]) for u in live),
+                min(self.config.max_seq_len - 1
+                    - self.state.get(u).seen_tokens for u in live),
+            )
+            if C <= 0:
                 break
-            logits = self.put(batch_uids, [np.asarray([nxt[u]]) for u in batch_uids])
-            nxt = {u: pick(u, logits[i]) for i, u in enumerate(batch_uids)}
+            if len(live) > self.config.max_batch_size:
+                raise RuntimeError(
+                    f"{len(live)} sequences > max_batch_size "
+                    f"{self.config.max_batch_size}"
+                )
+            if not self.can_schedule(live, [C + 1] * len(live)):
+                raise RuntimeError(
+                    "insufficient KV blocks to continue generation; "
+                    "raise num_kv_blocks or lower max_new_tokens"
+                )
+            width = _bucket(len(live), 8)
+            toks = np.zeros((width,), np.int32)
+            ctx = np.zeros((width,), np.int32)
+            steps = np.zeros((width,), np.int32)
+            row_streams = np.zeros((width,), np.uint32)
+            tables = np.full((width, self.config.blocks_per_seq),
+                             self.pad_block, np.int32)
+            pres_rows = (np.zeros((width, V), np.uint8)
+                         if pres is not None else None)
+            for r, u in enumerate(live):
+                seq = self.state.get(u)
+                base = seq.seen_tokens
+                self.state.extend(u, C)
+                toks[r] = pending[u]
+                ctx[r] = base + 1
+                steps[r] = base + 1  # first in-chunk draw's position
+                row_streams[r] = slot_of[u]
+                tables[r] = self.state.block_table(
+                    [u], self.config.blocks_per_seq, self.pad_block)[0]
+                if pres_rows is not None:
+                    pres_rows[r] = pres[slot_of[u]]
+            use_sampler = not (scfg.greedy and not scfg.needs_presence)
+            fn = self.decode_multi_fn(
+                width, C,
+                sampling=scfg if use_sampler else None,
+                with_presence=pres_rows is not None and use_sampler,
+            )
+            args = [self.params, self.cache, self._dev(toks),
+                    self._dev(tables), self._dev(ctx)]
+            if use_sampler:
+                args.append(self._row_keys(seed_val, row_streams))
+                args.append(self._dev(steps))
+                if pres_rows is not None:
+                    args.append(self._dev(pres_rows))
+            gen, _, self.cache, _ = fn(*args)
+            gen = np.asarray(gen)  # [C, width] — the only host transfer
+            for r, u in enumerate(live):
+                self.state.commit(u, C)
+            cont = []
+            for r, u in enumerate(live):
+                ok = True
+                for j in range(C - 1):
+                    if ok:
+                        ok = accept(u, int(gen[j, r]))
+                pending[u] = int(gen[C - 1, r])
+                if ok:
+                    cont.append(u)
+                # a sequence that finished mid-chunk wrote a few extra
+                # tokens into its own blocks — freed at flush below.
+                # Capacity is NOT re-filtered here: the loop top accepts
+                # each pending token first, then filters — dropping a
+                # capped sequence before that accept would eat its final
+                # sampled token (r4 review finding)
+            live = cont
         for u in uids:
             if self.state.get(u) is not None:
                 self.flush(u)

@@ -91,7 +91,7 @@ def prepare(params: Dict[str, Any], cfg: T.TransformerConfig,
             if "bq" in lp:
                 lp["b_qkv"] = jnp.concatenate(
                     [lp.pop("bq"), lp.pop("bk"), lp.pop("bv")], axis=0)
-            if cfg.n_experts == 0 and "w_gate" in lp:
+            if cfg.n_experts == 0 and cfg.is_gated and "w_gate" in lp:
                 lp["w_gi"] = jnp.concatenate(
                     [lp.pop("w_gate"), lp.pop("w_in")], axis=1)
         layers.append(lp)
@@ -193,9 +193,13 @@ def _lm_logits(x, params, cfg: T.TransformerConfig):
     head = params["lm_head"]
     if isinstance(head, ChannelQuantWeight):
         y = jnp.einsum("...e,ev->...v", x, head.q.astype(x.dtype))
-        return y.astype(jnp.float32) * head.scale
-    return jnp.einsum("...e,ev->...v", x, head.astype(x.dtype)
-                      ).astype(jnp.float32)
+        y = y.astype(jnp.float32) * head.scale
+    else:
+        y = jnp.einsum("...e,ev->...v", x, head.astype(x.dtype)
+                       ).astype(jnp.float32)
+    if "lm_head_b" in params:
+        y = y + params["lm_head_b"].astype(jnp.float32)
+    return y
 
 
 # ---------------------------------------------------------------------------
@@ -290,13 +294,17 @@ def _rope_at(x, positions, cfg: T.TransformerConfig):
     """Rotary embedding at per-token positions [T] (decode needs a
     different position per row, unlike training's contiguous offset).
     Frequencies come from T.rope_inv_freq so long-context scaling
-    (linear / llama3) matches the training forward exactly."""
+    (linear / llama3) and partial rotary (Phi) match the training
+    forward exactly."""
     freqs = T.rope_inv_freq(cfg)
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [T, D/2]
+    R = T.rope_dim(cfg)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [T, R/2]
     cos, sin = jnp.cos(angles), jnp.sin(angles)
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)  # [T, H, D/2]
+    xr, xp = x[..., :R], x[..., R:]
+    x1, x2 = jnp.split(xr.astype(jnp.float32), 2, axis=-1)  # [T, H, R/2]
     c, s = cos[:, None, :], sin[:, None, :]
-    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return jnp.concatenate([out.astype(x.dtype), xp], axis=-1)
 
 
 def _flat_slot_index(positions, block_table, block_size):
@@ -431,21 +439,23 @@ def _mlp(h, lp, cfg: T.TransformerConfig):
     per-expert combine column — X-times the dense FFN FLOPs, no [T,X,C]
     dispatch tensor. Fine for decode widths; a gathered-GEMM path is the
     optimization lever for huge prefills."""
+    act = T._act_fn(cfg)  # one dispatch table for train + serve
     if cfg.n_experts == 0:
-        if cfg.variant == "llama":
+        if cfg.is_gated:
             if "w_gi" in lp:
                 gi = _wmm("te,ef->tf", h, lp["w_gi"])
                 F = gi.shape[-1] // 2
-                inner = jax.nn.silu(gi[:, :F]) * gi[:, F:]
+                inner = act(gi[:, :F]) * gi[:, F:]
             else:
-                inner = jax.nn.silu(_wmm("te,ef->tf", h, lp["w_gate"])) \
+                inner = act(_wmm("te,ef->tf", h, lp["w_gate"])) \
                     * _wmm("te,ef->tf", h, lp["w_in"])
         else:
-            inner = jax.nn.gelu(
-                _wmm("te,ef->tf", h, lp["w_in"]) + lp["b_in"].astype(h.dtype)
-            )
+            inner = _wmm("te,ef->tf", h, lp["w_in"])
+            if "b_in" in lp:
+                inner = inner + lp["b_in"].astype(h.dtype)
+            inner = act(inner)
         out = _wmm("tf,fe->te", inner, lp["w_out"])
-        if cfg.variant == "gpt2":
+        if "b_out" in lp:
             out = out + lp["b_out"].astype(h.dtype)
         return out
 
@@ -466,24 +476,30 @@ def _mlp(h, lp, cfg: T.TransformerConfig):
         weights = (onehot1 * (g1 / denom)[:, None]
                    + onehot2 * (g2 / denom)[:, None])
 
-    has_gate = cfg.variant == "llama"
+    has_gate = cfg.is_gated
+    has_bias = "b_in" in lp
     xs = [lp["w_in"], lp["w_out"], weights.T.astype(h.dtype)]
     if has_gate:
         xs.append(lp["w_gate"])
-    if cfg.variant == "gpt2":
+    if has_bias:
         xs += [lp["b_in"], lp["b_out"]]
 
     def expert(acc, ws):
-        if cfg.variant == "llama":
-            w_in, w_out, wcol, w_gate = ws
-            inner = jax.nn.silu(h @ w_gate.astype(h.dtype)) * (
+        if has_gate:
+            w_in, w_out, wcol, w_gate = ws[:4]
+            inner = act(h @ w_gate.astype(h.dtype)) * (
                 h @ w_in.astype(h.dtype)
             )
             y = inner @ w_out.astype(h.dtype)
         else:
-            w_in, w_out, wcol, b_in, b_out = ws
-            inner = jax.nn.gelu(h @ w_in.astype(h.dtype) + b_in.astype(h.dtype))
-            y = inner @ w_out.astype(h.dtype) + b_out.astype(h.dtype)
+            w_in, w_out, wcol = ws[:3]
+            b_in, b_out = ws[3:] if has_bias else (None, None)
+            inner = h @ w_in.astype(h.dtype)
+            if b_in is not None:
+                inner = inner + b_in.astype(h.dtype)
+            y = act(inner) @ w_out.astype(h.dtype)
+            if b_out is not None:
+                y = y + b_out.astype(h.dtype)
         return acc + wcol[:, None] * y, None
 
     out, _ = jax.lax.scan(expert, jnp.zeros_like(h), tuple(xs))
@@ -605,21 +621,21 @@ def decode_step(
 
     new_k, new_v = [], []
     for lp in params["layers"]:
-        h = T._act_quant(T._norm(x, lp["ln1_scale"], lp.get("ln1_bias"), cfg), cfg)
+        h1 = T._act_quant(T._norm(x, lp["ln1_scale"], lp.get("ln1_bias"), cfg), cfg)
         if "w_qkv" in lp:
-            qkv = _wmm("se,ehd->shd", h, lp["w_qkv"])
+            qkv = _wmm("se,ehd->shd", h1, lp["w_qkv"])
             if "b_qkv" in lp:
                 qkv = qkv + lp["b_qkv"].astype(x.dtype)
             q, k, v = jnp.split(qkv, [H, H + KV], axis=1)
         else:
-            q = _wmm("se,ehd->shd", h, lp["wq"])
-            k = _wmm("se,ehd->shd", h, lp["wk"])
-            v = _wmm("se,ehd->shd", h, lp["wv"])
+            q = _wmm("se,ehd->shd", h1, lp["wq"])
+            k = _wmm("se,ehd->shd", h1, lp["wk"])
+            v = _wmm("se,ehd->shd", h1, lp["wv"])
             if "bq" in lp:
                 q = q + lp["bq"].astype(x.dtype)
                 k = k + lp["bk"].astype(x.dtype)
                 v = v + lp["bv"].astype(x.dtype)
-        if cfg.variant != "gpt2":
+        if cfg.use_rope:
             q = _rope_at(q, positions, cfg)
             k = _rope_at(k, positions, cfg)
         q = _cons(q, mesh, None, "model", None)
@@ -644,12 +660,18 @@ def decode_step(
         new_k.append(ck)
         new_v.append(cv)
         out = _wmm("shd,hde->se", att, lp["wo"])
-        if cfg.variant == "gpt2":
+        if "bo" in lp:
             out = out + lp["bo"].astype(x.dtype)
-        x = x + out
 
-        h = T._act_quant(T._norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg), cfg)
-        x = x + _mlp(h, lp, cfg)
+        if cfg.parallel_residual:
+            h2 = h1 if cfg.shared_ln else T._act_quant(
+                T._norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg), cfg)
+            x = x + out + _mlp(h2, lp, cfg)
+        else:
+            x = x + out
+            h2 = T._act_quant(
+                T._norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg), cfg)
+            x = x + _mlp(h2, lp, cfg)
 
     x = T._norm(x, params["ln_f_scale"], params.get("ln_f_bias"), cfg)
     logits = _lm_logits(x, params, cfg)
@@ -661,10 +683,11 @@ def decode_multi(
     params, cache: PagedCache, tokens, tables, ctx_lens,
     cfg: T.TransformerConfig, n_steps: int, use_kernel: bool = True,
     mesh: Optional[Mesh] = None, unique_rows: bool = True,
+    sampling=None, keys=None, step0=None, presence=None,
 ):
-    """Fused greedy decode: n_steps tokens per compiled program.
+    """Fused decode: n_steps tokens per compiled program.
 
-    One `lax.scan` over decode_step with the argmax fed back — the
+    One `lax.scan` over decode_step with the next token fed back — the
     host dispatches once per n_steps instead of per token, amortizing
     dispatch/scheduling latency (the SplitFuse-era "fixed work per
     forward" idea applied along time). Block tables must already cover
@@ -672,29 +695,47 @@ def decode_multi(
     sequences (each advances its own context), so the fused
     write+attend kernel applies (see decode_step unique_rows).
 
-    Returns (generated [n_steps, S] int32, final logits [S, V], cache).
+    sampling: optional sampling.SamplingConfig — the full on-device
+    chain (penalty/temperature/top-k/top-p + gumbel-max draw); None =
+    greedy argmax. keys [S] per-sequence PRNG keys and step0 [S] int32
+    draw counters feed the per-(sequence, step) streams; presence
+    [S, V] uint8 rides the carry for the repetition penalty (pass only
+    when the config needs it — it is 2 MB at batch 64).
+
+    Returns (generated [n_steps, S] int32, final logits [S, V], cache,
+    final presence or None).
     """
+    from .sampling import sample_tokens, update_presence
 
     S = tokens.shape[0]
     V = cfg.vocab_size
     if not is_prepared(params):
         params = prepare(params, cfg, fuse=mesh is None)
+    with_presence = presence is not None
 
-    def body(carry, _):
-        toks, ctx, _, cache = carry
+    def body(carry, i):
+        toks, ctx, _, cache, pres = carry
         logits, cache = decode_step(params, cache, toks, tables, ctx, cfg,
                                     use_kernel, mesh=mesh,
                                     unique_rows=unique_rows)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if sampling is None:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            nxt = sample_tokens(logits, sampling, keys,
+                                None if step0 is None else step0 + i,
+                                presence=pres)
+        if with_presence:
+            pres = update_presence(pres, nxt)
         # logits ride the CARRY (overwritten per step): stacking them in ys
         # would keep a dead [n_steps, S, V] accumulator live in HBM
-        return (nxt, ctx + 1, logits, cache), nxt
+        return (nxt, ctx + 1, logits, cache, pres), nxt
 
-    init = (tokens, ctx_lens, jnp.zeros((S, V), jnp.float32), cache)
-    (_, _, last_logits, cache), gen = jax.lax.scan(
-        body, init, None, length=n_steps
+    init = (tokens, ctx_lens, jnp.zeros((S, V), jnp.float32), cache,
+            presence)
+    (_, _, last_logits, cache, presence), gen = jax.lax.scan(
+        body, init, jnp.arange(n_steps, dtype=jnp.int32)
     )
-    return gen, last_logits, cache
+    return gen, last_logits, cache, presence
 
 
 # ---------------------------------------------------------------------------
@@ -759,21 +800,21 @@ def prefill_batch(
 
     new_k, new_v = [], []
     for lp in params["layers"]:
-        h = T._act_quant(T._norm(x, lp["ln1_scale"], lp.get("ln1_bias"), cfg), cfg)
+        h1 = T._act_quant(T._norm(x, lp["ln1_scale"], lp.get("ln1_bias"), cfg), cfg)
         if "w_qkv" in lp:
-            qkv = _wmm("bse,ehd->bshd", h, lp["w_qkv"])
+            qkv = _wmm("bse,ehd->bshd", h1, lp["w_qkv"])
             if "b_qkv" in lp:
                 qkv = qkv + lp["b_qkv"].astype(x.dtype)
             q, k, v = jnp.split(qkv, [H, H + KV], axis=2)
         else:
-            q = _wmm("bse,ehd->bshd", h, lp["wq"])
-            k = _wmm("bse,ehd->bshd", h, lp["wk"])
-            v = _wmm("bse,ehd->bshd", h, lp["wv"])
+            q = _wmm("bse,ehd->bshd", h1, lp["wq"])
+            k = _wmm("bse,ehd->bshd", h1, lp["wk"])
+            v = _wmm("bse,ehd->bshd", h1, lp["wv"])
             if "bq" in lp:
                 q = q + lp["bq"].astype(x.dtype)
                 k = k + lp["bk"].astype(x.dtype)
                 v = v + lp["bv"].astype(x.dtype)
-        if cfg.variant != "gpt2":
+        if cfg.use_rope:
             rot = jax.vmap(_rope_at, in_axes=(0, None, None))
             q = rot(q, positions, cfg)
             k = rot(k, positions, cfg)
@@ -820,13 +861,20 @@ def prefill_batch(
                 use_flash=use_kernel and cfg.use_flash and _tp_size(mesh) <= 1,
                 window=cfg.sliding_window)
         out = _wmm("bshd,hde->bse", att, lp["wo"])
-        if cfg.variant == "gpt2":
+        if "bo" in lp:
             out = out + lp["bo"].astype(x.dtype)
-        x = x + out
 
-        h = T._act_quant(T._norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg), cfg)
         E = x.shape[-1]
-        x = x + _mlp(h.reshape(B * Tp, E), lp, cfg).reshape(B, Tp, E)
+        if cfg.parallel_residual:
+            h2 = h1 if cfg.shared_ln else T._act_quant(
+                T._norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg), cfg)
+            x = x + out + _mlp(h2.reshape(B * Tp, E), lp,
+                               cfg).reshape(B, Tp, E)
+        else:
+            x = x + out
+            h2 = T._act_quant(
+                T._norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg), cfg)
+            x = x + _mlp(h2.reshape(B * Tp, E), lp, cfg).reshape(B, Tp, E)
 
     # logits for each prompt's last REAL token only (logits_gather):
     # gather before the vocab matmul so the head runs on B tokens, not B*Tp

@@ -1,0 +1,181 @@
+"""On-device token sampling for the serving engine.
+
+TPU-native redesign of the reference's sampling story: FastGen gathers
+last-token logits on device (ref: inference/v2/kernels/ragged_ops/
+logits_gather/) and MII applies the HF LogitsProcessor chain GPU-side;
+the v1 engine inherits HF `generate` sampling (ref:
+inference/engine.py:613). Here the whole chain — repetition penalty,
+temperature, top-k, top-p, and the categorical draw — runs INSIDE the
+compiled decode program, so a decode step returns token ids ([S] int32)
+instead of shipping [S, vocab] fp32 logits to the host (8-13 MB/step at
+batch 64 — round 3's structural serving-latency tax).
+
+Design notes (XLA-first):
+- the categorical draw is GUMBEL-MAX: argmax(logits/T + G),
+  G = -log(-log(U)). Exact for categoricals, needs no cumsum/sort, and
+  is replayable: the same threefry key on any backend yields the same
+  U, so a host oracle given the same logits and key reproduces the
+  token bit-exactly (tested in tests/test_sampling.py).
+- top-p needs sorted cumulative mass; sorting 32k logits per step is
+  VPU-hostile, so the CANDIDATES come from lax.top_k (width
+  cand_width, default 256) while their masses come from the full
+  softmax (or, after top-k, the k survivors — the HF processor-chain
+  order). Exact whenever the nucleus fits in the candidate width; the
+  host oracle applies the same truncation. The reference's sampler
+  post-processes on full vocab — document the difference, don't hide
+  it.
+- repetition penalty needs the seen-token set; a [S, vocab] presence
+  bitmap rides the decode scan and is updated with max(presence,
+  one_hot(token)) — no scatter (XLA scatter carries a fixed multi-ms
+  cost on TPU, docs/PROFILE_r02.md).
+- per-sequence PRNG streams: key_i = fold_in(base, slot_i), step t uses
+  fold_in(key_i, t) — batch composition never changes a sequence's
+  stream (the host sampler had the same property via per-uid
+  np.random.Generator).
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingConfig:
+    """STATIC sampling knobs (compiled into the decode program; the
+    engine caches one program per distinct config). Scalar knobs that
+    could be traced (temperature, top_p, penalty) are still static
+    here: serving configs change rarely and static values let XLA fold
+    the filter chain."""
+
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    repetition_penalty: float = 1.0
+    cand_width: int = 256  # top-p candidate pool (exactness bound)
+
+    @property
+    def greedy(self) -> bool:
+        return (not self.do_sample) or self.temperature <= 0.0
+
+    @property
+    def needs_presence(self) -> bool:
+        return self.repetition_penalty != 1.0
+
+    def key(self):
+        return dataclasses.astuple(self)
+
+
+def apply_penalty_and_filters(logits, cfg: SamplingConfig,
+                              presence: Optional[Any] = None):
+    """[S, V] f32 logits -> filtered logits (still [S, V]; filtered-out
+    entries at -inf). CTRL repetition-penalty rule (divide positive
+    seen logits, multiply negative — ref HF RepetitionPenaltyLogitsProcessor,
+    which the reference engine inherits), then temperature, then top-k,
+    then top-p."""
+    logits = logits.astype(jnp.float32)
+    if cfg.needs_presence and presence is not None:
+        seen = presence.astype(jnp.bool_)
+        pen = jnp.float32(cfg.repetition_penalty)
+        logits = jnp.where(
+            seen, jnp.where(logits > 0, logits / pen, logits * pen), logits)
+    if cfg.greedy:
+        return logits
+    logits = logits / jnp.float32(max(cfg.temperature, 1e-6))
+    V = logits.shape[-1]
+    k_eff = 0
+    if cfg.top_k and 0 < cfg.top_k < V:
+        k_eff = cfg.top_k
+    need_pool = k_eff or (0.0 < cfg.top_p < 1.0)
+    if need_pool:
+        width = min(V, max(k_eff or 1, cfg.cand_width
+                           if 0.0 < cfg.top_p < 1.0 else (k_eff or 1)))
+        vals = jax.lax.top_k(logits, width)[0]  # [S, width] descending
+        if k_eff:
+            kth = vals[:, k_eff - 1][:, None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if 0.0 < cfg.top_p < 1.0:
+            # HF chain order: TopP sees the TOP-K-FILTERED distribution
+            # (renormalized over the k survivors); without top-k, masses
+            # come from the FULL softmax (exp(v - lse(all logits))), not
+            # a pool-renormalized one — pool renormalization would
+            # inflate every cumulative mass by 1/pool_mass and push the
+            # nucleus cutoff too deep (r4 review finding).
+            if k_eff:
+                pool = vals[:, :k_eff]
+                lse = jax.scipy.special.logsumexp(pool, axis=-1,
+                                                  keepdims=True)
+            else:
+                pool = vals
+                lse = jax.scipy.special.logsumexp(logits, axis=-1,
+                                                  keepdims=True)
+            probs = jnp.exp(pool - lse)  # true masses, descending order
+            csum = jnp.cumsum(probs, axis=-1)
+            # keep the smallest prefix reaching top_p (always the top-1)
+            keep = (csum - probs) < jnp.float32(cfg.top_p)
+            thr = jnp.min(jnp.where(keep, pool, jnp.inf), axis=-1)[:, None]
+            logits = jnp.where(logits < thr, -jnp.inf, logits)
+    return logits
+
+
+def sample_tokens(logits, cfg: SamplingConfig, keys=None, step=None,
+                  presence: Optional[Any] = None):
+    """[S, V] logits -> [S] int32 tokens.
+
+    keys: [S] per-sequence PRNG keys (jax.random key array); step: [S]
+    int32 per-sequence draw counters (folded into the key so fused
+    multi-step decode advances each stream exactly like stepwise)."""
+    filtered = apply_penalty_and_filters(logits, cfg, presence)
+    if cfg.greedy:
+        return jnp.argmax(filtered, axis=-1).astype(jnp.int32)
+
+    def draw(key, t, row):
+        u = jax.random.uniform(
+            jax.random.fold_in(key, t), row.shape,
+            minval=jnp.float32(1e-20), maxval=1.0)
+        g = -jnp.log(-jnp.log(u))
+        return jnp.argmax(row + g).astype(jnp.int32)
+
+    return jax.vmap(draw)(keys, step, filtered)
+
+
+def update_presence(presence, tokens):
+    """presence [S, V] uint8 | tokens [S] -> updated presence (one_hot
+    max, not scatter)."""
+    oh = jax.nn.one_hot(tokens, presence.shape[-1], dtype=presence.dtype)
+    return jnp.maximum(presence, oh)
+
+
+def presence_from_prompts(prompts, vocab: int, width: int):
+    """Host-side initial presence for `width` slots from python/numpy
+    token lists (rows beyond len(prompts) stay empty)."""
+    import numpy as np
+
+    out = np.zeros((width, vocab), np.uint8)
+    for i, p in enumerate(prompts):
+        toks = np.asarray(p, np.int64).ravel()
+        toks = toks[(toks >= 0) & (toks < vocab)]
+        out[i, toks] = 1
+    return out
+
+
+def host_oracle_token(logits, cfg: SamplingConfig, key, t,
+                      presence_row=None) -> int:
+    """Replay one draw host-side (numpy logits + the same key/step):
+    must reproduce sample_tokens bit-exactly — the parity contract the
+    tests pin down."""
+    import numpy as np
+
+    row = jnp.asarray(np.asarray(logits, np.float32))[None]
+    pres = (jnp.asarray(np.asarray(presence_row, np.uint8))[None]
+            if presence_row is not None else None)
+    filtered = apply_penalty_and_filters(row, cfg, pres)
+    if cfg.greedy:
+        return int(jnp.argmax(filtered[0]))
+    u = jax.random.uniform(jax.random.fold_in(key, t), filtered[0].shape,
+                           minval=jnp.float32(1e-20), maxval=1.0)
+    g = -jnp.log(-jnp.log(u))
+    return int(jnp.argmax(filtered[0] + g))

@@ -115,6 +115,24 @@ class TransformerConfig:
     # Explicit head dim for families where head_dim != d_model / n_heads
     # (Mistral-Nemo / Gemma-class); None derives it.
     head_dim_override: Optional[int] = None
+    # ---- model-family knobs (serving-zoo breadth: Falcon / OPT / Phi /
+    # Qwen — ref: inference/v2/model_implementations/{falcon,opt,phi,
+    # qwen,qwen_v2}/model.py; each family is a small delta on the ONE
+    # functional family here, not a separate module zoo). The `variant`
+    # stays the base preset: "llama" = rotary family, "gpt2" =
+    # learned-positions family; None knobs inherit the preset.
+    qkv_bias: Optional[bool] = None       # Qwen/Qwen2/Phi: q/k/v biases
+    attn_out_bias: Optional[bool] = None  # bo (OPT/Phi yes, Qwen no)
+    mlp_bias: Optional[bool] = None       # b_in/b_out
+    activation: Optional[str] = None      # silu | gelu | relu (OPT)
+    norm_type: Optional[str] = None       # rms | layer (Falcon/Phi: layer)
+    gated_mlp: Optional[bool] = None      # SwiGLU pair vs single w_in
+    # Falcon/Phi parallel form: x + attn(ln1 x) + mlp(ln2 x); shared_ln
+    # feeds BOTH branches from ln1 (Falcon-7B / Phi) and drops ln2.
+    parallel_residual: bool = False
+    shared_ln: bool = False
+    rotary_pct: float = 1.0               # Phi partial rotary
+    lm_head_bias: bool = False            # Phi-2
 
     def __post_init__(self):
         if self.rope_scaling_type not in ("none", "linear", "llama3"):
@@ -143,6 +161,64 @@ class TransformerConfig:
             )
         if self.variant not in ("llama", "gpt2"):
             raise ValueError(f"unknown variant '{self.variant}'")
+        if self.activation not in (None, "silu", "gelu", "gelu_exact",
+                                   "relu"):
+            # "gelu" is the tanh approximation (HF gelu_new — GPT-2/Phi);
+            # "gelu_exact" is erf GELU (Falcon's nn.GELU())
+            raise ValueError(f"unknown activation '{self.activation}'")
+        if self.norm_type not in (None, "rms", "layer"):
+            raise ValueError(f"unknown norm_type '{self.norm_type}'")
+        if self.shared_ln and not self.parallel_residual:
+            raise ValueError("shared_ln requires parallel_residual")
+        if not (0.0 < self.rotary_pct <= 1.0):
+            raise ValueError("rotary_pct must be in (0, 1]")
+        if self.rotary_pct < 1.0 and self.variant == "gpt2":
+            raise ValueError("rotary_pct applies to the rotary family")
+        if self.lm_head_bias and self.tie_embeddings:
+            raise ValueError("lm_head_bias requires an untied lm_head")
+
+    # -- family-knob resolution (None -> variant preset) ---------------
+    @property
+    def use_rope(self) -> bool:
+        return self.variant != "gpt2"
+
+    @property
+    def norm_kind(self) -> str:
+        return self.norm_type or ("rms" if self.variant == "llama"
+                                  else "layer")
+
+    @property
+    def norm_has_bias(self) -> bool:
+        return self.norm_kind == "layer"
+
+    @property
+    def act_name(self) -> str:
+        return self.activation or ("silu" if self.variant == "llama"
+                                   else "gelu")
+
+    @property
+    def is_gated(self) -> bool:
+        if self.gated_mlp is not None:
+            return self.gated_mlp
+        return self.variant == "llama"
+
+    @property
+    def has_qkv_bias(self) -> bool:
+        if self.qkv_bias is not None:
+            return self.qkv_bias
+        return self.variant == "gpt2"
+
+    @property
+    def has_attn_out_bias(self) -> bool:
+        if self.attn_out_bias is not None:
+            return self.attn_out_bias
+        return self.variant == "gpt2"
+
+    @property
+    def has_mlp_bias(self) -> bool:
+        if self.mlp_bias is not None:
+            return self.mlp_bias
+        return self.variant == "gpt2"
 
     @property
     def kv_heads(self) -> int:
@@ -159,7 +235,7 @@ class TransformerConfig:
     def ff_dim(self) -> int:
         if self.d_ff is not None:
             return self.d_ff
-        if self.variant == "llama":
+        if self.is_gated:
             d = int(self.d_model * 8 / 3)
             return ((d + 127) // 128) * 128
         return 4 * self.d_model
@@ -207,12 +283,13 @@ def _layer_shapes(cfg: TransformerConfig) -> Dict[str, Tuple[Tuple[int, ...], Tu
     E, H, KV, D, F = cfg.d_model, cfg.n_heads, cfg.kv_heads, cfg.head_dim, cfg.ff_dim
     shapes = {
         "ln1_scale": ((E,), ("embed",)),
-        "ln2_scale": ((E,), ("embed",)),
         "wq": ((E, H, D), ("embed", "heads", "head_dim")),
         "wk": ((E, KV, D), ("embed", "heads", "head_dim")),
         "wv": ((E, KV, D), ("embed", "heads", "head_dim")),
         "wo": ((H, D, E), ("heads", "head_dim", "embed")),
     }
+    if not cfg.shared_ln:
+        shapes["ln2_scale"] = ((E,), ("embed",))
     X = cfg.n_experts
     if X > 0:
         # Expert-stacked FFN weights: leading experts dim shards over the
@@ -224,26 +301,30 @@ def _layer_shapes(cfg: TransformerConfig) -> Dict[str, Tuple[Tuple[int, ...], Tu
             "w_in": ((X, E, F), ("expert", "embed", "expert_mlp")),
             "w_out": ((X, F, E), ("expert", "expert_mlp", "embed")),
         })
-        if cfg.variant == "llama":
+        if cfg.is_gated:
             shapes["w_gate"] = ((X, E, F), ("expert", "embed", "expert_mlp"))
     else:
         shapes.update({
             "w_in": ((E, F), ("embed", "mlp")),
             "w_out": ((F, E), ("mlp", "embed")),
         })
-        if cfg.variant == "llama":
+        if cfg.is_gated:
             shapes["w_gate"] = ((E, F), ("embed", "mlp"))
-    if cfg.variant != "llama":
-        shapes.update({
-            "ln1_bias": ((E,), ("embed",)),
-            "ln2_bias": ((E,), ("embed",)),
-            "b_in": (((X, F) if X > 0 else (F,)), (("expert", "expert_mlp") if X > 0 else ("mlp",))),
-            "b_out": (((X, E) if X > 0 else (E,)), (("expert", "embed") if X > 0 else ("embed",))),
-            "bq": ((H, D), ("heads", "head_dim")),
-            "bk": ((KV, D), ("heads", "head_dim")),
-            "bv": ((KV, D), ("heads", "head_dim")),
-            "bo": ((E,), ("embed",)),
-        })
+    if cfg.norm_has_bias:
+        shapes["ln1_bias"] = ((E,), ("embed",))
+        if not cfg.shared_ln:
+            shapes["ln2_bias"] = ((E,), ("embed",))
+    if cfg.has_mlp_bias:
+        shapes["b_in"] = (((X, F) if X > 0 else (F,)),
+                          (("expert", "expert_mlp") if X > 0 else ("mlp",)))
+        shapes["b_out"] = (((X, E) if X > 0 else (E,)),
+                           (("expert", "embed") if X > 0 else ("embed",)))
+    if cfg.has_qkv_bias:
+        shapes["bq"] = ((H, D), ("heads", "head_dim"))
+        shapes["bk"] = ((KV, D), ("heads", "head_dim"))
+        shapes["bv"] = ((KV, D), ("heads", "head_dim"))
+    if cfg.has_attn_out_bias:
+        shapes["bo"] = ((E,), ("embed",))
     return shapes
 
 
@@ -261,9 +342,12 @@ def init(cfg: TransformerConfig, rng) -> Dict[str, Any]:
     }
     if cfg.variant == "gpt2":
         params["pos_embed"] = jax.random.normal(keys[1], (cfg.max_seq, E), jnp.float32) * std
+    if cfg.norm_has_bias:
         params["ln_f_bias"] = jnp.zeros((E,), jnp.float32)
     if not cfg.tie_embeddings:
         params["lm_head"] = jax.random.normal(keys[2], (E, V), jnp.float32) * std
+        if cfg.lm_head_bias:
+            params["lm_head_b"] = jnp.zeros((V,), jnp.float32)
 
     layers = {}
     lkeys = jax.random.split(keys[3], len(_layer_shapes(cfg)))
@@ -294,9 +378,12 @@ def logical_specs(cfg: TransformerConfig) -> Dict[str, Any]:
     }
     if cfg.variant == "gpt2":
         specs["pos_embed"] = (None, "embed")
+    if cfg.norm_has_bias:
         specs["ln_f_bias"] = ("embed",)
     if not cfg.tie_embeddings:
         specs["lm_head"] = ("embed", "vocab")
+        if cfg.lm_head_bias:
+            specs["lm_head_b"] = ("vocab",)
     if cfg.pipeline_stages > 1:
         lead = (("pipe_virtual", "pipe_stage", "layers")
                 if cfg.pipeline_virtual_stages > 1
@@ -315,7 +402,7 @@ def logical_specs(cfg: TransformerConfig) -> Dict[str, Any]:
 
 def _norm(x, scale, bias, cfg: TransformerConfig):
     x32 = x.astype(jnp.float32)
-    if cfg.variant == "llama":
+    if cfg.norm_kind == "rms":
         rms = jax.lax.rsqrt(jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + cfg.norm_eps)
         out = x32 * rms * scale
     else:
@@ -325,14 +412,23 @@ def _norm(x, scale, bias, cfg: TransformerConfig):
     return out.astype(x.dtype)
 
 
+def rope_dim(cfg: TransformerConfig) -> int:
+    """Rotated dims per head: head_dim, or the partial-rotary slice
+    (Phi/NeoX partial_rotary_factor — rope applies to the first
+    rotary_pct * head_dim dims, the rest pass through)."""
+    R = int(cfg.rotary_pct * cfg.head_dim)
+    return R - (R % 2)
+
+
 def rope_inv_freq(cfg: TransformerConfig) -> jnp.ndarray:
-    """Per-band rotary frequencies [D/2], with long-context scaling.
+    """Per-band rotary frequencies [rope_dim/2], with long-context
+    scaling.
 
     "linear" divides every frequency by the factor (position
     interpolation); "llama3" is the Llama-3.x NTK-by-parts rule — long
     wavelengths compress by the factor, short ones keep full resolution,
     the middle band interpolates (HF rope_scaling 'llama3' semantics)."""
-    D = cfg.head_dim
+    D = rope_dim(cfg)
     inv = cfg.rope_theta ** (-jnp.arange(0, D // 2, dtype=jnp.float32) / (D // 2))
     if cfg.rope_scaling_type == "linear":
         return inv / cfg.rope_scaling_factor
@@ -361,14 +457,17 @@ def _rope(q, k, cfg: TransformerConfig, offset: int = 0, positions=None):
     else:
         pos = positions.astype(jnp.float32)  # [B,S]
     freqs = rope_inv_freq(cfg)
-    angles = pos[..., None] * freqs[None, None, :]  # [B|1, S, D/2]
+    angles = pos[..., None] * freqs[None, None, :]  # [B|1, S, R/2]
     cos, sin = jnp.cos(angles), jnp.sin(angles)
+    R = rope_dim(cfg)
 
     def rot(x):
-        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        xr, xp = x[..., :R], x[..., R:]  # partial rotary passthrough
+        x1, x2 = jnp.split(xr.astype(jnp.float32), 2, axis=-1)
         c = cos[:, :, None, :]
         s = sin[:, :, None, :]
-        return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+        out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+        return jnp.concatenate([out.astype(x.dtype), xp], axis=-1)
 
     return rot(q), rot(k)
 
@@ -430,17 +529,18 @@ def _dropout(x, rate: float, rng):
     return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
 
 
-def _attention_block(x, lp, cfg: TransformerConfig, rng=None, positions=None):
-    B, S, E = x.shape
-    h = _act_quant(_norm(x, lp["ln1_scale"], lp.get("ln1_bias"), cfg), cfg)
+def _attention_delta(h, lp, cfg: TransformerConfig, rng=None, positions=None):
+    """Attention branch over the NORMED input h; returns the residual
+    DELTA (the layer body composes sequential vs parallel residuals)."""
+    x = h
     q = jnp.einsum("bse,ehd->bshd", h, lp["wq"].astype(x.dtype))
     k = jnp.einsum("bse,ehd->bshd", h, lp["wk"].astype(x.dtype))
     v = jnp.einsum("bse,ehd->bshd", h, lp["wv"].astype(x.dtype))
-    if cfg.variant == "gpt2":
+    if cfg.has_qkv_bias:
         q = q + lp["bq"].astype(x.dtype)
         k = k + lp["bk"].astype(x.dtype)
         v = v + lp["bv"].astype(x.dtype)
-    else:
+    if cfg.use_rope:
         q, k = _rope(q, k, cfg, positions=positions)
     from jax.ad_checkpoint import checkpoint_name
 
@@ -481,18 +581,25 @@ def _attention_block(x, lp, cfg: TransformerConfig, rng=None, positions=None):
 
     out = _shard(out, DP, "seq", "model", None)
     out = jnp.einsum("bshd,hde->bse", out, lp["wo"].astype(x.dtype))
-    if cfg.variant == "gpt2":
+    if cfg.has_attn_out_bias:
         out = out + lp["bo"].astype(x.dtype)
-    out = _dropout(out, cfg.dropout, rng)
-    return x + out
+    return _dropout(out, cfg.dropout, rng)
 
 
-def _mlp_block(x, lp, cfg: TransformerConfig, rng=None):
-    """Dense or MoE FFN; returns (residual output, moe aux loss)."""
+def _act_fn(cfg: TransformerConfig):
+    return {"silu": jax.nn.silu, "gelu": jax.nn.gelu,
+            "gelu_exact": partial(jax.nn.gelu, approximate=False),
+            "relu": jax.nn.relu}[cfg.act_name]
+
+
+def _mlp_delta(h, lp, cfg: TransformerConfig, rng=None):
+    """FFN branch over the NORMED input h; returns (residual delta,
+    moe aux loss)."""
     if cfg.n_experts > 0:
-        return _moe_mlp_block(x, lp, cfg, rng)
-    h = _act_quant(_norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg), cfg)
-    if cfg.variant == "llama":
+        return _moe_mlp_delta(h, lp, cfg, rng)
+    x = h
+    act = _act_fn(cfg)
+    if cfg.is_gated:
         from jax.ad_checkpoint import checkpoint_name
 
         # named for remat="save_attn_mlp": saving the two F-wide products
@@ -503,41 +610,43 @@ def _mlp_block(x, lp, cfg: TransformerConfig, rng=None):
         up = checkpoint_name(
             jnp.einsum("bse,ef->bsf", h, lp["w_in"].astype(x.dtype)),
             "mlp_up")
-        inner = jax.nn.silu(gate) * up
+        inner = act(gate) * up
     else:
-        inner = jax.nn.gelu(
-            jnp.einsum("bse,ef->bsf", h, lp["w_in"].astype(x.dtype)) + lp["b_in"].astype(x.dtype)
-        )
+        inner = jnp.einsum("bse,ef->bsf", h, lp["w_in"].astype(x.dtype))
+        if cfg.has_mlp_bias:
+            inner = inner + lp["b_in"].astype(x.dtype)
+        inner = act(inner)
     inner = _shard(inner, DP, "seq", "model")
     out = jnp.einsum("bsf,fe->bse", inner, lp["w_out"].astype(x.dtype))
-    if cfg.variant == "gpt2":
+    if cfg.has_mlp_bias:
         out = out + lp["b_out"].astype(x.dtype)
-    out = _dropout(out, cfg.dropout, rng)
-    return x + out, jnp.float32(0.0)
+    return _dropout(out, cfg.dropout, rng), jnp.float32(0.0)
 
 
-def _moe_mlp_block(x, lp, cfg: TransformerConfig, rng=None):
-    """Expert-parallel MoE FFN (ref: deepspeed/moe/sharded_moe.py
-    MOELayer:421 — dispatch einsum / all-to-all / expert FFN / combine)."""
+def _moe_mlp_delta(h, lp, cfg: TransformerConfig, rng=None):
+    """Expert-parallel MoE FFN over normed h (ref: deepspeed/moe/
+    sharded_moe.py MOELayer:421 — dispatch einsum / all-to-all / expert
+    FFN / combine)."""
     from ..moe.sharded_moe import moe_ffn
 
-    B, S, E = x.shape
-    h = _act_quant(_norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg), cfg)
+    B, S, E = h.shape
+    x = h
+    act = _act_fn(cfg)
     tokens = h.reshape(B * S, E)
 
     def expert_fn(xin):  # [X, C, E] expert-major
-        if cfg.variant == "llama":
+        if cfg.is_gated:
             gate = jnp.einsum("xce,xef->xcf", xin, lp["w_gate"].astype(x.dtype))
             up = jnp.einsum("xce,xef->xcf", xin, lp["w_in"].astype(x.dtype))
-            inner = jax.nn.silu(gate) * up
+            inner = act(gate) * up
         else:
-            inner = jax.nn.gelu(
-                jnp.einsum("xce,xef->xcf", xin, lp["w_in"].astype(x.dtype))
-                + lp["b_in"][:, None, :].astype(x.dtype)
-            )
+            inner = jnp.einsum("xce,xef->xcf", xin, lp["w_in"].astype(x.dtype))
+            if cfg.has_mlp_bias:
+                inner = inner + lp["b_in"][:, None, :].astype(x.dtype)
+            inner = act(inner)
         inner = _shard(inner, "expert", None, "model")
         out = jnp.einsum("xcf,xfe->xce", inner, lp["w_out"].astype(x.dtype))
-        if cfg.variant == "gpt2":
+        if cfg.has_mlp_bias:
             out = out + lp["b_out"][:, None, :].astype(x.dtype)
         return out
 
@@ -560,8 +669,7 @@ def _moe_mlp_block(x, lp, cfg: TransformerConfig, rng=None):
     )
     out = out.reshape(B, S, E)
     out = _shard(out, DP, "seq", None)
-    out = _dropout(out, cfg.dropout, rng)
-    return x + out, l_aux
+    return _dropout(out, cfg.dropout, rng), l_aux
 
 
 # valid TransformerConfig.remat values; __post_init__ validates so a
@@ -602,8 +710,23 @@ def _make_layer_body(cfg: TransformerConfig, use_rng: bool, positions=None,
             r1 = r2 = None
 
         def run(h0):
-            h = _attention_block(h0, lp, cfg, r1, positions=positions)
-            h, l_aux = _mlp_block(h, lp, cfg, r2)
+            h1 = _act_quant(
+                _norm(h0, lp["ln1_scale"], lp.get("ln1_bias"), cfg), cfg)
+            attn = _attention_delta(h1, lp, cfg, r1, positions=positions)
+            if cfg.parallel_residual:
+                # Falcon/Phi form: both branches read the SAME residual
+                # stream (shared_ln additionally shares the norm)
+                h2 = h1 if cfg.shared_ln else _act_quant(
+                    _norm(h0, lp["ln2_scale"], lp.get("ln2_bias"), cfg), cfg)
+                mlp, l_aux = _mlp_delta(h2, lp, cfg, r2)
+                h = h0 + attn + mlp
+            else:
+                hmid = h0 + attn
+                h2 = _act_quant(
+                    _norm(hmid, lp["ln2_scale"], lp.get("ln2_bias"), cfg),
+                    cfg)
+                mlp, l_aux = _mlp_delta(h2, lp, cfg, r2)
+                h = hmid + mlp
             h = _shard(h, DP, "seq", None)
             return h, l_aux
 
@@ -746,10 +869,12 @@ def forward(params: Dict[str, Any], tokens, cfg: TransformerConfig, rng=None):
     x = forward_hidden(params, tokens, cfg, rng)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("bse,ev->bsv", x, head.astype(x.dtype))
+    if "lm_head_b" in params:
+        logits = logits + params["lm_head_b"].astype(logits.dtype)
     return _shard(logits, DP, "seq", "model")
 
 
-def _chunked_ce(x, head, targets, mask, n_chunks: int):
+def _chunked_ce(x, head, targets, mask, n_chunks: int, head_b=None):
     """Cross-entropy without materializing [B,S,V] through backward.
 
     The per-chunk logits+logsumexp are rematerialized in bwd
@@ -764,6 +889,8 @@ def _chunked_ce(x, head, targets, mask, n_chunks: int):
     @jax.checkpoint
     def chunk(x_c, t_c, m_c):
         logits = jnp.einsum("bce,ev->bcv", x_c, head.astype(x_c.dtype))
+        if head_b is not None:
+            logits = logits + head_b.astype(logits.dtype)
         logits = _shard(logits, DP, None, "model").astype(jnp.float32)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         tgt = jnp.take_along_axis(logits, t_c[..., None], axis=-1)[..., 0]
@@ -800,11 +927,11 @@ def _ce_chunk_count(seq_len: int, loss_chunks: int) -> int:
     return max(loss_chunks if seq_len % max(loss_chunks, 1) == 0 else 1, 1)
 
 
-def _token_mean_ce(x, head, targets, mask, n_chunks: int):
+def _token_mean_ce(x, head, targets, mask, n_chunks: int, head_b=None):
     """Token-mean CE for one (micro)batch — the single shared loss tail
     for the flat and pipelined paths (identical numerics by
     construction)."""
-    tot, cnt = _chunked_ce(x, head, targets, mask, n_chunks)
+    tot, cnt = _chunked_ce(x, head, targets, mask, n_chunks, head_b=head_b)
     return tot / jnp.maximum(cnt, 1.0)
 
 
@@ -823,7 +950,9 @@ def make_loss_fn(cfg: TransformerConfig, loss_chunks: int = 8):
             pld_theta=batch.get("pld_theta"),
         )
         n = _ce_chunk_count(inputs.shape[1], loss_chunks)
-        loss = _token_mean_ce(x, _lm_head(params, cfg), targets, _shift_mask(batch, targets), n)
+        loss = _token_mean_ce(x, _lm_head(params, cfg), targets,
+                              _shift_mask(batch, targets), n,
+                              head_b=params.get("lm_head_b"))
         if cfg.n_experts > 0:
             # Load-balancing aux loss, coefficient per the reference's
             # Megatron-DeepSpeed recipe (ref: sharded_moe.py l_aux usage).
@@ -952,7 +1081,8 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, loss_chunks: int = 8):
         mask = _shift_mask(batch, targets)
         n = _ce_chunk_count(S, loss_chunks)
         per_micro = jax.vmap(
-            lambda xc, tc, mc: _token_mean_ce(xc, head, tc, mc, n)
+            lambda xc, tc, mc: _token_mean_ce(
+                xc, head, tc, mc, n, head_b=params.get("lm_head_b"))
         )(x_out, targets, mask)
         loss = jnp.mean(per_micro)
         if cfg.n_experts > 0:

@@ -329,21 +329,20 @@ def _decode_fused_kernel(
     *, n_seqs: int, block_size: int, scale: float, n_kv: int, gp: int,
     window: int,
 ):
-    """ONE grid step for the whole decode batch. The KV arenas stay in
-    HBM (memory_space=ANY); per sequence, a fori_loop walks ONLY the
-    live blocks of its table, double-buffering block DMAs. Dead table
-    slots cost nothing, every new token's row is DMA'd straight into its
-    cache slot upfront (2 KB each, vs RMW-ing whole 256 KB blocks
-    through the output pipeline), and each new token's attention
-    contribution enters as one extra online-softmax column from VMEM.
-    Sequences are unrolled; sequence s+1's first block DMA is issued
-    before sequence s computes (buffer sets alternate by sequence
-    parity), so the common short-context case never stalls on DMA.
-    A (S, NB)-grid kernel variant measured 31 us/call at S=8, NB=4 on
-    v5e — sequencing cost per table slot, live or not; this shape costs
-    ~13 us."""
+    """One grid step per SEQUENCE (compile size O(1) in batch — an
+    earlier all-sequences-unrolled variant ran ~8us/call faster at S=8
+    but its Mosaic compile exploded at S=64). The KV arenas stay in HBM
+    (memory_space=ANY); a fori_loop walks ONLY the live blocks of this
+    sequence's table, double-buffering block DMAs. Dead table slots cost
+    nothing, the new token's row is DMA'd straight into its cache slot
+    (2 KB, vs RMW-ing whole 256 KB blocks through the output pipeline),
+    and its attention contribution enters as one extra online-softmax
+    column from VMEM. Scratch persists across grid steps, so each step
+    prefetches the NEXT sequence's first block (buffer sets alternate by
+    sequence parity) — the common short-context case never stalls."""
     bs = block_size
     D = q_ref.shape[-1]
+    s = pl.program_id(0)
 
     def jbase_of(ctx):
         return (jnp.maximum(ctx - window, 0) // bs) if window > 0 else 0
@@ -351,119 +350,125 @@ def _decode_fused_kernel(
     def nblk_of(ctx):
         return pl.cdiv(jnp.maximum(ctx - 1, 0), bs)
 
-    def load(s, bufset, j, buf_slot):
-        blk = tbl_ref[s, j]
+    def load(sq, bufset, j, buf_slot):
+        blk = tbl_ref[sq, j]
         pltpu.make_async_copy(k_any.at[blk], bufk.at[bufset, buf_slot],
                               lsem.at[bufset, buf_slot, 0]).start()
         pltpu.make_async_copy(v_any.at[blk], bufv.at[bufset, buf_slot],
                               lsem.at[bufset, buf_slot, 1]).start()
 
-    def prefetch_first(s):
-        ctx = ctx_ref[s]
+    def prefetch_first(sq):
+        ctx = ctx_ref[sq]
         jb = jbase_of(ctx)
 
         @pl.when(jb < nblk_of(ctx))
         def _():
-            load(s, s % 2, jb, jb % 2)
+            load(sq, sq % 2, jb, jb % 2)
 
-    prefetch_first(0)
-    for s in range(n_seqs):
-        if s + 1 < n_seqs:
-            prefetch_first(s + 1)
-        ctx = ctx_ref[s]
-        slot = slot_ref[s]
-        L = jnp.maximum(ctx - 1, 0)      # old tokens in the cache
-        bufset = s % 2
+    @pl.when(s == 0)
+    def _prefetch_self():
+        prefetch_first(0)
 
-        def body(j, carry, s=s, ctx=ctx, L=L, bufset=bufset):
-            ms, ls, accs = carry  # per-head tuples: (Gp,1),(Gp,1),(Gp,D)
-            bslot = j % 2
+    @pl.when(s + 1 < n_seqs)
+    def _prefetch_next_seq():
+        prefetch_first(s + 1)
 
-            @pl.when(j + 1 < nblk_of(ctx))
-            def _prefetch_next():
-                load(s, bufset, j + 1, (j + 1) % 2)
+    ctx = ctx_ref[s]
+    slot = slot_ref[s]
+    L = jnp.maximum(ctx - 1, 0)      # old tokens in the cache
+    bufset = s % 2
 
-            pltpu.make_async_copy(k_any.at[0], bufk.at[bufset, bslot],
-                                  lsem.at[bufset, bslot, 0]).wait()
-            pltpu.make_async_copy(v_any.at[0], bufv.at[bufset, bslot],
-                                  lsem.at[bufset, bslot, 1]).wait()
-            kb = bufk[bufset, bslot]  # (bs, KV, D)
-            vb = bufv[bufset, bslot]
-            cols = j * bs + jax.lax.broadcasted_iota(jnp.int32, (gp, bs), 1)
-            live = cols < L
-            if window > 0:
-                live = jnp.logical_and(live, cols >= ctx - window)
-            ms2, ls2, accs2 = [], [], []
-            for h in range(n_kv):
-                q = q_ref[s, h]  # (Gp, D)
-                st = _dot(q, kb[:, h, :], trans_b=True) * scale  # (Gp, bs)
-                st = jnp.where(live, st, NEG_INF)
-                m_new = jnp.maximum(ms[h], jnp.max(st, axis=1, keepdims=True))
-                p = jnp.exp(st - m_new)
-                corr = jnp.exp(ms[h] - m_new)
-                ls2.append(ls[h] * corr + jnp.sum(p, axis=1, keepdims=True))
-                accs2.append(accs[h] * corr + _dot(p.astype(vb.dtype),
-                                                   vb[:, h, :]))
-                ms2.append(m_new)
-            return tuple(ms2), tuple(ls2), tuple(accs2)
+    def body(j, carry):
+        ms, ls, accs = carry  # per-head tuples: (Gp,1),(Gp,1),(Gp,D)
+        bslot = j % 2
 
-        init = (
-            tuple(jnp.full((gp, 1), NEG_INF, jnp.float32)
-                  for _ in range(n_kv)),
-            tuple(jnp.zeros((gp, 1), jnp.float32) for _ in range(n_kv)),
-            tuple(jnp.zeros((gp, D), jnp.float32) for _ in range(n_kv)),
-        )
-        ms, ls, accs = jax.lax.fori_loop(jbase_of(ctx), nblk_of(ctx),
-                                         body, init)
+        @pl.when(j + 1 < nblk_of(ctx))
+        def _prefetch_next():
+            load(s, bufset, j + 1, (j + 1) % 2)
 
-        # this sequence's new row -> its cache slot, started only AFTER
-        # its own block loads are consumed: the write may tear bf16
-        # values mid-DMA, and although the row's column is masked out of
-        # the softmax, 0 * NaN from a torn load would still poison the
-        # accumulator. Other sequences' loads never touch this block
-        # (rows are distinct sequences). Waited at kernel end.
-        @pl.when(slot >= 0)
-        def _write_row(s=s, slot=slot):
-            blk = slot // bs
-            off = slot % bs
-            pltpu.make_async_copy(kn_ref.at[s], ck_any.at[blk, off],
-                                  wsem.at[s, 0]).start()
-            pltpu.make_async_copy(vn_ref.at[s], cv_any.at[blk, off],
-                                  wsem.at[s, 1]).start()
-
-        # the new token's own column (kn/vn are VMEM-resident inputs)
-        def newcol(carry, s=s):
-            ms, ls, accs = carry
-            ms2, ls2, accs2 = [], [], []
-            for h in range(n_kv):
-                q = q_ref[s, h]
-                stn = (jnp.sum(q * kn_ref[s, h][None, :], axis=1,
-                               keepdims=True) * scale).astype(jnp.float32)
-                m_new = jnp.maximum(ms[h], stn)
-                p = jnp.exp(stn - m_new)
-                corr = jnp.exp(ms[h] - m_new)
-                ls2.append(ls[h] * corr + p)
-                accs2.append(accs[h] * corr
-                             + p * vn_ref[s, h][None, :].astype(jnp.float32))
-                ms2.append(m_new)
-            return tuple(ms2), tuple(ls2), tuple(accs2)
-
-        ms, ls, accs = jax.lax.cond(slot >= 0, newcol, lambda c: c,
-                                    (ms, ls, accs))
-
+        pltpu.make_async_copy(k_any.at[0], bufk.at[bufset, bslot],
+                              lsem.at[bufset, bslot, 0]).wait()
+        pltpu.make_async_copy(v_any.at[0], bufv.at[bufset, bslot],
+                              lsem.at[bufset, bslot, 1]).wait()
+        kb = bufk[bufset, bslot]  # (bs, KV, D)
+        vb = bufv[bufset, bslot]
+        cols = j * bs + jax.lax.broadcasted_iota(jnp.int32, (gp, bs), 1)
+        live = cols < L
+        if window > 0:
+            live = jnp.logical_and(live, cols >= ctx - window)
+        ms2, ls2, accs2 = [], [], []
         for h in range(n_kv):
-            l_safe = jnp.where(ls[h] == 0.0, 1.0, ls[h])
-            o_ref[s, h] = (accs[h] / l_safe).astype(o_ref.dtype)
+            q = q_ref[s, h]  # (Gp, D)
+            st = _dot(q, kb[:, h, :], trans_b=True) * scale  # (Gp, bs)
+            st = jnp.where(live, st, NEG_INF)
+            m_new = jnp.maximum(ms[h], jnp.max(st, axis=1, keepdims=True))
+            p = jnp.exp(st - m_new)
+            corr = jnp.exp(ms[h] - m_new)
+            ls2.append(ls[h] * corr + jnp.sum(p, axis=1, keepdims=True))
+            accs2.append(accs[h] * corr + _dot(p.astype(vb.dtype),
+                                               vb[:, h, :]))
+            ms2.append(m_new)
+        return tuple(ms2), tuple(ls2), tuple(accs2)
 
-    for s in range(n_seqs):
-        @pl.when(slot_ref[s] >= 0)
-        def _wait_row(s=s):
-            blk = slot_ref[s] // bs
-            off = slot_ref[s] % bs
-            pltpu.make_async_copy(kn_ref.at[s], ck_any.at[blk, off],
-                                  wsem.at[s, 0]).wait()
-            pltpu.make_async_copy(vn_ref.at[s], cv_any.at[blk, off],
-                                  wsem.at[s, 1]).wait()
+    init = (
+        tuple(jnp.full((gp, 1), NEG_INF, jnp.float32)
+              for _ in range(n_kv)),
+        tuple(jnp.zeros((gp, 1), jnp.float32) for _ in range(n_kv)),
+        tuple(jnp.zeros((gp, D), jnp.float32) for _ in range(n_kv)),
+    )
+    ms, ls, accs = jax.lax.fori_loop(jbase_of(ctx), nblk_of(ctx),
+                                     body, init)
+
+    # this sequence's new row -> its cache slot, started only AFTER its
+    # own block loads are consumed: the write may tear bf16 values
+    # mid-DMA, and although the row's column is masked out of the
+    # softmax, 0 * NaN from a torn load would still poison the
+    # accumulator. Other sequences' loads never touch this block (rows
+    # are distinct sequences). Waited at the final grid step.
+    @pl.when(slot >= 0)
+    def _write_row():
+        blk = slot // bs
+        off = slot % bs
+        pltpu.make_async_copy(kn_ref.at[s], ck_any.at[blk, off],
+                              wsem.at[s, 0]).start()
+        pltpu.make_async_copy(vn_ref.at[s], cv_any.at[blk, off],
+                              wsem.at[s, 1]).start()
+
+    # the new token's own column (kn/vn are VMEM-resident inputs)
+    def newcol(carry):
+        ms, ls, accs = carry
+        ms2, ls2, accs2 = [], [], []
+        for h in range(n_kv):
+            q = q_ref[s, h]
+            stn = (jnp.sum(q * kn_ref[s, h][None, :], axis=1,
+                           keepdims=True) * scale).astype(jnp.float32)
+            m_new = jnp.maximum(ms[h], stn)
+            p = jnp.exp(stn - m_new)
+            corr = jnp.exp(ms[h] - m_new)
+            ls2.append(ls[h] * corr + p)
+            accs2.append(accs[h] * corr
+                         + p * vn_ref[s, h][None, :].astype(jnp.float32))
+            ms2.append(m_new)
+        return tuple(ms2), tuple(ls2), tuple(accs2)
+
+    ms, ls, accs = jax.lax.cond(slot >= 0, newcol, lambda c: c,
+                                (ms, ls, accs))
+
+    for h in range(n_kv):
+        l_safe = jnp.where(ls[h] == 0.0, 1.0, ls[h])
+        o_ref[s, h] = (accs[h] / l_safe).astype(o_ref.dtype)
+
+    @pl.when(s == n_seqs - 1)
+    def _wait_rows():
+        for sq in range(n_seqs):
+            @pl.when(slot_ref[sq] >= 0)
+            def _w(sq=sq):
+                blk = slot_ref[sq] // bs
+                off = slot_ref[sq] % bs
+                pltpu.make_async_copy(kn_ref.at[sq], ck_any.at[blk, off],
+                                      wsem.at[sq, 0]).wait()
+                pltpu.make_async_copy(vn_ref.at[sq], cv_any.at[blk, off],
+                                      wsem.at[sq, 1]).wait()
 
 
 def supports_fused_v2(head_dim: int) -> bool:
@@ -501,7 +506,7 @@ def paged_decode_fused(q, k_cache, v_cache, block_table, ctx_lens,
     vmem = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(1,),
+        grid=(S,),
         in_specs=[
             vmem(), vmem(), vmem(),
             pl.BlockSpec(memory_space=pltpu.ANY),

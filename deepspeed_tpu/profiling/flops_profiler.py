@@ -111,3 +111,152 @@ class FlopsProfiler:
     @property
     def last(self) -> Optional[Dict[str, Any]]:
         return self._last
+
+    def print_model_profile(self, model_config, seq_len: int,
+                            batch_size: Optional[int] = None,
+                            module_depth: int = -1, top_modules: int = 0,
+                            file=None) -> None:
+        """Reference-style per-module tree (ref: profiler.py
+        print_model_profile:282) — see module_profile_tree for how the
+        numbers are derived under jit."""
+        step_t = (self._last or {}).get("step_time_s")
+        print_model_profile(
+            model_config, seq_len,
+            batch_size=batch_size or self.batch_size or 1,
+            step_time_s=step_t, module_depth=module_depth,
+            top_modules=top_modules, file=file,
+            output_file=self.config.output_file,
+        )
+
+
+# ---------------------------------------------------------------------------
+# per-module tree (ref: profiler.py print_model_profile:282)
+# ---------------------------------------------------------------------------
+
+def module_profile_tree(cfg, seq_len: int, batch_size: int = 1
+                        ) -> Dict[str, Any]:
+    """Analytic per-module profile of one FORWARD pass of the in-tree
+    transformer family: params / MACs-derived flops per module, nested
+    like the reference's module tree.
+
+    The reference counts these numbers with forward hooks + patched
+    functionals per nn.Module call; under jit there are no module
+    boundaries at runtime, but the model family's structure is known
+    exactly, so the same counts come from the config in closed form
+    (per-layer latency below is flops-proportional attribution of the
+    measured step time — an estimate, clearly labeled; op-exact timing
+    lives in the xplane traces, utils/profiler.py)."""
+    E, H, KV, D = cfg.d_model, cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    F, V, L, S, B = cfg.ff_dim, cfg.vocab_size, cfg.n_layers, seq_len, \
+        batch_size
+    T = B * S  # tokens per step
+
+    def mod(params, flops, children=None):
+        d = {"params": int(params), "flops": float(flops)}
+        if children:
+            d["children"] = children
+            d["params"] = int(sum(c["params"] for c in children.values())
+                              + params)
+            d["flops"] = float(sum(c["flops"] for c in children.values())
+                               + flops)
+        return d
+
+    qkv_params = E * (H + 2 * KV) * D + (
+        (H + 2 * KV) * D if cfg.has_qkv_bias else 0)
+    attn = mod(0, 0, {
+        "qkv_proj": mod(qkv_params, 2 * T * E * (H + 2 * KV) * D),
+        # causal: ~S/2 keys per query
+        "attn_scores": mod(0, 2 * T * H * D * S / 2),
+        "attn_context": mod(0, 2 * T * H * D * S / 2),
+        "out_proj": mod(H * D * E + (E if cfg.has_attn_out_bias else 0),
+                        2 * T * H * D * E),
+    })
+    n_mats = (2 if cfg.is_gated else 1)
+    mlp_in_p = n_mats * E * F + (F if cfg.has_mlp_bias else 0)
+    X = max(cfg.n_experts, 1)
+    # MoE: every token runs top_k experts' FFNs (capacity-free count)
+    fan = cfg.moe_top_k if cfg.n_experts > 0 else 1
+    mlp_children = {
+        "in_proj" + ("_gate_up" if cfg.is_gated else ""):
+            mod(mlp_in_p * X, fan * n_mats * 2 * T * E * F),
+        "out_proj": mod((F * E + (E if cfg.has_mlp_bias else 0)) * X,
+                        fan * 2 * T * F * E),
+    }
+    if cfg.n_experts > 0:
+        mlp_children["router"] = mod(E * X, 2 * T * E * X)
+    mlp = mod(0, 0, mlp_children)
+    n_ln = 1 if cfg.shared_ln else 2
+    layer = mod(0, 0, {
+        "attention": attn,
+        "mlp" if cfg.n_experts == 0 else "moe_mlp": mlp,
+        "norms": mod(n_ln * E * (2 if cfg.norm_has_bias else 1),
+                     n_ln * 5 * T * E),
+    })
+    top = {
+        "embed": mod(V * E + (cfg.max_seq * E if cfg.variant == "gpt2"
+                              else 0), 0),
+        "layers": mod(0, 0, {f"layer_{i}": layer for i in range(L)}),
+        "final_norm": mod(E * (2 if cfg.norm_has_bias else 1), 5 * T * E),
+        "lm_head": mod(
+            0 if cfg.tie_embeddings
+            else E * V + (V if cfg.lm_head_bias else 0),
+            2 * T * E * V),
+    }
+    return mod(0, 0, top)
+
+
+def print_model_profile(cfg, seq_len: int, batch_size: int = 1,
+                        step_time_s: Optional[float] = None,
+                        module_depth: int = -1, top_modules: int = 0,
+                        file=None, output_file: Optional[str] = None) -> None:
+    """Depth-controlled per-module tree: params / fwd flops / % of model
+    flops / (optional) flops-proportional share of the measured step
+    time. module_depth=-1 prints everything; top_modules=k keeps only
+    the k most expensive children per level (both knobs mirror the
+    reference's print_model_profile)."""
+    tree = module_profile_tree(cfg, seq_len, batch_size)
+    total = tree["flops"] or 1.0
+    lines = [
+        "-" * 72,
+        "DeepSpeed-TPU per-module profile "
+        f"(fwd, batch {batch_size} x seq {seq_len})",
+        f"{'module':<40}{'params':>10}{'fwd flops':>12}{'%':>6}"
+        + (f"{'est ms':>8}" if step_time_s else ""),
+    ]
+
+    def fmt_n(n):
+        for u, s in ((1e9, "G"), (1e6, "M"), (1e3, "k")):
+            if abs(n) >= u:
+                return f"{n/u:.2f}{s}"
+        return str(int(n))
+
+    def walk(name, node, depth, indent):
+        pct = node["flops"] / total * 100
+        row = f"{'  '*indent + name:<40}{fmt_n(node['params']):>10}" \
+              f"{fmt_n(node['flops']):>12}{pct:>5.1f}%"
+        if step_time_s:
+            row += f"{node['flops']/total*step_time_s*1e3:>8.2f}"
+        lines.append(row)
+        if module_depth != -1 and depth >= module_depth:
+            return
+        kids = list((node.get("children") or {}).items())
+        kids.sort(key=lambda kv: -kv[1]["flops"])
+        if top_modules:
+            kids = kids[:top_modules]
+        # identical repeated layers print once with a multiplier
+        if name == "layers" and kids:
+            k0_name, k0 = kids[0]
+            lines.append(f"{'  '*(indent+1)}[x{len(kids)} identical layers"
+                         f" — expanding {k0_name}]")
+            walk(k0_name, k0, depth + 1, indent + 1)
+            return
+        for kname, kid in kids:
+            walk(kname, kid, depth + 1, indent + 1)
+
+    walk("model", tree, 0, 0)
+    lines.append("-" * 72)
+    out = "\n".join(lines)
+    print(out, file=file or sys.stdout)
+    if output_file:
+        with open(output_file, "a") as fh:
+            print(out, file=fh)

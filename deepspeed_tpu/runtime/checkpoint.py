@@ -15,6 +15,7 @@ Layout mirrors the reference's tag scheme:
   <save_dir>/latest            (text file holding the newest tag)
 """
 
+import contextlib
 import json
 import os
 from typing import Any, Dict, Optional, Tuple
@@ -226,19 +227,36 @@ class TieredCheckpointEngine:
             shutil.rmtree(os.path.join(save_dir, t), ignore_errors=True)
 
     # --- load path (fast tier first, durable fallback) ----------------
+    @contextlib.contextmanager
+    def load_fanout(self, load_dir: str, tag: Optional[str]):
+        """Pin ONE (tier, version) resolution for the duration of a
+        load fan-out (peek_meta → resolve_tag → load): re-resolving per
+        call could route them to different tiers/versions if a
+        retention sweep or an async fast-tier commit lands in between.
+        The pin lives ONLY inside this scope — a standalone peek_meta
+        (e.g. polling latest-tag metadata) resolves fresh every time,
+        so it can never serve a stale 'latest' (r3 advisor finding)."""
+        key = (os.path.abspath(load_dir), tag)
+        self._tier_cache = (key, self._resolve_tier(load_dir, tag))
+        try:
+            yield
+        finally:
+            self._tier_cache = None
+
     def _tier_for(
         self, load_dir: str, tag: Optional[str]
     ) -> Tuple[CheckpointEngine, str, str]:
-        """Resolve (engine, root, concrete tag) ONCE per (load_dir, tag)
-        and memoize: a load_checkpoint call fans out into peek_meta +
-        load (+ resolve_tag), and re-resolving per call could route them
-        to different tiers/versions if a retention sweep or an async
-        fast-tier commit lands in between. The one-entry cache is
-        invalidated on every save."""
+        """Inside an open load_fanout: the pinned resolution. Outside:
+        resolve fresh (uncached)."""
         key = (os.path.abspath(load_dir), tag)
         cached = getattr(self, "_tier_cache", None)
         if cached is not None and cached[0] == key:
             return cached[1]
+        return self._resolve_tier(load_dir, tag)
+
+    def _resolve_tier(
+        self, load_dir: str, tag: Optional[str]
+    ) -> Tuple[CheckpointEngine, str, str]:
         self.fast.wait()
         val: Optional[Tuple[CheckpointEngine, str, str]] = None
         try:
@@ -252,11 +270,9 @@ class TieredCheckpointEngine:
                 # no durable fallback: surface the fast-tier miss directly
                 val = (self.fast, load_dir,
                        tag if tag is not None else "")
-                # keep the miss un-cached so the error path stays live
-                return val
-            val = (self.durable, self.load_path,
-                   self.durable.resolve_tag(self.load_path, tag))
-        self._tier_cache = (key, val)
+            else:
+                val = (self.durable, self.load_path,
+                       self.durable.resolve_tag(self.load_path, tag))
         return val
 
     def peek_meta(self, load_dir: str, tag: Optional[str]) -> Dict:
@@ -265,15 +281,7 @@ class TieredCheckpointEngine:
 
     def load(self, load_dir: str, tag: Optional[str], template_state: Any):
         engine, root, resolved = self._tier_for(load_dir, tag)
-        try:
-            return engine.load(root, resolved or tag, template_state)
-        finally:
-            # the memo exists to keep ONE load_checkpoint fan-out
-            # (peek_meta → resolve_tag → load) on a single tier/version;
-            # load() always ends the fan-out, so drop it here — a reader
-            # process that never saves must still observe newer tags on
-            # its next load
-            self._tier_cache = None
+        return engine.load(root, resolved or tag, template_state)
 
     def resolve_tag(self, load_dir: str, tag: Optional[str]) -> str:
         engine, root, resolved = self._tier_for(load_dir, tag)

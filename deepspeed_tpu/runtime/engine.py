@@ -20,6 +20,7 @@ State lives as a `TrainState` pytree of sharded global arrays:
             (ref: stage_1_and_2.py optimizer-state partitioning)
 """
 
+import contextlib
 import dataclasses
 import functools
 from typing import Any, Callable, Dict, Optional
@@ -83,6 +84,7 @@ class DeepSpeedTPUEngine:
         param_init_fn: Optional[Callable] = None,
         init_rng: Optional[Any] = None,
         pipelined: bool = False,
+        pipeline_virtual_stages: Optional[int] = None,
     ):
         """`params` is either a concrete pytree, or (with `param_init_fn`)
         a pytree of ShapeDtypeStructs — then params are materialized
@@ -94,11 +96,19 @@ class DeepSpeedTPUEngine:
         [gas, micro_batch, ...] batch in one call and runs the microbatch
         loop itself through the stage-sharded layer stack
         (runtime/pipe.py) — the PipelineEngine analog
-        (ref: runtime/pipe/engine.py:55)."""
+        (ref: runtime/pipe/engine.py:55).
+
+        pipeline_virtual_stages: the interleave degree v of a circular
+        [v, P, lc, ...] layer stack. Declare it whenever v > 1 — the
+        checkpoint meta records it and universal-checkpoint conversion
+        depends on it; shape inference alone cannot distinguish v == P
+        stacks from plain [P, L/P, ...] ones (r3 advisor finding)."""
         self.config = config
         self.loss_fn = loss_fn
         self.has_aux = has_aux
         self.pipelined = pipelined
+        self._pipe_virtual = (int(pipeline_virtual_stages)
+                              if pipeline_virtual_stages else None)
         axis_sizes = config.mesh.axis_sizes()
         hpz = config.zero_optimization.zero_hpz_partition_size
         if hpz and hpz > 1:
@@ -1395,10 +1405,16 @@ class DeepSpeedTPUEngine:
         scratch = None
         if self.config.checkpoint.load_universal:
             load_dir, tag, scratch = self._maybe_convert_universal(load_dir, tag)
+        # pin one (tier, version) resolution across the peek_meta → load
+        # fan-out (tiered engine only; plain engines have no fan-out pin)
+        fanout = getattr(self.checkpoint_engine, "load_fanout", None)
+        ctx = fanout(load_dir, tag) if fanout is not None \
+            else contextlib.nullcontext()
         try:
-            if self._offload_nvme:
-                return self._load_checkpoint_nvme(load_dir, tag)
-            return self._load_checkpoint_fused(load_dir, tag)
+            with ctx:
+                if self._offload_nvme:
+                    return self._load_checkpoint_nvme(load_dir, tag)
+                return self._load_checkpoint_fused(load_dir, tag)
         finally:
             if scratch is not None:
                 import shutil
@@ -1507,29 +1523,29 @@ class DeepSpeedTPUEngine:
         from ..utils.universal_checkpoint import convert_pipeline_layout
 
         meta = self.checkpoint_engine.peek_meta(load_dir, tag)
-        if (int(meta.get("pipeline_virtual_stages", 1)) > 1
-                or self._pipe_virtual_stages() > 1):
-            raise NotImplementedError(
-                "load_universal cannot yet convert interleaved "
-                "(pipeline_virtual_stages > 1) layer layouts across "
-                "pipeline degrees; flatten with "
-                "runtime.pipe.unpartition_layers(..., virtual=v) and "
-                "re-partition for the target engine"
-            )
+        src_v = int(meta.get("pipeline_virtual_stages", 1))
+        tgt_v = self._pipe_virtual_stages()
         if "pipeline_stages" in meta:
             src = int(meta["pipeline_stages"])
         else:
+            if src_v > 1:
+                raise ValueError(
+                    "checkpoint meta records pipeline_virtual_stages but "
+                    "not pipeline_stages — cannot locate the layout dims"
+                )
             # pre-meta checkpoints: infer the stored degree from the saved
             # layer-leaf ranks (a stage-partitioned stack carries one extra
             # leading dim vs this engine's flat layout)
             src = self._infer_stored_pipeline_stages(load_dir, tag)
         tgt = int(self.mesh.shape.get("pipe", 1))
-        if src == tgt:
+        if src == tgt and src_v == tgt_v:
             return load_dir, tag, None
         out_dir = tempfile.mkdtemp(prefix="ds_tpu_universal_")
-        convert_pipeline_layout(load_dir, out_dir, src, tgt, tag)
+        convert_pipeline_layout(load_dir, out_dir, src, tgt, tag,
+                                source_virtual=src_v, target_virtual=tgt_v)
         log_dist(
-            f"load_universal: converted pipeline layout {src}→{tgt} stages",
+            f"load_universal: converted pipeline layout {src}x{src_v}→"
+            f"{tgt}x{tgt_v} stages",
             ranks=[0],
         )
         # caller deletes out_dir after restore (a converted checkpoint can
@@ -1537,11 +1553,16 @@ class DeepSpeedTPUEngine:
         return out_dir, tag, out_dir
 
     def _pipe_virtual_stages(self) -> int:
-        """Interleave degree of THIS engine's layer stack, read from the
-        stored leaf shapes: a circular stack is [v, P, lc, ...] (dim 1 ==
-        pipe), a plain one [P, L/P, ...] (dim 0 == pipe). The v == P ==
-        L/P corner is ambiguous from shape alone and reads as plain — the
-        load_universal guard errs loud before that matters."""
+        """Interleave degree of THIS engine's layer stack. The declared
+        pipeline_virtual_stages wins; otherwise fall back to shape
+        inference — a circular stack is [v, P, lc, ...] (dim 1 == pipe),
+        a plain one [P, L/P, ...] (dim 0 == pipe) — and REFUSE the
+        ambiguous corner where both dims equal pipe (a [P, P, lc] stack
+        could be v==P interleaved or a plain stack whose per-stage chunk
+        happens to be P; guessing wrong would silently scramble layer
+        order in universal conversion, r3 advisor finding)."""
+        if self._pipe_virtual is not None:
+            return self._pipe_virtual
         pipe = int(self.mesh.shape.get("pipe", 1))
         if not self.pipelined or pipe <= 1:
             return 1
@@ -1550,6 +1571,20 @@ class DeepSpeedTPUEngine:
         if not layers:
             return 1
         leaf = next(iter(layers.values()))
+        if leaf.ndim >= 2 and leaf.shape[0] == pipe and leaf.shape[1] == pipe:
+            # a [P, P, ...] stack is either plain with chunk == P (the
+            # common small-test shape) or an UNDECLARED v == P circular
+            # stack; assume plain but say so loudly — an interleaved
+            # engine must declare pipeline_virtual_stages or its
+            # checkpoints convert with scrambled layer order
+            log_dist(
+                f"layer stack leading dims are both == pipe ({pipe}); "
+                "assuming a PLAIN [P, L/P] layout. If this engine is "
+                "interleaved, pass pipeline_virtual_stages to "
+                "initialize() — checkpoint conversion depends on it.",
+                ranks=[0], level=30,  # logging.WARNING
+            )
+            return 1
         if leaf.ndim >= 2 and leaf.shape[0] != pipe and leaf.shape[1] == pipe:
             return int(leaf.shape[0])
         return 1

@@ -19,8 +19,11 @@ inference/engine.py:331-499). Differences driven by the TPU design:
   param_init_fn path shards by ZeRO/TP specs at jit boundaries.
 
 Supported architectures: LlamaForCausalLM, MistralForCausalLM,
-MixtralForCausalLM, GPT2LMHeadModel — the reference's flagship serving
-families (blogs/deepspeed-fastgen/README.md model table).
+MixtralForCausalLM, GPT2LMHeadModel, OPTForCausalLM,
+FalconForCausalLM (7B multi-query and 40B new-decoder forms),
+PhiForCausalLM, QWenLMHeadModel, Qwen2ForCausalLM — the reference's
+serving families (blogs/deepspeed-fastgen/README.md model table +
+inference/v2/model_implementations/{falcon,opt,phi,qwen,qwen_v2}/).
 
 Weights load one tensor at a time via safetensors.safe_open (single-file
 or index.json-sharded checkpoints), so peak host memory is ~one stacked
@@ -129,8 +132,13 @@ class _CheckpointReader:
 # config mapping (ref: engine_factory.py:67 — arch string dispatch)
 # ---------------------------------------------------------------------------
 
-_LLAMA_FAMILY = {"LlamaForCausalLM", "MistralForCausalLM", "MixtralForCausalLM"}
-SUPPORTED_ARCHITECTURES = sorted(_LLAMA_FAMILY | {"GPT2LMHeadModel"})
+_LLAMA_FAMILY = {"LlamaForCausalLM", "MistralForCausalLM",
+                 "MixtralForCausalLM", "Qwen2ForCausalLM"}
+SUPPORTED_ARCHITECTURES = sorted(_LLAMA_FAMILY | {
+    "GPT2LMHeadModel", "OPTForCausalLM", "FalconForCausalLM",
+    "RWForCausalLM",  # falcon's pre-rename arch string
+    "PhiForCausalLM", "QWenLMHeadModel",
+})
 
 
 def config_from_hf(hf: Dict[str, Any], **overrides) -> TransformerConfig:
@@ -179,6 +187,111 @@ def config_from_hf(hf: Dict[str, Any], **overrides) -> TransformerConfig:
         if arch == "MixtralForCausalLM":
             kw.update(n_experts=hf["num_local_experts"],
                       moe_top_k=hf["num_experts_per_tok"])
+        if arch == "Qwen2ForCausalLM":
+            # ref: inference/v2/model_implementations/qwen_v2/model.py —
+            # llama geometry + biases on q/k/v only
+            kw.update(qkv_bias=True, attn_out_bias=False,
+                      norm_eps=float(hf.get("rms_norm_eps", 1e-6)))
+    elif arch in ("FalconForCausalLM", "RWForCausalLM"):
+        # ref: inference/v2/model_implementations/falcon/model.py —
+        # parallel attn+MLP residual; 7B: multi-query + ONE layernorm,
+        # 40B+ (new_decoder_architecture): GQA + ln_attn/ln_mlp pair
+        if hf.get("alibi"):
+            raise ValueError("falcon with alibi positions is unsupported "
+                             "(rotary falcon checkpoints only)")
+        new_arch = bool(hf.get("new_decoder_architecture"))
+        n_heads = hf.get("num_attention_heads", hf.get("n_head"))
+        if new_arch:
+            n_kv = hf.get("num_kv_heads", hf.get("n_head_kv")) or n_heads
+        else:
+            n_kv = 1 if hf.get("multi_query", True) else n_heads
+        parallel = bool(hf.get("parallel_attn", True))
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            n_layers=hf.get("num_hidden_layers", hf.get("n_layer")),
+            n_heads=n_heads,
+            n_kv_heads=n_kv,
+            d_model=hf["hidden_size"],
+            d_ff=4 * hf["hidden_size"],
+            max_seq=hf.get("max_position_embeddings", 2048),
+            variant="llama",            # rotary family base
+            norm_type="layer",
+            gated_mlp=False,
+            activation="gelu_exact",  # Falcon's nn.GELU() is erf GELU
+            qkv_bias=bool(hf.get("bias", False)),
+            attn_out_bias=bool(hf.get("bias", False)),
+            mlp_bias=bool(hf.get("bias", False)),
+            parallel_residual=parallel,
+            shared_ln=parallel and not new_arch,
+            rope_theta=float(hf.get("rope_theta", 10000.0)),
+            norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
+            tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
+        )
+    elif arch == "OPTForCausalLM":
+        # ref: inference/v2/model_implementations/opt/model.py — learned
+        # positions (+2 row offset in the HF table), ReLU MLP, biases
+        if not hf.get("do_layer_norm_before", True):
+            raise ValueError("OPT with do_layer_norm_before=False "
+                             "(opt-350m post-LN) is unsupported")
+        if hf.get("word_embed_proj_dim", hf["hidden_size"]) != hf["hidden_size"]:
+            raise ValueError("OPT word_embed_proj_dim != hidden_size "
+                             "(project_in/out) is unsupported")
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            n_layers=hf["num_hidden_layers"],
+            n_heads=hf["num_attention_heads"],
+            d_model=hf["hidden_size"],
+            d_ff=hf["ffn_dim"],
+            max_seq=hf["max_position_embeddings"],
+            variant="gpt2",             # learned-positions family base
+            activation="relu",
+            norm_eps=1e-5,
+            tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
+        )
+    elif arch == "PhiForCausalLM":
+        # ref: inference/v2/model_implementations/phi/model.py — parallel
+        # residual with ONE shared layernorm, partial rotary, biased
+        # projections, untied biased lm_head
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            n_layers=hf["num_hidden_layers"],
+            n_heads=hf["num_attention_heads"],
+            n_kv_heads=hf.get("num_key_value_heads") or None,
+            d_model=hf["hidden_size"],
+            d_ff=hf["intermediate_size"],
+            max_seq=hf.get("max_position_embeddings", 2048),
+            variant="llama",
+            norm_type="layer",
+            gated_mlp=False,
+            activation="gelu",
+            qkv_bias=True,
+            attn_out_bias=True,
+            mlp_bias=True,
+            parallel_residual=True,
+            shared_ln=True,
+            rotary_pct=float(hf.get("partial_rotary_factor", 0.5)),
+            rope_theta=float(hf.get("rope_theta", 10000.0)),
+            norm_eps=float(hf.get("layer_norm_eps", 1e-5)),
+            tie_embeddings=False,
+            lm_head_bias=True,
+        )
+    elif arch == "QWenLMHeadModel":
+        # ref: inference/v2/model_implementations/qwen/model.py — Qwen v1:
+        # llama geometry, fused biased c_attn, UNbiased everything else;
+        # HF intermediate_size counts BOTH gate+up halves
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            n_layers=hf["num_hidden_layers"],
+            n_heads=hf["num_attention_heads"],
+            d_model=hf["hidden_size"],
+            d_ff=hf["intermediate_size"] // 2,
+            max_seq=hf.get("max_position_embeddings", 8192),
+            variant="llama",
+            qkv_bias=True,
+            rope_theta=float(hf.get("rotary_emb_base", 10000.0)),
+            norm_eps=float(hf.get("layer_norm_epsilon", 1e-6)),
+            tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        )
     elif arch == "GPT2LMHeadModel":
         kw = dict(
             vocab_size=hf["vocab_size"],
@@ -219,6 +332,10 @@ def _map_llama_layer(r: _CheckpointReader, i: int,
         "wv": r.get(p + "self_attn.v_proj.weight").T.reshape(E, KV, D),
         "wo": r.get(p + "self_attn.o_proj.weight").T.reshape(H, D, E),
     }
+    if cfg.has_qkv_bias:  # Qwen2: biases on q/k/v only
+        out["bq"] = r.get(p + "self_attn.q_proj.bias").reshape(H, D)
+        out["bk"] = r.get(p + "self_attn.k_proj.bias").reshape(KV, D)
+        out["bv"] = r.get(p + "self_attn.v_proj.bias").reshape(KV, D)
     if cfg.n_experts > 0:
         X, F = cfg.n_experts, cfg.ff_dim
         m = p + "block_sparse_moe."
@@ -265,6 +382,123 @@ def _map_gpt2_layer(r: _CheckpointReader, i: int,
         "b_in": r.get(p + "mlp.c_fc.bias"),
         "w_out": r.get(p + "mlp.c_proj.weight"),  # [F, E]
         "b_out": r.get(p + "mlp.c_proj.bias"),
+    }
+
+
+def _split_falcon_qkv(w: np.ndarray, cfg: TransformerConfig):
+    """Falcon's fused query_key_value: rows are laid out per KV GROUP as
+    [q_1..q_per_kv, k, v] (7B multi-query: one group of [q_1..q_H, k, v]).
+    w arrives transposed [E, (q_per_kv+2)*KV*D]."""
+    E, H, KV, D = cfg.d_model, cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    qpk = H // KV
+    g = w.reshape(E, KV, qpk + 2, D)
+    wq = g[:, :, :qpk, :].reshape(E, H, D)
+    wk = g[:, :, qpk, :]
+    wv = g[:, :, qpk + 1, :]
+    return wq, wk, wv
+
+
+def _map_falcon_layer(r: _CheckpointReader, i: int,
+                      cfg: TransformerConfig) -> Dict[str, np.ndarray]:
+    E, H, KV, D = cfg.d_model, cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    p = f"transformer.h.{i}."
+    wq, wk, wv = _split_falcon_qkv(
+        r.get(p + "self_attention.query_key_value.weight").T, cfg)
+    out = {
+        "wq": wq, "wk": wk, "wv": wv,
+        "wo": r.get(p + "self_attention.dense.weight").T.reshape(H, D, E),
+        "w_in": r.get(p + "mlp.dense_h_to_4h.weight").T,
+        "w_out": r.get(p + "mlp.dense_4h_to_h.weight").T,
+    }
+    if cfg.shared_ln:  # 7B: one layernorm feeds both branches
+        out["ln1_scale"] = r.get(p + "input_layernorm.weight")
+        out["ln1_bias"] = r.get(p + "input_layernorm.bias")
+    else:  # new_decoder_architecture: ln_attn + ln_mlp
+        out["ln1_scale"] = r.get(p + "ln_attn.weight")
+        out["ln1_bias"] = r.get(p + "ln_attn.bias")
+        out["ln2_scale"] = r.get(p + "ln_mlp.weight")
+        out["ln2_bias"] = r.get(p + "ln_mlp.bias")
+    if cfg.has_qkv_bias:
+        bq, bk, bv = _split_falcon_qkv(
+            r.get(p + "self_attention.query_key_value.bias")[None], cfg)
+        out["bq"], out["bk"], out["bv"] = bq[0], bk[0], bv[0]
+        out["bo"] = r.get(p + "self_attention.dense.bias")
+        out["b_in"] = r.get(p + "mlp.dense_h_to_4h.bias")
+        out["b_out"] = r.get(p + "mlp.dense_4h_to_h.bias")
+    return out
+
+
+def _map_opt_layer(r: _CheckpointReader, i: int, cfg: TransformerConfig,
+                   pre: str) -> Dict[str, np.ndarray]:
+    E, H, D = cfg.d_model, cfg.n_heads, cfg.head_dim
+    p = f"{pre}layers.{i}."
+    a = p + "self_attn."
+    return {
+        "ln1_scale": r.get(p + "self_attn_layer_norm.weight"),
+        "ln1_bias": r.get(p + "self_attn_layer_norm.bias"),
+        "ln2_scale": r.get(p + "final_layer_norm.weight"),
+        "ln2_bias": r.get(p + "final_layer_norm.bias"),
+        "wq": r.get(a + "q_proj.weight").T.reshape(E, H, D),
+        "wk": r.get(a + "k_proj.weight").T.reshape(E, H, D),
+        "wv": r.get(a + "v_proj.weight").T.reshape(E, H, D),
+        "bq": r.get(a + "q_proj.bias").reshape(H, D),
+        "bk": r.get(a + "k_proj.bias").reshape(H, D),
+        "bv": r.get(a + "v_proj.bias").reshape(H, D),
+        "wo": r.get(a + "out_proj.weight").T.reshape(H, D, E),
+        "bo": r.get(a + "out_proj.bias"),
+        "w_in": r.get(p + "fc1.weight").T,
+        "b_in": r.get(p + "fc1.bias"),
+        "w_out": r.get(p + "fc2.weight").T,
+        "b_out": r.get(p + "fc2.bias"),
+    }
+
+
+def _map_phi_layer(r: _CheckpointReader, i: int,
+                   cfg: TransformerConfig) -> Dict[str, np.ndarray]:
+    E, H, KV, D = cfg.d_model, cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    p = f"model.layers.{i}."
+    a = p + "self_attn."
+    return {
+        "ln1_scale": r.get(p + "input_layernorm.weight"),
+        "ln1_bias": r.get(p + "input_layernorm.bias"),
+        "wq": r.get(a + "q_proj.weight").T.reshape(E, H, D),
+        "wk": r.get(a + "k_proj.weight").T.reshape(E, KV, D),
+        "wv": r.get(a + "v_proj.weight").T.reshape(E, KV, D),
+        "bq": r.get(a + "q_proj.bias").reshape(H, D),
+        "bk": r.get(a + "k_proj.bias").reshape(KV, D),
+        "bv": r.get(a + "v_proj.bias").reshape(KV, D),
+        "wo": r.get(a + "dense.weight").T.reshape(H, D, E),
+        "bo": r.get(a + "dense.bias"),
+        "w_in": r.get(p + "mlp.fc1.weight").T,
+        "b_in": r.get(p + "mlp.fc1.bias"),
+        "w_out": r.get(p + "mlp.fc2.weight").T,
+        "b_out": r.get(p + "mlp.fc2.bias"),
+    }
+
+
+def _map_qwen_layer(r: _CheckpointReader, i: int,
+                    cfg: TransformerConfig) -> Dict[str, np.ndarray]:
+    """Qwen v1 (QWenLMHeadModel): fused biased c_attn; MLP computes
+    c_proj(w1(x) * silu(w2(x))) — w2 is the GATE, w1 the up projection."""
+    E, H, D = cfg.d_model, cfg.n_heads, cfg.head_dim
+    p = f"transformer.h.{i}."
+    w = r.get(p + "attn.c_attn.weight").T  # [E, 3E]
+    b = r.get(p + "attn.c_attn.bias")      # [3E]
+    wq, wk, wv = np.split(w, 3, axis=1)
+    bq, bk, bv = np.split(b, 3, axis=0)
+    return {
+        "ln1_scale": r.get(p + "ln_1.weight"),
+        "ln2_scale": r.get(p + "ln_2.weight"),
+        "wq": wq.reshape(E, H, D),
+        "wk": wk.reshape(E, H, D),
+        "wv": wv.reshape(E, H, D),
+        "bq": bq.reshape(H, D),
+        "bk": bk.reshape(H, D),
+        "bv": bv.reshape(H, D),
+        "wo": r.get(p + "attn.c_proj.weight").T.reshape(H, D, E),
+        "w_gate": r.get(p + "mlp.w2.weight").T,
+        "w_in": r.get(p + "mlp.w1.weight").T,
+        "w_out": r.get(p + "mlp.c_proj.weight").T,
     }
 
 
@@ -315,10 +549,54 @@ def import_external(
     else:
         cast = lambda a: a
 
-    if cfg.variant == "gpt2":
+    archs = hf.get("architectures") or []
+    arch = archs[0] if archs else hf.get("model_type", "?")
+    params: Dict[str, Any]
+    if arch == "GPT2LMHeadModel":
         top = _gpt2_top(r)
-        params: Dict[str, Any] = {k: cast(r.get(v)) for k, v in top.items()}
+        params = {k: cast(r.get(v)) for k, v in top.items()}
         layer_maps = [_map_gpt2_layer(r, i, cfg) for i in range(cfg.n_layers)]
+    elif arch == "OPTForCausalLM":
+        pre = ("model.decoder." if "model.decoder.embed_tokens.weight" in r
+               else "decoder.")
+        params = {
+            "embed": cast(r.get(pre + "embed_tokens.weight")),
+            # HF offsets learned positions by 2 (legacy padding rows)
+            "pos_embed": cast(r.get(pre + "embed_positions.weight")[2:]),
+            "ln_f_scale": cast(r.get(pre + "final_layer_norm.weight")),
+            "ln_f_bias": cast(r.get(pre + "final_layer_norm.bias")),
+        }
+        if not cfg.tie_embeddings:
+            params["lm_head"] = cast(r.get("lm_head.weight").T)
+        layer_maps = [_map_opt_layer(r, i, cfg, pre)
+                      for i in range(cfg.n_layers)]
+    elif arch in ("FalconForCausalLM", "RWForCausalLM"):
+        params = {
+            "embed": cast(r.get("transformer.word_embeddings.weight")),
+            "ln_f_scale": cast(r.get("transformer.ln_f.weight")),
+            "ln_f_bias": cast(r.get("transformer.ln_f.bias")),
+        }
+        if not cfg.tie_embeddings:
+            params["lm_head"] = cast(r.get("lm_head.weight").T)
+        layer_maps = [_map_falcon_layer(r, i, cfg)
+                      for i in range(cfg.n_layers)]
+    elif arch == "PhiForCausalLM":
+        params = {
+            "embed": cast(r.get("model.embed_tokens.weight")),
+            "ln_f_scale": cast(r.get("model.final_layernorm.weight")),
+            "ln_f_bias": cast(r.get("model.final_layernorm.bias")),
+            "lm_head": cast(r.get("lm_head.weight").T),
+            "lm_head_b": cast(r.get("lm_head.bias")),
+        }
+        layer_maps = [_map_phi_layer(r, i, cfg) for i in range(cfg.n_layers)]
+    elif arch == "QWenLMHeadModel":
+        params = {
+            "embed": cast(r.get("transformer.wte.weight")),
+            "ln_f_scale": cast(r.get("transformer.ln_f.weight")),
+        }
+        if not cfg.tie_embeddings:
+            params["lm_head"] = cast(r.get("lm_head.weight").T)
+        layer_maps = [_map_qwen_layer(r, i, cfg) for i in range(cfg.n_layers)]
     else:
         params = {
             "embed": cast(r.get("model.embed_tokens.weight")),

@@ -23,23 +23,45 @@ import shutil
 from typing import Any, Dict, Optional
 
 
-def _reshape_layer_leaf(leaf, source_stages: int, target_stages: int):
+def _reshape_layer_leaf(leaf, source_stages: int, target_stages: int,
+                        source_virtual: int = 1, target_virtual: int = 1):
+    """Re-partition one stacked layer leaf between pipeline layouts.
+
+    The circular (interleaved) layout [v, P, lc, ...] assigns chunk
+    c = r*P + p to stage p at round r (runtime/pipe.partition_layers)
+    — and flat layer index l = (r*P + p)*lc + c_in_chunk equals the
+    plain row-major reshape, so collapsing ALL leading layout dims
+    recovers the flat [L, ...] stack exactly. What conversion cannot do
+    from shapes alone is know HOW MANY leading dims are layout (a
+    [v, P, lc] stack with v == P reads like [P, L/P] with a weight dim)
+    — hence the explicit source_virtual (recorded in checkpoint meta as
+    pipeline_virtual_stages; ref reshaper:
+    deepspeed/checkpoint/reshape_3d_utils.py)."""
     import numpy as np
 
     x = np.asarray(leaf)
-    if source_stages > 1:  # [P1, L/P1, ...] → [L, ...]
-        x = x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
+    if source_stages > 1:
+        lead = 3 if source_virtual > 1 else 2
+        L = int(np.prod(x.shape[:lead]))
+        x = x.reshape((L,) + x.shape[lead:])
     if target_stages > 1:
         L = x.shape[0]
-        if L % target_stages:
+        if L % (target_stages * target_virtual):
             raise ValueError(
-                f"layer count {L} not divisible by target stages {target_stages}"
+                f"layer count {L} not divisible by target stages "
+                f"{target_stages} x virtual {target_virtual}"
             )
-        x = x.reshape((target_stages, L // target_stages) + x.shape[1:])
+        if target_virtual > 1:
+            x = x.reshape((target_virtual, target_stages,
+                           L // (target_stages * target_virtual))
+                          + x.shape[1:])
+        else:
+            x = x.reshape((target_stages, L // target_stages) + x.shape[1:])
     return x
 
 
-def _convert_tree(tree: Any, source: int, target: int):
+def _convert_tree(tree: Any, source: int, target: int,
+                  source_virtual: int = 1, target_virtual: int = 1):
     """Reshape the 'layers' subtree of a params-shaped tree (params,
     master, or an optimizer moment). Trees whose layer leaves do NOT
     match the params layout (e.g. 1-bit error buffers) are rejected by
@@ -48,7 +70,8 @@ def _convert_tree(tree: Any, source: int, target: int):
         return tree
     out = dict(tree)
     out["layers"] = {
-        k: _reshape_layer_leaf(v, source, target)
+        k: _reshape_layer_leaf(v, source, target, source_virtual,
+                               target_virtual)
         for k, v in tree["layers"].items()
     }
     return out
@@ -60,9 +83,13 @@ def convert_pipeline_layout(
     source_stages: int,
     target_stages: int,
     tag: Optional[str] = None,
+    source_virtual: int = 1,
+    target_virtual: int = 1,
 ) -> str:
     """Rewrite <ckpt_dir>/<tag> into <out_dir>/<tag> with the layer stack
-    re-partitioned from source_stages to target_stages (1 = flat)."""
+    re-partitioned from source_stages to target_stages (1 = flat).
+    source_virtual/target_virtual handle circular (interleaved)
+    [v, P, lc, ...] layouts on either side."""
     import jax
     import numpy as np
     import orbax.checkpoint as ocp
@@ -91,7 +118,8 @@ def convert_pipeline_layout(
                     "state is not supported; resume with a fresh optimizer "
                     "or the original pipeline degree"
                 )
-        return _convert_tree(tree, source_stages, target_stages)
+        return _convert_tree(tree, source_stages, target_stages,
+                             source_virtual, target_virtual)
 
     out = dict(raw)
     out["params"] = convert_like_params(params)
@@ -120,10 +148,14 @@ def main(argv=None):
     p.add_argument("output_dir")
     p.add_argument("--source-stages", type=int, required=True)
     p.add_argument("--target-stages", type=int, required=True)
+    p.add_argument("--source-virtual", type=int, default=1)
+    p.add_argument("--target-virtual", type=int, default=1)
     p.add_argument("--tag", default=None)
     a = p.parse_args(argv)
     out = convert_pipeline_layout(
-        a.checkpoint_dir, a.output_dir, a.source_stages, a.target_stages, a.tag
+        a.checkpoint_dir, a.output_dir, a.source_stages, a.target_stages,
+        a.tag, source_virtual=a.source_virtual,
+        target_virtual=a.target_virtual,
     )
     print(f"wrote converted checkpoint to {out}")
 

@@ -53,11 +53,11 @@ def main(batch=8, n_steps=24, quant=False):
     def readback(x):
         return np.asarray(jax.tree.leaves(x)[0].ravel()[:1])
 
-    gen, logits, cache = fn(params, cache, toks, tables, ctx)
+    gen, logits, cache, _ = fn(params, cache, toks, tables, ctx)
     readback(logits)
     t0 = time.perf_counter()
     for _ in range(3):
-        gen, logits, cache = fn(params, cache, toks, tables, ctx)
+        gen, logits, cache, _ = fn(params, cache, toks, tables, ctx)
     readback(logits)
     wall = (time.perf_counter() - t0) / 3 / n_steps
     print(f"wall per decode step: {wall*1e3:.3f} ms  (batch {batch})")
@@ -65,7 +65,7 @@ def main(batch=8, n_steps=24, quant=False):
     trace_dir = "/tmp/decode_trace"
     os.system(f"rm -rf {trace_dir}")
     jax.profiler.start_trace(trace_dir)
-    gen, logits, cache = fn(params, cache, toks, tables, ctx)
+    gen, logits, cache, _ = fn(params, cache, toks, tables, ctx)
     readback(logits)
     jax.profiler.stop_trace()
 

@@ -199,6 +199,74 @@ class TestUniversalCheckpoint:
               "--source-stages", "1", "--target-stages", "2"])
         assert "wrote converted checkpoint" in capsys.readouterr().out
 
+    def test_interleaved_reshape_roundtrip(self):
+        """[v=2, P=2, lc, ...] → P=4 plain → flat: bit-equal with the
+        original flat stack at every hop (VERDICT r3 item 9 — the cyclic
+        chunk placement's flat order IS the row-major reshape, so the
+        conversion is exact once the leading-layout rank is known)."""
+        from deepspeed_tpu.runtime.pipe import partition_layers
+        from deepspeed_tpu.utils.universal_checkpoint import (
+            _reshape_layer_leaf,
+        )
+
+        r = np.random.default_rng(0)
+        flat = r.normal(size=(8, 6, 5)).astype(np.float32)
+        circ = np.asarray(
+            partition_layers({"w": flat}, 2, virtual=2)["w"])  # [2,2,2,6,5]
+        assert circ.shape == (2, 2, 2, 6, 5)
+        # interleaved(2x2) -> plain P=4
+        p4 = _reshape_layer_leaf(circ, source_stages=2, target_stages=4,
+                                 source_virtual=2)
+        np.testing.assert_array_equal(p4, flat.reshape(4, 2, 6, 5))
+        # plain P=4 -> flat
+        back = _reshape_layer_leaf(p4, source_stages=4, target_stages=1)
+        np.testing.assert_array_equal(back, flat)
+        # flat -> interleaved(2x2) -> flat
+        circ2 = _reshape_layer_leaf(flat, source_stages=1, target_stages=2,
+                                    target_virtual=2)
+        np.testing.assert_array_equal(circ2, circ)
+
+    def test_interleaved_auto_convert_resume(self, tmp_path):
+        """A circular (v=2, P=2) engine's checkpoint auto-converts into
+        a FLAT engine via checkpoint.load_universal (the r3 guard at
+        engine._maybe_convert_universal is gone); resumed trajectory
+        matches. The v == P layout is exactly the shape-ambiguous corner
+        the declared pipeline_virtual_stages resolves."""
+        pcfg = T.TransformerConfig(vocab_size=VOCAB, n_layers=8, n_heads=4,
+                                   d_model=64, max_seq=32, variant="llama",
+                                   use_flash=False, pipeline_stages=2,
+                                   pipeline_virtual_stages=2)
+        fcfg = T.TransformerConfig(vocab_size=VOCAB, n_layers=8, n_heads=4,
+                                   d_model=64, max_seq=32, variant="llama",
+                                   use_flash=False)
+        common = {"train_batch_size": 16, "gradient_accumulation_steps": 4,
+                  "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                  "seed": 7, "steps_per_print": 1000}
+        pipe = ds.initialize(
+            {**common, "mesh": {"pipe": 2, "data": 4}},
+            loss_fn=T.make_pipelined_loss_fn(pcfg),
+            param_init_fn=lambda k: T.init(pcfg, k),
+            param_logical_specs=T.logical_specs(pcfg),
+            pipelined=True, pipeline_virtual_stages=2)
+        assert pipe.state.params["layers"]["w_in"].shape[:2] == (2, 2)
+        r = np.random.default_rng(0)
+        batches = [{"tokens": r.integers(0, VOCAB, (16, 33)).astype(np.int32)}
+                   for _ in range(5)]
+        for b in batches[:3]:
+            pipe.train_batch(b)
+        pipe.save_checkpoint(str(tmp_path / "ck"))
+        rest_pipe = [pipe.train_batch(b)["loss"] for b in batches[3:]]
+
+        flat = ds.initialize(
+            {**common, "mesh": {"data": 4, "model": 2},
+             "checkpoint": {"load_universal": True}},
+            loss_fn=T.make_loss_fn(fcfg),
+            param_init_fn=lambda k: T.init(fcfg, k),
+            param_logical_specs=T.logical_specs(fcfg))
+        flat.load_checkpoint(str(tmp_path / "ck"))
+        rest_flat = [flat.train_batch(b)["loss"] for b in batches[3:]]
+        np.testing.assert_allclose(rest_flat, rest_pipe, rtol=2e-4)
+
     def test_load_universal_auto_converts(self, tmp_path):
         """checkpoint.load_universal=true: a flat engine loads a
         pipeline-degree-2 checkpoint directly, conversion happening inside

@@ -633,7 +633,7 @@ class TestDecodeMulti:
         ctx = np.asarray([11], np.int32)
         tok = np.asarray([prompt[-1]], np.int32)
 
-        gen, last_logits, _ = M.decode_multi(
+        gen, last_logits, _, _ = M.decode_multi(
             eng.params, eng.cache, tok, tables, ctx, cfg, n_steps=4,
             use_kernel=False)
 
@@ -906,11 +906,39 @@ class TestBatchedPrefill:
         # sequential puts (single-prompt path)
         seq = np.stack([a.put([i], [p.copy()])[0]
                         for i, p in enumerate(prompts)])
-        # one wave (batched path)
+        # one put (batched path) — prompts GROUP BY TOKEN BUCKET so the
+        # 11-token straggler no longer pads the 3/5-token prompts to its
+        # bucket (r3 advisor finding): two compiled waves, (2,8) + (1,8
+        # -> bucket 16)
         wave = b.put([0, 1, 2], [p.copy() for p in prompts])
         np.testing.assert_allclose(wave, seq, rtol=2e-5, atol=2e-5)
-        # one compiled batch program for the whole wave
-        assert list(b._prefill_batch_fns) == [(4, 16)]
+        assert sorted(b._prefill_batch_fns) == [(1, 16), (2, 8)]
+
+    def test_non_strict_admits_per_uid(self, rng):
+        """strict=False: prompts that fit run, the rest are REJECTED
+        per-uid instead of failing the batch (r3 advisor finding; the
+        v2 scheduler defers individual prompts)."""
+        cfg, params = small_model()
+        eng = engine_for(cfg, params, num_kv_blocks=4, kv_block_size=8,
+                         max_seq_len=32)
+        # capacity: 4 blocks = 32 tokens; three 16-token prompts -> only
+        # the first two fit
+        prompts = [np.asarray(rng.integers(0, 128, 16), np.int32)
+                   for _ in range(3)]
+        out, rejected = eng.put([0, 1, 2], [p.copy() for p in prompts],
+                                strict=False)
+        assert rejected == [2]
+        assert eng.state.get(2) is None or eng.state.get(2).seen_tokens == 0
+        for i in (0, 1):
+            ref = oracle_next_logits(params, cfg, list(prompts[i]))
+            np.testing.assert_allclose(out[i], ref, rtol=2e-2, atol=2e-2)
+        assert not out[2].any()  # rejected row is zeros
+        # strict default still refuses the whole batch, mutating nothing
+        eng2 = engine_for(cfg, params, num_kv_blocks=4, kv_block_size=8,
+                          max_seq_len=32)
+        with pytest.raises(RuntimeError, match="insufficient KV blocks"):
+            eng2.put([0, 1, 2], [p.copy() for p in prompts])
+        assert eng2.state.free_blocks == 4
 
     def test_wave_then_decode_consistent(self, rng):
         """KV written by the batched prefill serves later decodes."""

@@ -299,6 +299,7 @@ class TestCircularPipeline:
                 param_init_fn=lambda k: T.init(mcfg, k),
                 param_logical_specs=T.logical_specs(mcfg),
                 pipelined=True,
+                pipeline_virtual_stages=v,
             )
 
         np.testing.assert_allclose(

@@ -13,6 +13,7 @@ model that fits).
 """
 
 import json
+import os
 import sys
 import time
 
@@ -114,6 +115,28 @@ def main():
     }
     if serving:
         out.update(serving)
+    # committed real-chip artifacts from the scaling / offload lanes
+    # (scripts/bench_scaling.py, scripts/ici_projection.py,
+    # scripts/bench_offload.py) ride along so the headline line carries
+    # them without re-running their multi-minute builds every bench
+    root = os.path.dirname(os.path.abspath(__file__))
+    sc = os.path.join(root, "SCALING_r04.json")
+    if os.path.exists(sc):
+        doc = json.load(open(sc))
+        out["scaling"] = {
+            k: v["fwd_bwd_mfu"] for k, v in doc.get("layer_mfu", {}).items()
+        }
+        if "ici_projection" in doc:
+            out["ici_seconds_70b_upper"] = doc["ici_projection"][
+                "ici_seconds_at_100GBps"]
+    off = os.path.join(root, "OFFLOAD_r04.json")
+    if os.path.exists(off):
+        out["offload_serving"] = {
+            e["mode"]: {"weights_gib": e["weights_host_gib"],
+                        "tok_s_b64": e["decode_tok_s"],
+                        "larger_than_hbm": e["larger_than_hbm"]}
+            for e in json.load(open(off))
+        }
     print(json.dumps(out))
 
 

@@ -141,11 +141,29 @@ class InferenceEngine:
         dtype=jnp.bfloat16,
         quantization: Optional[Dict[str, Any]] = None,
         mesh: Optional[Mesh] = None,
+        offload: Optional[Dict[str, Any]] = None,
     ):
         """quantization: ZeRO-Inference weight-only PTQ, e.g.
         {"bits": 8, "group_size": 128} — weights stay int8/int4 in HBM
         and dequantize transiently inside each compiled step
         (ref: deepspeed/inference/quantization/).
+
+        offload: ZeRO-Inference FULL-offload serving — {"device": "cpu"}
+        parks every LAYER's weights in host DRAM (pinned_host) and
+        streams them into HBM inside the compiled step, one layer at a
+        time, so models larger than a chip's HBM serve on one chip
+        (ref: docs/_posts/2022-09-10-zero-inference.md:52 — the 43 tok/s
+        OPT-30B full-offload case; batch-size-first policy applies: the
+        per-step cost is dominated by the fixed weight stream, so
+        throughput scales with batch until HBM/compute bind).
+        Embeddings / lm_head / final norm stay HBM-resident (they are
+        the hot constant set). Composes with per-channel int8
+        quantization (halves the streamed bytes). Not supported under a
+        TP mesh. {"device": "nvme"} is intentionally NOT implemented
+        for serving: at single-chip scale host DRAM exceeds any model
+        this chip can usefully serve, and the NVMe aio tier
+        (runtime/swap.py) exists for the TRAINING state, which is ~16x
+        params; pass cpu.
 
         mesh: explicit serving mesh; when absent and config.tp_size > 1,
         a {'model': tp_size} mesh is built over the first tp_size devices
@@ -210,6 +228,25 @@ class InferenceEngine:
                     f"shorter than the largest prefill bucket ({worst}); "
                     "lower max_seq_len so its bucket fits"
                 )
+        self._offload = None
+        if offload:
+            dev = offload.get("device")
+            if dev == "nvme":
+                raise NotImplementedError(
+                    "offload={'device': 'nvme'} serving: use 'cpu' — host "
+                    "DRAM exceeds single-chip-servable models; the NVMe "
+                    "aio tier (runtime/swap.py) backs the ~16x-larger "
+                    "TRAINING state"
+                )
+            if dev != "cpu":
+                raise ValueError(f"offload.device must be 'cpu' (got {dev!r})")
+            if self.mesh is not None:
+                raise NotImplementedError(
+                    "offload serving under a TP mesh is not supported; "
+                    "large models on multiple chips should shard (tp_size) "
+                    "instead of streaming"
+                )
+            self._offload = {"device": "cpu"}
         self._dtype = dtype
         self._quantization = dict(quantization) if quantization else None
         self._per_channel = bool(self._quantization
@@ -237,6 +274,8 @@ class InferenceEngine:
             # step-entry dequant pass
             self._dequant = lambda p: p
         self._prepare_fn = None
+        self._layer_xform = None
+        self._top_xform = None
         self.refresh_params(params)
         self.state = StateManager(
             num_blocks=self.config.num_kv_blocks,
@@ -269,7 +308,16 @@ class InferenceEngine:
         steps, generation serves the updated arrays (quantized engines
         re-quantize). The tree is cast and converted to the SERVING
         layout (M.prepare: per-layer unstacked, fused GEMMs — see
-        inference/model.py docstring) in one compiled transform."""
+        inference/model.py docstring) in one compiled transform.
+
+        Offload engines stage LAYER BY LAYER instead: a bigger-than-HBM
+        model must never materialize whole on device, so each layer is
+        cast/fused/quantized in its own compiled transform whose outputs
+        land directly in pinned_host (device HBM holds one layer
+        transiently)."""
+        if self._offload is not None:
+            self.params = self._refresh_offload(params)
+            return
         if self._prepare_fn is None:
             cfg, dtype = self.cfg, self._dtype
             fuse = self.mesh is None
@@ -297,6 +345,90 @@ class InferenceEngine:
             prepared = _shard_serving_params(prepared, self.cfg, self.mesh)
         self.params = prepared
 
+    def _refresh_offload(self, params: Any) -> Any:
+        """Layer-at-a-time staging into the pinned_host tier."""
+        cfg, dtype = self.cfg, self._dtype
+        if self._quantization and not self._per_channel:
+            raise NotImplementedError(
+                "offload serving with GROUPWISE quantization would "
+                "dequantize the whole tree on device each step; use "
+                "per_channel int8 (streams codes, scales on output)"
+            )
+        dev = jax.devices()[0]
+        host = jax.sharding.SingleDeviceSharding(dev,
+                                                 memory_kind="pinned_host")
+
+        from .quantization import ChannelQuantWeight
+
+        is_cq = lambda x: isinstance(x, ChannelQuantWeight)
+
+        def cast(p):
+            # quantized leaves pass through whole (their f32 scales must
+            # NOT cast to the serving dtype)
+            return jax.tree.map(
+                lambda x: x if is_cq(x) else (
+                    x.astype(dtype)
+                    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                    else jnp.asarray(x)),
+                p, is_leaf=is_cq)
+
+        per_channel = self._per_channel
+
+        def layer_xform(lp):
+            lp = M.prepare_layer(cast(lp), cfg, fuse=True)
+            if per_channel and not any(is_cq(v) for v in lp.values()):
+                lp = M.quantize_layer(lp, cfg)
+            return lp
+
+        if self._layer_xform is None:
+            # one compiled transform per layer; the result is parked to
+            # pinned_host eagerly (in-jit host out_shardings is not
+            # lowered on every backend), so HBM holds a single layer
+            # transiently
+            self._layer_xform = jax.jit(layer_xform)
+            self._top_xform = jax.jit(
+                lambda t: M.quantize_prepared(
+                    {**cast(t), "layers": []}, cfg)
+                if per_channel else cast(t))
+        if M.is_prepared(params):
+            layer_dicts = params["layers"]
+        else:
+            st = params["layers"]
+            L = cfg.n_layers
+            layer_dicts = ({name: w[l] for name, w in st.items()}
+                          for l in range(L))
+        park = lambda lp: jax.tree.map(
+            lambda w: jax.device_put(w, host), lp)
+        layers = [park(self._layer_xform(lp)) for lp in layer_dicts]
+        top_in = {k: v for k, v in params.items() if k != "layers"}
+        top = self._top_xform(top_in)
+        top.pop("layers", None)
+        top["layers"] = layers
+        return top
+
+    def _fetch_layer(self):
+        """In-jit pinned_host→HBM fetch for one layer's weights (None
+        when weights are HBM-resident).
+
+        The fetch is scheduling-barriered on the activations from TWO
+        layers back: without the barrier XLA's scheduler hoists every
+        layer's host stream to the program start — for a
+        bigger-than-HBM model that is an immediate OOM (observed on the
+        19 GiB 70B-width slice). The 2-layer window still overlaps
+        layer l+1's stream with layer l's compute."""
+        if self._offload is None:
+            return None
+        dev_s = jax.sharding.SingleDeviceSharding(
+            jax.devices()[0], memory_kind="device")
+
+        def fetch(lp, dep=None):
+            if dep is not None:
+                lp = jax.tree.map(
+                    lambda w: jax.lax.optimization_barrier((w, dep))[0], lp)
+            return jax.tree.map(lambda w: jax.device_put(w, dev_s), lp)
+
+        return fetch
+
     # -- compiled-step caches -------------------------------------------
     def _prefill_batch_fn(self, bp: int, tp: int):
         """Compiled cross-prompt prefill for batch bucket bp x token
@@ -307,11 +439,12 @@ class InferenceEngine:
         if key not in self._prefill_batch_fns:
             cfg, use_kernel, deq = self.cfg, self._use_kernel, self._dequant
             mesh = self.mesh
+            fetch = self._fetch_layer()
 
             def step(params, cache, tokens, n_real, tables):
                 return M.prefill_batch(
                     deq(params), cache, tokens, n_real, tables, cfg,
-                    use_kernel, mesh=mesh,
+                    use_kernel, mesh=mesh, fetch_layer=fetch,
                 )
 
             self._prefill_batch_fns[key] = jax.jit(step, donate_argnums=(1,))
@@ -322,11 +455,12 @@ class InferenceEngine:
         if key not in self._decode_fns:
             cfg, use_kernel, deq = self.cfg, self._use_kernel, self._dequant
             mesh = self.mesh
+            fetch = self._fetch_layer()
 
             def step(params, cache, tokens, tables, ctx):
                 return M.decode_step(
                     deq(params), cache, tokens, tables, ctx, cfg, use_kernel,
-                    mesh=mesh, unique_rows=unique_rows,
+                    mesh=mesh, unique_rows=unique_rows, fetch_layer=fetch,
                 )
 
             self._decode_fns[key] = jax.jit(step, donate_argnums=(1,))
@@ -347,12 +481,14 @@ class InferenceEngine:
         if key not in self._decode_multi_fns:
             cfg, use_kernel, deq = self.cfg, self._use_kernel, self._dequant
             mesh = self.mesh
+            fetch = self._fetch_layer()
 
             if sampling is None:
                 def step(params, cache, tokens, tables, ctx):
                     return M.decode_multi(
                         deq(params), cache, tokens, tables, ctx, cfg,
                         n_steps=n_steps, use_kernel=use_kernel, mesh=mesh,
+                        fetch_layer=fetch,
                     )
             elif with_presence:
                 def step(params, cache, tokens, tables, ctx, keys, step0,
@@ -361,7 +497,7 @@ class InferenceEngine:
                         deq(params), cache, tokens, tables, ctx, cfg,
                         n_steps=n_steps, use_kernel=use_kernel, mesh=mesh,
                         sampling=sampling, keys=keys, step0=step0,
-                        presence=presence,
+                        presence=presence, fetch_layer=fetch,
                     )
             else:
                 def step(params, cache, tokens, tables, ctx, keys, step0):
@@ -369,6 +505,7 @@ class InferenceEngine:
                         deq(params), cache, tokens, tables, ctx, cfg,
                         n_steps=n_steps, use_kernel=use_kernel, mesh=mesh,
                         sampling=sampling, keys=keys, step0=step0,
+                        fetch_layer=fetch,
                     )
 
             self._decode_multi_fns[key] = jax.jit(step, donate_argnums=(1,))
@@ -840,17 +977,16 @@ class InferenceEngine:
             pres_rows = (np.zeros((width, V), np.uint8)
                          if pres is not None else None)
             for r, u in enumerate(live):
-                seq = self.state.get(u)
-                base = seq.seen_tokens
+                base = self.state.get(u).seen_tokens
                 self.state.extend(u, C)
                 toks[r] = pending[u]
                 ctx[r] = base + 1
                 steps[r] = base + 1  # first in-chunk draw's position
                 row_streams[r] = slot_of[u]
-                tables[r] = self.state.block_table(
-                    [u], self.config.blocks_per_seq, self.pad_block)[0]
                 if pres_rows is not None:
                     pres_rows[r] = pres[slot_of[u]]
+            tables[: len(live)] = self.state.block_table(
+                live, self.config.blocks_per_seq, self.pad_block)
             use_sampler = not (scfg.greedy and not scfg.needs_presence)
             fn = self.decode_multi_fn(
                 width, C,
@@ -897,6 +1033,7 @@ def init_inference(
     dtype=jnp.bfloat16,
     quantization: Optional[Dict[str, Any]] = None,
     mesh: Optional[Mesh] = None,
+    offload: Optional[Dict[str, Any]] = None,
 ) -> InferenceEngine:
     """Build the inference engine (ref: deepspeed/__init__.py
     init_inference:268 → InferenceEngine; config keys follow
@@ -979,9 +1116,15 @@ def init_inference(
                 f"({cfg['tp_size']}) in the inference config; drop one"
             )
         cfg["tp_size"] = size
+    if "offload" in cfg:
+        off = cfg.pop("offload")
+        if offload is not None and offload != off:
+            raise ValueError("conflicting offload in config and kwarg")
+        offload = off
     icfg = InferenceConfig(**cfg)
     return InferenceEngine(model_config, params, icfg, dtype,
-                           quantization=quantization, mesh=mesh)
+                           quantization=quantization, mesh=mesh,
+                           offload=offload)
 
 
 def init_inference_from_hf(

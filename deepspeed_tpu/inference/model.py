@@ -82,21 +82,29 @@ def prepare(params: Dict[str, Any], cfg: T.TransformerConfig,
             f"(got leading dim {lead.shape[0]} != {L}; merge pipeline "
             "partitions before serving)"
         )
-    layers = []
-    for l in range(L):
-        lp = {name: w[l] for name, w in st.items()}
-        if fuse:
-            lp["w_qkv"] = jnp.concatenate(
-                [lp.pop("wq"), lp.pop("wk"), lp.pop("wv")], axis=1)
-            if "bq" in lp:
-                lp["b_qkv"] = jnp.concatenate(
-                    [lp.pop("bq"), lp.pop("bk"), lp.pop("bv")], axis=0)
-            if cfg.n_experts == 0 and cfg.is_gated and "w_gate" in lp:
-                lp["w_gi"] = jnp.concatenate(
-                    [lp.pop("w_gate"), lp.pop("w_in")], axis=1)
-        layers.append(lp)
-    out["layers"] = layers
+    out["layers"] = [
+        prepare_layer({name: w[l] for name, w in st.items()}, cfg, fuse)
+        for l in range(L)
+    ]
     return out
+
+
+def prepare_layer(lp: Dict[str, Any], cfg: T.TransformerConfig,
+                  fuse: bool = True) -> Dict[str, Any]:
+    """One layer's training-layout dict -> serving layout (the per-layer
+    body of prepare(); offload serving stages layers through this one at
+    a time so a bigger-than-HBM model never materializes whole)."""
+    lp = dict(lp)
+    if fuse and "wq" in lp:
+        lp["w_qkv"] = jnp.concatenate(
+            [lp.pop("wq"), lp.pop("wk"), lp.pop("wv")], axis=1)
+        if "bq" in lp:
+            lp["b_qkv"] = jnp.concatenate(
+                [lp.pop("bq"), lp.pop("bk"), lp.pop("bv")], axis=0)
+        if cfg.n_experts == 0 and cfg.is_gated and "w_gate" in lp:
+            lp["w_gi"] = jnp.concatenate(
+                [lp.pop("w_gate"), lp.pop("w_in")], axis=1)
+    return lp
 
 
 # per-layer serving weight name -> (contract_ndim, logical axes) for
@@ -124,6 +132,14 @@ _SERVING_SPECS = {
     "ln2_bias": (None, ("embed",)),
     # MoE expert stacks (never per-channel-quantized; X leading dim)
     "w_router": (None, ("embed", None)),
+    # PR-MoE residual dense expert + mixing coefficient
+    "wr_in": (1, ("embed", "mlp")),
+    "wr_gate": (1, ("embed", "mlp")),
+    "wr_out": (1, ("mlp", "embed")),
+    "br_in": (None, ("mlp",)),
+    "br_out": (None, ("embed",)),
+    "w_coef": (None, ("embed", None)),
+    "b_coef": (None, (None,)),
 }
 _MOE_SPECS = {
     "w_in": ("expert", "embed", "expert_mlp"),
@@ -145,20 +161,23 @@ def quantize_prepared(prepared: Dict[str, Any],
     out["embed"] = channel_quantize(prepared["embed"], 1, scale_first=True)
     if "lm_head" in prepared:
         out["lm_head"] = channel_quantize(prepared["lm_head"], 1)
-    moe = cfg.n_experts > 0
-    layers = []
-    for lp in prepared["layers"]:
-        nlp = dict(lp)
-        for name, w in lp.items():
-            spec = _SERVING_SPECS.get(name)
-            if spec is None or spec[0] is None:
-                continue
-            if moe and name in ("w_gate", "w_in", "w_out"):
-                continue  # expert stacks: keep fp (scanned, not hot)
-            nlp[name] = channel_quantize(w, spec[0])
-        layers.append(nlp)
-    out["layers"] = layers
+    out["layers"] = [quantize_layer(lp, cfg) for lp in prepared["layers"]]
     return out
+
+
+def quantize_layer(lp: Dict[str, Any],
+                   cfg: T.TransformerConfig) -> Dict[str, Any]:
+    """Per-channel int8 for one prepared layer (see quantize_prepared)."""
+    moe = cfg.n_experts > 0
+    nlp = dict(lp)
+    for name, w in lp.items():
+        spec = _SERVING_SPECS.get(name)
+        if spec is None or spec[0] is None:
+            continue
+        if moe and name in ("w_gate", "w_in", "w_out"):
+            continue  # expert stacks: keep fp (scanned, not hot)
+        nlp[name] = channel_quantize(w, spec[0])
+    return nlp
 
 
 def _wmm(eq: str, x, w):
@@ -503,6 +522,25 @@ def _mlp(h, lp, cfg: T.TransformerConfig):
         return acc + wcol[:, None] * y, None
 
     out, _ = jax.lax.scan(expert, jnp.zeros_like(h), tuple(xs))
+    if cfg.moe_use_residual:
+        # PR-MoE serving: dense residual expert + learned mix, matching
+        # the training combine exactly (ref: moe/layer.py use_residual)
+        if has_gate:
+            inner = act(_wmm("te,ef->tf", h, lp["wr_gate"])) \
+                * _wmm("te,ef->tf", h, lp["wr_in"])
+        else:
+            inner = _wmm("te,ef->tf", h, lp["wr_in"])
+            if "br_in" in lp:
+                inner = inner + lp["br_in"].astype(h.dtype)
+            inner = act(inner)
+        dense = _wmm("tf,fe->te", inner, lp["wr_out"])
+        if "br_out" in lp:
+            dense = dense + lp["br_out"].astype(h.dtype)
+        coef = jax.nn.softmax(
+            h.astype(jnp.float32) @ lp["w_coef"].astype(jnp.float32)
+            + lp["b_coef"].astype(jnp.float32), axis=-1)
+        out = (out * coef[:, 0:1].astype(h.dtype)
+               + dense * coef[:, 1:2].astype(h.dtype))
     return out
 
 
@@ -564,7 +602,7 @@ def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None,
 def decode_step(
     params, cache: PagedCache, tokens, tables, ctx_lens, cfg: T.TransformerConfig,
     use_kernel: bool = True, mesh: Optional[Mesh] = None,
-    unique_rows: bool = False,
+    unique_rows: bool = False, fetch_layer=None,
 ):
     """tokens [S] int32, tables [S, NB] int32, ctx_lens [S] int32 (context
     length INCLUDING the new token) → (logits [S, V], new cache).
@@ -579,7 +617,13 @@ def decode_step(
     fused write+attend kernel, halving Pallas launches per layer. The
     caller must also guarantee padding rows' tables point at a reserved
     scratch block (engine: pad_block), since the fused kernel's
-    write-back touches each row's target block."""
+    write-back touches each row's target block.
+
+    fetch_layer: ZeRO-Inference offload serving — a per-layer transform
+    (in-jit pinned_host→HBM device_put) applied as each layer's weights
+    are consumed, so HBM holds O(one layer) of weights instead of the
+    model (ref: docs/_posts/2022-09-10-zero-inference.md full-offload
+    mode; the engine builds it)."""
     S = tokens.shape[0]
     if not is_prepared(params):
         params = prepare(params, cfg, fuse=mesh is None)
@@ -620,7 +664,10 @@ def decode_step(
     flat_idx = jnp.where(valid, flat_idx, jnp.int32(-1))
 
     new_k, new_v = [], []
+    x_hist = []  # layer outputs; fetch l is barriered on output l-2
     for lp in params["layers"]:
+        if fetch_layer is not None:
+            lp = fetch_layer(lp, x_hist[-2] if len(x_hist) >= 2 else None)
         h1 = T._act_quant(T._norm(x, lp["ln1_scale"], lp.get("ln1_bias"), cfg), cfg)
         if "w_qkv" in lp:
             qkv = _wmm("se,ehd->shd", h1, lp["w_qkv"])
@@ -672,6 +719,7 @@ def decode_step(
             h2 = T._act_quant(
                 T._norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg), cfg)
             x = x + _mlp(h2, lp, cfg)
+        x_hist.append(x)
 
     x = T._norm(x, params["ln_f_scale"], params.get("ln_f_bias"), cfg)
     logits = _lm_logits(x, params, cfg)
@@ -684,6 +732,7 @@ def decode_multi(
     cfg: T.TransformerConfig, n_steps: int, use_kernel: bool = True,
     mesh: Optional[Mesh] = None, unique_rows: bool = True,
     sampling=None, keys=None, step0=None, presence=None,
+    fetch_layer=None,
 ):
     """Fused decode: n_steps tokens per compiled program.
 
@@ -717,7 +766,8 @@ def decode_multi(
         toks, ctx, _, cache, pres = carry
         logits, cache = decode_step(params, cache, toks, tables, ctx, cfg,
                                     use_kernel, mesh=mesh,
-                                    unique_rows=unique_rows)
+                                    unique_rows=unique_rows,
+                                    fetch_layer=fetch_layer)
         if sampling is None:
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         else:
@@ -760,7 +810,7 @@ def prefill_step(
 def prefill_batch(
     params, cache: PagedCache, tokens, n_real, tables,
     cfg: T.TransformerConfig, use_kernel: bool = True,
-    mesh: Optional[Mesh] = None,
+    mesh: Optional[Mesh] = None, fetch_layer=None,
 ):
     """Cross-prompt batched prefill: tokens [B, Tp] int32 (padded),
     n_real [B] int32, tables [B, NB] int32 → (last-real-token logits
@@ -799,7 +849,10 @@ def prefill_batch(
     ).reshape(B * Tp)
 
     new_k, new_v = [], []
+    x_hist = []  # layer outputs; fetch l is barriered on output l-2
     for lp in params["layers"]:
+        if fetch_layer is not None:
+            lp = fetch_layer(lp, x_hist[-2] if len(x_hist) >= 2 else None)
         h1 = T._act_quant(T._norm(x, lp["ln1_scale"], lp.get("ln1_bias"), cfg), cfg)
         if "w_qkv" in lp:
             qkv = _wmm("bse,ehd->bshd", h1, lp["w_qkv"])
@@ -875,6 +928,7 @@ def prefill_batch(
             h2 = T._act_quant(
                 T._norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg), cfg)
             x = x + _mlp(h2.reshape(B * Tp, E), lp, cfg).reshape(B, Tp, E)
+        x_hist.append(x)
 
     # logits for each prompt's last REAL token only (logits_gather):
     # gather before the vocab matmul so the head runs on B tokens, not B*Tp

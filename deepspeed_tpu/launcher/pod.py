@@ -1,0 +1,163 @@
+"""Pod-scale launcher: run a training script on every host of a TPU pod
+slice.
+
+TPU-native analog of the reference's multinode launcher
+(ref: launcher/runner.py main:388 + multinode_runner.py PDSHRunner:18 /
+OpenMPIRunner / SlurmRunner — there: parse a hostfile, build a
+pdsh/mpirun command line, propagate env and per-node ranks). On a TPU
+pod the rendezvous half is the platform's: every host already knows its
+coordinator and process index, so `deepspeed_tpu.comm.init_distributed()`
+needs no hostfile, no MASTER_ADDR bookkeeping, no per-rank spawner. What
+a pod launcher still owes the user — and what this module does — is:
+
+  - fan the command out to ALL workers of a slice in one invocation
+    (the `gcloud compute tpus tpu-vm ssh --worker=all` wrapper),
+  - propagate environment variables and the working directory,
+  - aggregate per-host output with `[worker N]` prefixes and save one
+    log file per host (the pdsh output-prefix behavior),
+  - `env-report` across hosts (env_report.py on every worker) and
+    fail-fast status collection (first nonzero exit wins, like
+    launch.py's terminate-on-failure).
+
+Usage:
+  python -m deepspeed_tpu.launcher.pod \
+      --tpu my-slice --zone us-east5-a [--project p] [--workers all] \
+      [--env K=V ...] [--log-dir logs/] [--chdir /path/on/host] \
+      -- python train.py --my-args
+  python -m deepspeed_tpu.launcher.pod --tpu my-slice --zone z env-report
+"""
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional, Sequence
+
+
+def build_worker_command(
+    tpu: str,
+    zone: str,
+    command: Sequence[str],
+    worker: str = "all",
+    project: Optional[str] = None,
+    env: Optional[Dict[str, str]] = None,
+    chdir: Optional[str] = None,
+    gcloud: str = "gcloud",
+) -> List[str]:
+    """The `gcloud ... ssh --worker=W --command=...` line for one worker
+    group (exposed for tests and for users who want the raw command)."""
+    inner = ""
+    if env:
+        inner += " ".join(
+            f"export {k}={shlex.quote(v)};" for k, v in sorted(env.items())
+        ) + " "
+    if chdir:
+        inner += f"cd {shlex.quote(chdir)} && "
+    inner += " ".join(shlex.quote(c) for c in command)
+    cmd = [gcloud, "compute", "tpus", "tpu-vm", "ssh", tpu,
+           f"--zone={zone}", f"--worker={worker}", "--command", inner]
+    if project:
+        cmd.insert(6, f"--project={project}")
+    return cmd
+
+
+def _stream(proc: subprocess.Popen, tag: str, sink) -> None:
+    for line in proc.stdout:  # type: ignore[union-attr]
+        sys.stdout.write(f"[{tag}] {line}")
+        sys.stdout.flush()
+        if sink is not None:
+            sink.write(line)
+
+
+def run_on_pod(
+    tpu: str,
+    zone: str,
+    command: Sequence[str],
+    workers: str = "all",
+    project: Optional[str] = None,
+    env: Optional[Dict[str, str]] = None,
+    chdir: Optional[str] = None,
+    log_dir: Optional[str] = None,
+    gcloud: str = "gcloud",
+) -> int:
+    """Run `command` on the slice. workers='all' fans out in ONE gcloud
+    call (the platform's pdsh); a comma list ('0,2,5') opens one ssh per
+    worker so each gets its own `[worker N]` prefix and log file.
+    Returns the first nonzero exit code (0 when every worker succeeded).
+    """
+    targets = [workers] if workers == "all" else [
+        w.strip() for w in workers.split(",") if w.strip()]
+    procs, threads, sinks = [], [], []
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+    for w in targets:
+        cmd = build_worker_command(tpu, zone, command, worker=w,
+                                   project=project, env=env, chdir=chdir,
+                                   gcloud=gcloud)
+        sink = (open(os.path.join(log_dir, f"worker_{w}.log"), "w")
+                if log_dir else None)
+        p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+        t = threading.Thread(target=_stream, args=(p, f"worker {w}", sink),
+                             daemon=True)
+        t.start()
+        procs.append(p)
+        threads.append(t)
+        sinks.append(sink)
+    rc = 0
+    for p, t, sink in zip(procs, threads, sinks):
+        p.wait()
+        t.join()
+        if sink is not None:
+            sink.close()
+        if p.returncode and not rc:
+            rc = p.returncode
+    return rc
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--tpu", required=True, help="TPU slice name")
+    parser.add_argument("--zone", required=True)
+    parser.add_argument("--project", default=None)
+    parser.add_argument("--workers", default="all",
+                        help="'all' (one fan-out call) or '0,1,...' "
+                        "(per-worker ssh with separate logs)")
+    parser.add_argument("--env", action="append", default=[],
+                        metavar="K=V", help="environment to propagate")
+    parser.add_argument("--chdir", default=None,
+                        help="working directory on each host")
+    parser.add_argument("--log-dir", default=None,
+                        help="write one log file per worker here")
+    parser.add_argument("--gcloud", default="gcloud",
+                        help="gcloud binary (tests stub this)")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="-- python train.py ... | env-report")
+    args = parser.parse_args(argv)
+    cmd = list(args.command)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        parser.error("no command; pass '-- python train.py ...' "
+                     "or 'env-report'")
+    if cmd == ["env-report"]:
+        cmd = [sys.executable.rsplit("/", 1)[-1], "-m",
+               "deepspeed_tpu.env_report"]
+    env = {}
+    for kv in args.env:
+        if "=" not in kv:
+            parser.error(f"--env expects K=V, got {kv!r}")
+        k, v = kv.split("=", 1)
+        env[k] = v
+    return run_on_pod(
+        args.tpu, args.zone, cmd, workers=args.workers,
+        project=args.project, env=env or None, chdir=args.chdir,
+        log_dir=args.log_dir, gcloud=args.gcloud)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

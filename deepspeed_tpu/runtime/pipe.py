@@ -44,6 +44,27 @@ def _is_spec(x):
     return isinstance(x, P)
 
 
+def _constraint_auto_only(t, spec):
+    """with_sharding_constraint with MANUAL mesh axes stripped from the
+    spec — inside the per-worker gradient shard_map (1-bit/0-1/qgZ x
+    pipeline), the data axes are already mapped over and constraints may
+    only name Auto axes (same rule as models/transformer._shard)."""
+    mesh = jax.sharding.get_abstract_mesh()
+    manual = set(getattr(mesh, "manual_axes", ()) or ()) if mesh else set()
+    if manual:
+        def strip(entry):
+            if entry is None:
+                return None
+            axes = (entry,) if isinstance(entry, str) else tuple(entry)
+            live = tuple(a for a in axes if a not in manual)
+            if not live:
+                return None
+            return live[0] if len(live) == 1 else live
+
+        spec = P(*(strip(e) for e in tuple(spec)))
+    return jax.lax.with_sharding_constraint(t, spec)
+
+
 def num_stages(stage_params) -> int:
     return jax.tree.leaves(stage_params)[0].shape[0]
 
@@ -166,7 +187,7 @@ def pipeline_apply(
         if state_spec is None or not has_pipe:
             return tree
         return jax.tree.map(
-            lambda t, s: jax.lax.with_sharding_constraint(t, s) if s is not None else t,
+            lambda t, s: _constraint_auto_only(t, s) if s is not None else t,
             tree,
             state_spec,
             is_leaf=lambda v: v is None or _is_spec(v),
@@ -293,7 +314,7 @@ def pipeline_apply_circular(
         if state_spec is None or not has_pipe:
             return tree
         return jax.tree.map(
-            lambda t, s: jax.lax.with_sharding_constraint(t, s) if s is not None else t,
+            lambda t, s: _constraint_auto_only(t, s) if s is not None else t,
             tree,
             state_spec,
             is_leaf=lambda n: n is None or _is_spec(n),

@@ -389,10 +389,11 @@ def _split_falcon_qkv(w: np.ndarray, cfg: TransformerConfig):
     """Falcon's fused query_key_value: rows are laid out per KV GROUP as
     [q_1..q_per_kv, k, v] (7B multi-query: one group of [q_1..q_H, k, v]).
     w arrives transposed [E, (q_per_kv+2)*KV*D]."""
-    E, H, KV, D = cfg.d_model, cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    H, KV, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
     qpk = H // KV
-    g = w.reshape(E, KV, qpk + 2, D)
-    wq = g[:, :, :qpk, :].reshape(E, H, D)
+    lead = w.shape[0]  # E for weights, 1 for the bias-as-row trick
+    g = w.reshape(lead, KV, qpk + 2, D)
+    wq = g[:, :, :qpk, :].reshape(lead, H, D)
     wk = g[:, :, qpk, :]
     wv = g[:, :, qpk + 1, :]
     return wq, wk, wv
@@ -413,11 +414,16 @@ def _map_falcon_layer(r: _CheckpointReader, i: int,
     if cfg.shared_ln:  # 7B: one layernorm feeds both branches
         out["ln1_scale"] = r.get(p + "input_layernorm.weight")
         out["ln1_bias"] = r.get(p + "input_layernorm.bias")
-    else:  # new_decoder_architecture: ln_attn + ln_mlp
+    elif cfg.parallel_residual:  # new_decoder_architecture: ln_attn+ln_mlp
         out["ln1_scale"] = r.get(p + "ln_attn.weight")
         out["ln1_bias"] = r.get(p + "ln_attn.bias")
         out["ln2_scale"] = r.get(p + "ln_mlp.weight")
         out["ln2_bias"] = r.get(p + "ln_mlp.bias")
+    else:  # old-arch SEQUENTIAL (falcon-rw class, parallel_attn=False)
+        out["ln1_scale"] = r.get(p + "input_layernorm.weight")
+        out["ln1_bias"] = r.get(p + "input_layernorm.bias")
+        out["ln2_scale"] = r.get(p + "post_attention_layernorm.weight")
+        out["ln2_bias"] = r.get(p + "post_attention_layernorm.bias")
     if cfg.has_qkv_bias:
         bq, bk, bv = _split_falcon_qkv(
             r.get(p + "self_attention.query_key_value.bias")[None], cfg)

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Scaling-credibility bench (VERDICT r3 item 5): the 0.45-MFU north
+star (BASELINE.json: Llama-2-70B ZeRO-3 on v5p-256) cannot be verified
+on one chip — what CAN be measured is whether per-layer compute
+efficiency HOLDS as d_model grows from the 350M flagship (d1024) to 7B
+(d4096) and 70B (d8192) layer geometry. This runs a fwd+bwd step over a
+LAYER SLICE of each geometry on the real chip and reports MFU against
+the same 6N+attention flop model bench.py uses. (Optimizer state for a
+70B slice exceeds HBM; fwd+bwd is the part whose efficiency the
+north-star argument needs — the optimizer is bandwidth-trivial per
+PROFILE_r03's roofline note.)
+
+Writes the 'layer_mfu' block of SCALING_r04.json; the ICI projection
+half comes from scripts/ici_projection.py (CPU mesh). docs/PROFILE_r04.md
+assembles the argument.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GEOMETRIES = {
+    # name: (layers, d_model, heads, kv_heads, d_ff, seq, micro)
+    "flagship_350m": (4, 1024, 8, 8, None, 2048, 8),
+    "llama7b_slice": (4, 4096, 32, 32, 11008, 4096, 1),
+    "llama70b_slice": (2, 8192, 64, 8, 28672, 4096, 1),
+}
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models import transformer as T
+    from deepspeed_tpu.platform.accelerator import get_accelerator
+
+    acc = get_accelerator()
+    assert acc.is_tpu(), "scaling bench needs the chip"
+    peak = acc.peak_flops()
+    out = {}
+    for name, (L, E, H, KV, F, S, B) in GEOMETRIES.items():
+        cfg = T.TransformerConfig(
+            vocab_size=32000, n_layers=L, n_heads=H, n_kv_heads=KV,
+            d_model=E, d_ff=F, max_seq=S, variant="llama",
+            remat="save_attn_qkv", use_flash=True,
+            flash_block_q=1024, flash_block_k=1024)
+        params = jax.jit(lambda k, c=cfg: jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16), T.init(c, k))
+        )(jax.random.PRNGKey(0))
+        loss_fn = T.make_loss_fn(cfg, loss_chunks=16)
+
+        @jax.jit
+        def fwdbwd(p, batch):
+            loss, g = jax.value_and_grad(
+                lambda q: loss_fn(q, batch, None))(p)
+            # fold grads into a scalar so nothing params-sized transfers
+            return loss, sum(jnp.sum(x * x) for x in jax.tree.leaves(g))
+
+        r = np.random.default_rng(0)
+        batch = {"tokens": r.integers(0, 32000, (B, S + 1)).astype(np.int32)}
+        loss, gn = fwdbwd(params, batch)
+        np.asarray(jax.device_get(loss))
+        steps = 8
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss, gn = fwdbwd(params, batch)
+        np.asarray(jax.device_get(loss))
+        dt = (time.perf_counter() - t0) / steps
+        # 6N + attention fwd+bwd flops (flops_per_token counts exactly
+        # the train-step model flops; the optimizer's 2N FMA-class work
+        # is excluded by construction of 6N = fwd 2N + bwd 4N)
+        tok = B * S
+        flops = cfg.flops_per_token(S) * tok
+        mfu = flops / dt / peak
+        out[name] = {
+            "layers": L, "d_model": E, "seq": S, "micro_batch": B,
+            "params_m": round(T.param_count(cfg) / 1e6, 1),
+            "step_ms": round(dt * 1e3, 1),
+            "achieved_tflops": round(flops / dt / 1e12, 1),
+            "fwd_bwd_mfu": round(mfu, 4),
+        }
+        print(name, out[name], flush=True)
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "SCALING_r04.json")
+    doc = {}
+    if os.path.exists(path):
+        doc = json.load(open(path))
+    doc["layer_mfu"] = out
+    doc["peak_tflops"] = peak / 1e12
+    json.dump(doc, open(path, "w"), indent=1)
+    print(json.dumps({"scaling": out}))
+
+
+if __name__ == "__main__":
+    main()

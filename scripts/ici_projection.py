@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""ICI-volume projection for the 70B north star (VERDICT r3 item 5,
+second half): compile the 70B-geometry training step over a virtual
+8-device mesh, read EXACT per-collective bytes from the optimized HLO
+(profiling/hlo.collective_volumes), and project per-device ICI time at
+v5p-256 mesh shapes from the ring-collective model:
+
+  bytes_per_device(axis n) = (n-1)/n * payload   (all-gather/reduce-
+  scatter over a ring) — so per-device volume is ~CONSTANT in axis size
+  ((n-1)/n -> 1), and the measured 8-device volumes scale to 256 devices
+  by the payload ratio of the real model vs the slice.
+
+Run under JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8.
+Writes the 'ici_projection' block of SCALING_r04.json.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import transformer as T
+    from deepspeed_tpu.profiling.hlo import collective_volumes
+
+    # The SLICE measures collective STRUCTURE (which collectives, how
+    # many, per what tensor class) on a CPU-executable size; payloads
+    # scale exactly with param bytes (zero3 all-gather/reduce-scatter
+    # move the param/grad tree, TP psums move activations) — the 70B
+    # projection below applies that param ratio analytically.
+    L_SLICE = 2
+    cfg = T.TransformerConfig(
+        vocab_size=32000, n_layers=L_SLICE, n_heads=16, n_kv_heads=8,
+        d_model=2048, max_seq=128, variant="llama", use_flash=False)
+    engine = ds.initialize(
+        {"train_micro_batch_size_per_gpu": 1,
+         "gradient_accumulation_steps": 1,
+         "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+         "zero_optimization": {"stage": 3, "param_persistence_threshold": 0},
+         "bf16": {"enabled": True},
+         "mesh": {"zero": 2, "model": 4},
+         "steps_per_print": 10**9},
+        loss_fn=T.make_loss_fn(cfg, loss_chunks=1),
+        param_init_fn=lambda k: T.init(cfg, k),
+        param_logical_specs=T.logical_specs(cfg))
+    batch = {"tokens": np.zeros(
+        (engine.config.train_batch_size, 129), np.int32)}
+    compiled = engine.compile_train_step(batch) if hasattr(
+        engine, "compile_train_step") else None
+    if compiled is None:
+        # compile via one step, then read the cached executable
+        engine.train_batch(batch)
+        compiled = next(iter(engine._train_compiled_cache.values()))
+    vols = collective_volumes(compiled)
+    total_mb = sum(v["bytes"] for v in vols.values()) / 1e6
+
+    # projection: per-device ring-collective bytes are (n-1)/n * payload
+    # — payload scales with the param bytes. Slice -> 70B by the exact
+    # param-count ratio; measured axis-2 ring factor (1/2) -> axis-256
+    # ((255/256)): < 2x upper bound. v5p ICI is ~100 GB/s-class
+    # effective per chip (conservative).
+    cfg70 = T.TransformerConfig(
+        vocab_size=32000, n_layers=80, n_heads=64, n_kv_heads=8,
+        d_model=8192, d_ff=28672, max_seq=4096, variant="llama",
+        use_flash=False)
+    param_scale = T.param_count(cfg70) / T.param_count(cfg)
+    ring_scale = (255 / 256) / (1 / 2)  # 1.99x upper bound
+    proj_bytes = total_mb * 1e6 * param_scale * ring_scale
+    ici_gbps = 100e9
+    out = {
+        "mesh": "zero=2 x model=4 (virtual, 8 devices)",
+        "slice_layers": L_SLICE,
+        "slice_params_m": round(T.param_count(cfg) / 1e6, 1),
+        "param_scale_to_70b": round(param_scale, 1),
+        "per_collective_mb": {k: round(v["bytes"] / 1e6, 2)
+                              for k, v in vols.items()},
+        "slice_total_mb_per_step": round(total_mb, 1),
+        "projected_70b_gb_per_step_upper": round(proj_bytes / 1e9, 1),
+        "ici_seconds_at_100GBps": round(proj_bytes / ici_gbps, 3),
+    }
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "SCALING_r04.json")
+    doc = {}
+    if os.path.exists(path):
+        doc = json.load(open(path))
+    doc["ici_projection"] = out
+    json.dump(doc, open(path, "w"), indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

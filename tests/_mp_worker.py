@@ -28,7 +28,8 @@ def main():
     # env:// discovery path of init_distributed (ref: comm.py:604)
     ds.comm.init_distributed()
     assert ds.comm.is_initialized()
-    assert ds.comm.get_process_count() == 2, ds.comm.get_process_count()
+    n_procs = int(os.environ["WORLD_SIZE"])
+    assert ds.comm.get_process_count() == n_procs, ds.comm.get_process_count()
     assert ds.comm.get_world_size() == 8, ds.comm.get_world_size()
     assert ds.comm.get_rank() == rank
 

@@ -298,6 +298,21 @@ class TestFamilyZoo:
         assert cfg.parallel_residual and not cfg.shared_ln
         assert cfg.kv_heads == 2
 
+    def test_falcon_sequential_form(self, rng, tmp_path):
+        """old-arch NON-parallel rotary falcon (falcon-rw shape minus
+        alibi): sequential residuals, input/post_attention layernorms."""
+        torch.manual_seed(25)
+        hf_cfg = transformers.FalconConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, new_decoder_architecture=False,
+            multi_query=False, parallel_attn=False, bias=True, alibi=False,
+            tie_word_embeddings=True)
+        m = transformers.FalconForCausalLM(hf_cfg).eval()
+        path = _save(m, tmp_path)
+        cfg, _ = self._check(m, path, rng)
+        assert not cfg.parallel_residual and not cfg.shared_ln
+        assert cfg.has_qkv_bias and cfg.kv_heads == 4
+
     def test_falcon_alibi_rejected(self, tmp_path):
         with pytest.raises(ValueError, match="alibi"):
             config_from_hf({"architectures": ["FalconForCausalLM"],

@@ -618,6 +618,77 @@ class TestZeroInferenceQuantization:
         assert len(outs[0]) == 4
 
 
+class TestZeroInferenceOffload:
+    """Full-offload serving (ref: docs/_posts/2022-09-10-zero-inference
+    .md:52): layer weights park in pinned_host and stream into device
+    memory inside the compiled step — HBM holds O(one layer) of weights
+    plus the hot set (embed/head/norms)."""
+
+    def _pair(self, rng, quant=None):
+        cfg, params = small_model()
+        plain = engine_for(cfg, params)
+        off = init_inference(
+            params, cfg,
+            dict(max_seq_len=64, kv_block_size=8, num_kv_blocks=32,
+                 min_prefill_bucket=8, max_batch_size=8),
+            dtype=jnp.float32, quantization=quant,
+            offload={"device": "cpu"})
+        return cfg, plain, off
+
+    def test_layers_parked_host_top_resident(self, rng):
+        _, plain, off = self._pair(rng)
+        for lp in off.params["layers"]:
+            for w in jax.tree.leaves(lp):
+                assert w.sharding.memory_kind == "pinned_host"
+        assert off.params["embed"].sharding.memory_kind != "pinned_host"
+
+    def test_matches_resident_engine(self, rng):
+        cfg, plain, off = self._pair(rng)
+        prompts = [np.asarray(rng.integers(0, 128, n), np.int32)
+                   for n in (9, 4)]
+        l1 = plain.put([0, 1], [p.copy() for p in prompts])
+        l2 = off.put([0, 1], [p.copy() for p in prompts])
+        np.testing.assert_allclose(l2, l1, rtol=2e-5, atol=2e-5)
+        for _ in range(3):
+            nxt = [np.argmax(l1[i])[None].astype(np.int32) for i in range(2)]
+            l1 = plain.put([0, 1], nxt)
+            l2 = off.put([0, 1], nxt)
+            np.testing.assert_allclose(l2, l1, rtol=2e-5, atol=2e-5)
+
+    def test_generate_and_int8_compose(self, rng):
+        cfg, plain, off8 = None, None, None
+        cfg, params = small_model()
+        plain = engine_for(cfg, params)
+        off8 = init_inference(
+            params, cfg,
+            dict(max_seq_len=64, kv_block_size=8, num_kv_blocks=32,
+                 min_prefill_bucket=8, max_batch_size=8),
+            dtype=jnp.float32,
+            quantization={"bits": 8, "per_channel": True},
+            offload={"device": "cpu"})
+        from deepspeed_tpu.inference.quantization import ChannelQuantWeight
+
+        lp0 = off8.params["layers"][0]
+        assert isinstance(lp0["w_qkv"], ChannelQuantWeight)
+        assert lp0["w_qkv"].q.sharding.memory_kind == "pinned_host"
+        prompts = [list(rng.integers(0, 128, 6))]
+        out = off8.generate(prompts, max_new_tokens=5)
+        assert len(out[0]) == 5
+
+    def test_nvme_and_tp_rejected(self, rng):
+        cfg, params = small_model()
+        with pytest.raises(NotImplementedError, match="cpu"):
+            init_inference(params, cfg, dict(max_seq_len=32),
+                           offload={"device": "nvme"})
+        cfg2, params2 = small_model(n_heads=8)
+        with pytest.raises(NotImplementedError, match="TP mesh"):
+            init_inference(params2, cfg2,
+                           dict(max_seq_len=64, kv_block_size=8,
+                                num_kv_blocks=32, min_prefill_bucket=8,
+                                max_batch_size=8, tp_size=2),
+                           offload={"device": "cpu"})
+
+
 class TestDecodeMulti:
     def test_fused_matches_stepwise_greedy(self, rng):
         """decode_multi == argmax-fed loop of decode_step (exact)."""

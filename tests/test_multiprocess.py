@@ -22,13 +22,13 @@ pytestmark = pytest.mark.slow
 TIMEOUT_S = 420
 
 
-def test_two_process_world(tmp_path, capsys):
+def _run_world(tmp_path, capsys, num_procs, devices_per_proc):
     worker = os.path.join(os.path.dirname(__file__), "_mp_worker.py")
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(worker)))
     rc = launch_local(
         [sys.executable, worker, str(tmp_path / "ckpt")],
-        num_procs=2,
-        devices_per_proc=4,
+        num_procs=num_procs,
+        devices_per_proc=devices_per_proc,
         env_extra={
             "PYTHONPATH": repo_root,
             "XLA_FLAGS": "",  # drop the parent's 8-device flag
@@ -39,7 +39,18 @@ def test_two_process_world(tmp_path, capsys):
     out = capsys.readouterr().out
     assert rc == 0, out
     lines = sorted(l for l in out.splitlines() if "WORKER-OK" in l)
-    assert len(lines) == 2, out
-    # both controllers computed the identical global trajectory
+    assert len(lines) == num_procs, out
+    # all controllers computed the identical global trajectory
     tail = [l.split("losses=")[1] for l in lines]
-    assert tail[0] == tail[1], lines
+    assert all(t == tail[0] for t in tail), lines
+
+
+def test_two_process_world(tmp_path, capsys):
+    _run_world(tmp_path, capsys, num_procs=2, devices_per_proc=4)
+
+
+def test_four_process_world(tmp_path, capsys):
+    """4 controllers x 2 devices (VERDICT r3 item 10): the multi-host
+    orbax save/restore + divergence hash inside _mp_worker run across a
+    4-process world."""
+    _run_world(tmp_path, capsys, num_procs=4, devices_per_proc=2)

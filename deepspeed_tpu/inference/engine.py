@@ -229,7 +229,7 @@ class InferenceEngine:
                     "lower max_seq_len so its bucket fits"
                 )
         self._offload = None
-        if offload:
+        if offload is not None:  # {} is a config error, not "disabled"
             dev = offload.get("device")
             if dev == "nvme":
                 raise NotImplementedError(
@@ -390,16 +390,24 @@ class InferenceEngine:
                 lambda t: M.quantize_prepared(
                     {**cast(t), "layers": []}, cfg)
                 if per_channel else cast(t))
-        if M.is_prepared(params):
-            layer_dicts = params["layers"]
-        else:
-            st = params["layers"]
-            L = cfg.n_layers
+        st = params["layers"]
+        if isinstance(st, dict):  # training layout: stacked [L, ...]
             layer_dicts = ({name: w[l] for name, w in st.items()}
-                          for l in range(L))
+                           for l in range(cfg.n_layers))
+        else:
+            # per-layer list, or the lazy HF import's single-use
+            # generator (import_external(lazy_layers=True))
+            layer_dicts = st
         park = lambda lp: jax.tree.map(
             lambda w: jax.device_put(w, host), lp)
         layers = [park(self._layer_xform(lp)) for lp in layer_dicts]
+        if len(layers) != cfg.n_layers:
+            raise ValueError(
+                f"offload staging got {len(layers)} layers for a "
+                f"{cfg.n_layers}-layer model — an exhausted single-use "
+                "lazy import generator (re-import for a second engine) "
+                "or a pipeline-partitioned stack (merge partitions first)"
+            )
         top_in = {k: v for k, v in params.items() if k != "layers"}
         top = self._top_xform(top_in)
         top.pop("layers", None)
@@ -1133,14 +1141,23 @@ def init_inference_from_hf(
     dtype=jnp.bfloat16,
     quantization: Optional[Dict[str, Any]] = None,
     mesh: Optional[Mesh] = None,
+    offload: Optional[Dict[str, Any]] = None,
     **config_overrides,
 ) -> InferenceEngine:
     """Serve an HF-format checkpoint directory: import + init_inference
     (the build_hf_engine analog, ref: inference/v2/engine_factory.py:67).
     config_overrides adjust the derived TransformerConfig (e.g.
-    attention_impl, use_flash)."""
+    attention_impl, use_flash).
+
+    With offload={"device": "cpu"} the import is LAZY: layers stream
+    from the checkpoint files one at a time straight into the
+    pinned_host tier, so a checkpoint larger than free host-RAM
+    headroom (let alone HBM) never materializes whole anywhere."""
     from ..utils.hf_checkpoint import import_external
 
-    model_cfg, params = import_external(path, **config_overrides)
+    lazy = offload is not None or bool((config or {}).get("offload"))
+    model_cfg, params = import_external(path, lazy_layers=lazy,
+                                        **config_overrides)
     return init_inference(params, model_cfg, config, dtype,
-                          quantization=quantization, mesh=mesh)
+                          quantization=quantization, mesh=mesh,
+                          offload=offload)

@@ -106,7 +106,24 @@ def run_on_pod(
         procs.append(p)
         threads.append(t)
         sinks.append(sink)
+    # fail-fast (launch.py terminate-on-failure semantics): poll ALL
+    # workers; the first nonzero exit terminates the rest — a dead peer
+    # leaves survivors hung in collectives otherwise
+    import time
+
     rc = 0
+    live = list(procs)
+    while live:
+        for p in list(live):
+            code = p.poll()
+            if code is None:
+                continue
+            live.remove(p)
+            if code and not rc:
+                rc = code
+                for q in live:
+                    q.terminate()
+        time.sleep(0.05)
     for p, t, sink in zip(procs, threads, sinks):
         p.wait()
         t.join()
@@ -145,8 +162,9 @@ def main(argv=None) -> int:
         parser.error("no command; pass '-- python train.py ...' "
                      "or 'env-report'")
     if cmd == ["env-report"]:
-        cmd = [sys.executable.rsplit("/", 1)[-1], "-m",
-               "deepspeed_tpu.env_report"]
+        # fixed interpreter name: the LOCAL sys.executable's basename
+        # (conda/pyenv spellings) may not exist on the pod VMs
+        cmd = ["python3", "-m", "deepspeed_tpu.env_report"]
     env = {}
     for kv in args.env:
         if "=" not in kv:

@@ -521,6 +521,7 @@ def _gpt2_top(r: _CheckpointReader) -> Dict[str, str]:
 def import_external(
     path: str,
     dtype: Optional[Any] = None,
+    lazy_layers: bool = False,
     **config_overrides,
 ) -> Tuple[TransformerConfig, Dict[str, Any]]:
     """Load an HF-format checkpoint directory into the in-tree family.
@@ -533,6 +534,13 @@ def import_external(
     dtype: optional numpy/jax dtype to cast floating weights to during
     import (default: keep the checkpoint's dtype; serving casts again to
     the engine dtype anyway).
+
+    lazy_layers=True: params["layers"] is a GENERATOR of per-layer
+    dicts instead of the stacked [L, ...] arrays — peak host memory is
+    one layer, so a checkpoint larger than host RAM headroom can stream
+    straight into the offload serving tier (the engine's
+    _refresh_offload consumes exactly this shape; r3 VERDICT weak #7).
+    The generator is single-use.
 
     ref: inference/v2/checkpoint/huggingface_engine.py:1 +
     engine_factory.py:67 build_hf_engine.
@@ -561,7 +569,7 @@ def import_external(
     if arch == "GPT2LMHeadModel":
         top = _gpt2_top(r)
         params = {k: cast(r.get(v)) for k, v in top.items()}
-        layer_maps = [_map_gpt2_layer(r, i, cfg) for i in range(cfg.n_layers)]
+        layer_fn = lambda i: _map_gpt2_layer(r, i, cfg)
     elif arch == "OPTForCausalLM":
         pre = ("model.decoder." if "model.decoder.embed_tokens.weight" in r
                else "decoder.")
@@ -574,8 +582,7 @@ def import_external(
         }
         if not cfg.tie_embeddings:
             params["lm_head"] = cast(r.get("lm_head.weight").T)
-        layer_maps = [_map_opt_layer(r, i, cfg, pre)
-                      for i in range(cfg.n_layers)]
+        layer_fn = lambda i: _map_opt_layer(r, i, cfg, pre)
     elif arch in ("FalconForCausalLM", "RWForCausalLM"):
         params = {
             "embed": cast(r.get("transformer.word_embeddings.weight")),
@@ -584,8 +591,7 @@ def import_external(
         }
         if not cfg.tie_embeddings:
             params["lm_head"] = cast(r.get("lm_head.weight").T)
-        layer_maps = [_map_falcon_layer(r, i, cfg)
-                      for i in range(cfg.n_layers)]
+        layer_fn = lambda i: _map_falcon_layer(r, i, cfg)
     elif arch == "PhiForCausalLM":
         params = {
             "embed": cast(r.get("model.embed_tokens.weight")),
@@ -594,7 +600,7 @@ def import_external(
             "lm_head": cast(r.get("lm_head.weight").T),
             "lm_head_b": cast(r.get("lm_head.bias")),
         }
-        layer_maps = [_map_phi_layer(r, i, cfg) for i in range(cfg.n_layers)]
+        layer_fn = lambda i: _map_phi_layer(r, i, cfg)
     elif arch == "QWenLMHeadModel":
         params = {
             "embed": cast(r.get("transformer.wte.weight")),
@@ -602,7 +608,7 @@ def import_external(
         }
         if not cfg.tie_embeddings:
             params["lm_head"] = cast(r.get("lm_head.weight").T)
-        layer_maps = [_map_qwen_layer(r, i, cfg) for i in range(cfg.n_layers)]
+        layer_fn = lambda i: _map_qwen_layer(r, i, cfg)
     else:
         params = {
             "embed": cast(r.get("model.embed_tokens.weight")),
@@ -610,8 +616,21 @@ def import_external(
         }
         if not cfg.tie_embeddings:
             params["lm_head"] = cast(r.get("lm_head.weight").T)
-        layer_maps = [_map_llama_layer(r, i, cfg) for i in range(cfg.n_layers)]
+        layer_fn = lambda i: _map_llama_layer(r, i, cfg)
 
+    if lazy_layers:
+        # single-use per-layer stream: peak host memory = one layer
+        params["layers"] = (
+            {k: cast(v) for k, v in layer_fn(i).items()}
+            for i in range(cfg.n_layers)
+        )
+        log_dist(
+            f"imported HF checkpoint {path} (lazy layers): "
+            f"{hf.get('architectures')} {cfg.n_layers} layers", ranks=[0],
+        )
+        return cfg, params
+
+    layer_maps = [layer_fn(i) for i in range(cfg.n_layers)]
     params["layers"] = {
         name: cast(np.stack([lm[name] for lm in layer_maps]))
         for name in layer_maps[0]

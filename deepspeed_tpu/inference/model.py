@@ -552,9 +552,14 @@ def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None,
     returned (att, ck, cv) includes the in-kernel RMW)."""
     fused = k_new is not None
     if allowed_slots is not None and use_kernel and _tp_size(mesh) <= 1:
-        # block-sparse serving on the Pallas kernel: the layout rides in
-        # as a per-slot bitmap (scalar prefetch) and pruned slots skip
-        # compute entirely
+        # block-sparse serving on the Pallas kernels: the layout rides
+        # in as a per-slot bitmap. Fused+v2 skips pruned slots' DMA
+        # entirely; the (S, NB)-grid kernel clamps them to a resident
+        # tile (still no fresh DMA, but a grid step each).
+        if fused and supports_fused_v2(q.shape[-1]):
+            return paged_decode_fused(q, ck, cv, table, ctx,
+                                      k_new, v_new, slots, window=window,
+                                      allowed_slots=allowed_slots)
         return paged_decode_attention(q, ck, cv, table, ctx, window=window,
                                       allowed_slots=allowed_slots,
                                       k_new=k_new, v_new=v_new, slots=slots)

@@ -33,6 +33,7 @@ import shlex
 import subprocess
 import sys
 import threading
+import time
 from typing import Dict, List, Optional, Sequence
 
 
@@ -56,8 +57,13 @@ def build_worker_command(
     if chdir:
         inner += f"cd {shlex.quote(chdir)} && "
     inner += " ".join(shlex.quote(c) for c in command)
+    # -tt forces a pty: killing the local ssh client then HUPs the
+    # remote session, so fail-fast termination reaches the WORKERS, not
+    # just the local gcloud processes (otherwise survivors hold the
+    # slice hung in collectives)
     cmd = [gcloud, "compute", "tpus", "tpu-vm", "ssh", tpu,
-           f"--zone={zone}", f"--worker={worker}", "--command", inner]
+           f"--zone={zone}", f"--worker={worker}", "--ssh-flag=-tt",
+           "--command", inner]
     if project:
         cmd.insert(6, f"--project={project}")
     return cmd
@@ -107,10 +113,9 @@ def run_on_pod(
         threads.append(t)
         sinks.append(sink)
     # fail-fast (launch.py terminate-on-failure semantics): poll ALL
-    # workers; the first nonzero exit terminates the rest — a dead peer
-    # leaves survivors hung in collectives otherwise
-    import time
-
+    # workers; the first nonzero exit terminates the rest (pty-backed
+    # ssh, so the HUP reaches the remote processes — see
+    # build_worker_command)
     rc = 0
     live = list(procs)
     while live:
@@ -124,13 +129,10 @@ def run_on_pod(
                 for q in live:
                     q.terminate()
         time.sleep(0.05)
-    for p, t, sink in zip(procs, threads, sinks):
-        p.wait()
+    for t, sink in zip(threads, sinks):
         t.join()
         if sink is not None:
             sink.close()
-        if p.returncode and not rc:
-            rc = p.returncode
     return rc
 
 

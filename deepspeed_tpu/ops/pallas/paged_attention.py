@@ -322,12 +322,12 @@ def paged_decode_attention_xla(q, k_cache, v_cache, block_table, ctx_lens,
 # ---------------------------------------------------------------------------
 
 def _decode_fused_kernel(
-    tbl_ref, ctx_ref, slot_ref,                     # scalar prefetch
+    tbl_ref, ctx_ref, slot_ref, allow_ref,          # scalar prefetch
     q_ref, kn_ref, vn_ref, k_any, v_any,            # inputs (caches in HBM)
     o_ref, ck_any, cv_any,                          # outputs (caches aliased)
     bufk, bufv, wsem, lsem,                         # scratch
     *, n_seqs: int, block_size: int, scale: float, n_kv: int, gp: int,
-    window: int,
+    window: int, sparse: bool,
 ):
     """One grid step per SEQUENCE (compile size O(1) in batch — an
     earlier all-sequences-unrolled variant ran ~8us/call faster at S=8
@@ -339,7 +339,14 @@ def _decode_fused_kernel(
     and its attention contribution enters as one extra online-softmax
     column from VMEM. Scratch persists across grid steps, so each step
     prefetches the NEXT sequence's first block (buffer sets alternate by
-    sequence parity) — the common short-context case never stalls."""
+    sequence parity) — the common short-context case never stalls.
+
+    sparse: block-sparse layouts ride in as the allow_ref bitmap — a
+    disallowed slot's load is never ISSUED (its iteration neither waits
+    nor computes; block j+1's load is issued by iteration j regardless
+    of j's own allow bit, so pipelining is preserved across gaps). The
+    (S, NB)-grid kernel could only clamp a pruned slot's DMA to a
+    resident tile; here pruned slots are genuinely free."""
     bs = block_size
     D = q_ref.shape[-1]
     s = pl.program_id(0)
@@ -349,6 +356,11 @@ def _decode_fused_kernel(
 
     def nblk_of(ctx):
         return pl.cdiv(jnp.maximum(ctx - 1, 0), bs)
+
+    def allowed(sq, j):
+        if not sparse:
+            return True
+        return allow_ref[sq, j] != 0
 
     def load(sq, bufset, j, buf_slot):
         blk = tbl_ref[sq, j]
@@ -361,7 +373,7 @@ def _decode_fused_kernel(
         ctx = ctx_ref[sq]
         jb = jbase_of(ctx)
 
-        @pl.when(jb < nblk_of(ctx))
+        @pl.when(jnp.logical_and(jb < nblk_of(ctx), allowed(sq, jb)))
         def _():
             load(sq, sq % 2, jb, jb % 2)
 
@@ -382,20 +394,33 @@ def _decode_fused_kernel(
         ms, ls, accs = carry  # per-head tuples: (Gp,1),(Gp,1),(Gp,D)
         bslot = j % 2
 
-        @pl.when(j + 1 < nblk_of(ctx))
+        @pl.when(jnp.logical_and(j + 1 < nblk_of(ctx), allowed(s, j + 1)))
         def _prefetch_next():
             load(s, bufset, j + 1, (j + 1) % 2)
 
-        pltpu.make_async_copy(k_any.at[0], bufk.at[bufset, bslot],
-                              lsem.at[bufset, bslot, 0]).wait()
-        pltpu.make_async_copy(v_any.at[0], bufv.at[bufset, bslot],
-                              lsem.at[bufset, bslot, 1]).wait()
-        kb = bufk[bufset, bslot]  # (bs, KV, D)
-        vb = bufv[bufset, bslot]
+        ok = allowed(s, j)
         cols = j * bs + jax.lax.broadcasted_iota(jnp.int32, (gp, bs), 1)
         live = cols < L
         if window > 0:
             live = jnp.logical_and(live, cols >= ctx - window)
+        if sparse:
+            # a disallowed block has no in-flight DMA: don't wait, and
+            # mask every column so the accumulators pass through
+            live = jnp.logical_and(live, ok)
+
+            @pl.when(ok)
+            def _wait_allowed():
+                pltpu.make_async_copy(k_any.at[0], bufk.at[bufset, bslot],
+                                      lsem.at[bufset, bslot, 0]).wait()
+                pltpu.make_async_copy(v_any.at[0], bufv.at[bufset, bslot],
+                                      lsem.at[bufset, bslot, 1]).wait()
+        else:
+            pltpu.make_async_copy(k_any.at[0], bufk.at[bufset, bslot],
+                                  lsem.at[bufset, bslot, 0]).wait()
+            pltpu.make_async_copy(v_any.at[0], bufv.at[bufset, bslot],
+                                  lsem.at[bufset, bslot, 1]).wait()
+        kb = bufk[bufset, bslot]  # (bs, KV, D)
+        vb = bufv[bufset, bslot]
         ms2, ls2, accs2 = [], [], []
         for h in range(n_kv):
             q = q_ref[s, h]  # (Gp, D)
@@ -404,9 +429,17 @@ def _decode_fused_kernel(
             m_new = jnp.maximum(ms[h], jnp.max(st, axis=1, keepdims=True))
             p = jnp.exp(st - m_new)
             corr = jnp.exp(ms[h] - m_new)
-            ls2.append(ls[h] * corr + jnp.sum(p, axis=1, keepdims=True))
-            accs2.append(accs[h] * corr + _dot(p.astype(vb.dtype),
-                                               vb[:, h, :]))
+            l_new = ls[h] * corr + jnp.sum(p, axis=1, keepdims=True)
+            a_new = accs[h] * corr + _dot(p.astype(vb.dtype), vb[:, h, :])
+            if sparse:
+                # disallowed block: carry passes through untouched (the
+                # stale buffer's garbage and the all--inf exp NaNs are in
+                # the UNSELECTED where branch — never propagated)
+                m_new = jnp.where(ok, m_new, ms[h])
+                l_new = jnp.where(ok, l_new, ls[h])
+                a_new = jnp.where(ok, a_new, accs[h])
+            ls2.append(l_new)
+            accs2.append(a_new)
             ms2.append(m_new)
         return tuple(ms2), tuple(ls2), tuple(accs2)
 
@@ -478,11 +511,13 @@ def supports_fused_v2(head_dim: int) -> bool:
 
 
 def paged_decode_fused(q, k_cache, v_cache, block_table, ctx_lens,
-                       k_new, v_new, slots, window: int = 0):
+                       k_new, v_new, slots, window: int = 0,
+                       allowed_slots=None):
     """Fused single-token decode: write the batch's new KV rows into the
-    paged arenas AND attend over them, one kernel launch. The dense hot
-    path of the serving engine (sparse layouts keep _decode_kernel's
-    bitmap grid).
+    paged arenas AND attend over them, one kernel launch. The serving
+    engine's hot path for dense AND (via allowed_slots) block-sparse
+    layouts; only D % 128 != 0 models fall back to _decode_kernel's
+    bitmap grid.
 
     Same contract as paged_decode_attention's fused mode: rows are
     DISTINCT sequences; ctx INCLUDES the new token; slots [S] are the
@@ -490,14 +525,22 @@ def paged_decode_fused(q, k_cache, v_cache, block_table, ctx_lens,
     Returns (out [S, H, D], k_cache, v_cache) with the arenas updated in
     place (donate them).
 
+    allowed_slots: optional [S, NB] block-sparse bitmap — disallowed
+    slots are never DMA'd at all (the (S, NB)-grid kernel could only
+    clamp them to a resident tile).
+
     Requires head_dim % 128 == 0: the per-row (KV, D) write DMA must be
     lane-aligned (D=64 models route to paged_decode_attention's fused
     mode instead — see supports_fused_v2)."""
     S, H, D = q.shape
     NBLK, bs, KV, _ = k_cache.shape
+    NB = block_table.shape[1]
     G = H // KV
     Gp = max(G, 8)
     scale = 1.0 / (D**0.5)
+    sparse = allowed_slots is not None
+    allow = (allowed_slots.astype(jnp.int32) if sparse
+             else jnp.zeros((S, NB), jnp.int32))
 
     qg = q.reshape(S, KV, G, D)
     if Gp != G:
@@ -505,7 +548,7 @@ def paged_decode_fused(q, k_cache, v_cache, block_table, ctx_lens,
 
     vmem = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(S,),
         in_specs=[
             vmem(), vmem(), vmem(),
@@ -527,7 +570,7 @@ def paged_decode_fused(q, k_cache, v_cache, block_table, ctx_lens,
     out, ck, cv = pl.pallas_call(
         functools.partial(
             _decode_fused_kernel, n_seqs=S, block_size=bs, scale=scale,
-            n_kv=KV, gp=Gp, window=window,
+            n_kv=KV, gp=Gp, window=window, sparse=sparse,
         ),
         grid_spec=grid_spec,
         out_shape=[
@@ -535,10 +578,10 @@ def paged_decode_fused(q, k_cache, v_cache, block_table, ctx_lens,
             jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
             jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
         ],
-        # args: 3 scalar prefetch, q, kn, vn, k_cache, v_cache
-        input_output_aliases={6: 1, 7: 2},
+        # args: 4 scalar prefetch, q, kn, vn, k_cache, v_cache
+        input_output_aliases={7: 1, 8: 2},
         interpret=_interpret(),
-    )(block_table, ctx_lens, slots.astype(jnp.int32), qg,
+    )(block_table, ctx_lens, slots.astype(jnp.int32), allow, qg,
       k_new, v_new, k_cache, v_cache)
     return out[:, :, :G, :].reshape(S, H, D), ck, cv
 

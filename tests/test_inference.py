@@ -203,6 +203,32 @@ class TestFusedWriteAttend:
         np.testing.assert_allclose(ck, rk, rtol=1e-6, atol=1e-6)
         np.testing.assert_allclose(cv, rv, rtol=1e-6, atol=1e-6)
 
+    def test_v2_kernel_sparse_bitmap(self, rng):
+        """Block-sparse on the manual-DMA kernel: pruned slots are never
+        DMA'd; output matches the masked oracle."""
+        from deepspeed_tpu.ops.pallas.paged_attention import (
+            paged_decode_fused)
+
+        q, kc, vc, tbl, ctx, kn, vn, slots = self._setup(
+            rng, S=4, KV=2, G=2, D=128, bs=16, NBLK=32, NB=4,
+            ctx_vals=(17, 33, 64, 0))
+        tbl = tbl.at[3].set(31)
+        slots = slots.at[3].set(-1)
+        S, NB, bs = 4, 4, 16
+        lay = np.asarray(rng.integers(0, 2, (S, NB)), np.int32)
+        for s in range(3):
+            lay[s, (int(ctx[s]) - 1) // bs] = 1  # own-token slot allowed
+        allowed_pos = jnp.repeat(jnp.asarray(lay).astype(bool), bs, axis=1)
+        with jax.default_matmul_precision("highest"):
+            out, ck, cv = paged_decode_fused(
+                q, kc.copy(), vc.copy(), tbl, ctx, kn, vn, slots,
+                allowed_slots=jnp.asarray(lay))
+            ref, rk, rv = self._oracle(q, kc, vc, tbl, ctx, kn, vn, slots,
+                                       allowed=allowed_pos)
+        np.testing.assert_allclose(out[:3], ref[:3], rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(ck, rk, rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(cv, rv, rtol=1e-6, atol=1e-6)
+
     @pytest.mark.parametrize("window", [0, 40])
     def test_v2_kernel_matches_oracle(self, rng, window):
         """The per-sequence-grid manual-DMA kernel (paged_decode_fused,

@@ -71,6 +71,8 @@ def build_worker_command(
 
 def _stream(proc: subprocess.Popen, tag: str, sink) -> None:
     for line in proc.stdout:  # type: ignore[union-attr]
+        # the forced pty (-tt) CRLF-terminates remote output
+        line = line.rstrip("\r\n") + "\n"
         sys.stdout.write(f"[{tag}] {line}")
         sys.stdout.flush()
         if sink is not None:

@@ -12,6 +12,7 @@ numerics, fwd AND bwd (rematerialized per chunk) — XLA fuses the bias
 adds into the score computation.
 """
 
+import functools
 from typing import Optional, Sequence
 
 import jax
@@ -90,3 +91,80 @@ def evoformer_attention(
     (m, l, acc), _ = jax.lax.scan(body, (m0, l0, a0), jnp.arange(n_chunks))
     out = (acc / l[..., None]).astype(q.dtype)
     return jnp.moveaxis(out, -3, -2)
+
+
+# ---------------------------------------------------------------------------
+# reference-contract surface with the fused Pallas forward
+# ---------------------------------------------------------------------------
+
+def _kernel_fwd(q, k, v, b1, b2, has_b1, has_b2):
+    from .pallas.evoformer_attention import evoformer_flash_fwd
+
+    return evoformer_flash_fwd(q, k, v,
+                               bias1=b1 if has_b1 else None,
+                               bias2=b2 if has_b2 else None)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _evo_fused(q, k, v, b1, b2, has_b1, has_b2, chunk_size):
+    return _kernel_fwd(q, k, v, b1, b2, has_b1, has_b2)
+
+
+def _evo_fused_fwd(q, k, v, b1, b2, has_b1, has_b2, chunk_size):
+    return _kernel_fwd(q, k, v, b1, b2, has_b1, has_b2), (q, k, v, b1, b2)
+
+
+def _evo_fused_bwd(has_b1, has_b2, chunk_size, res, g):
+    # backward = vjp of the exact chunked implementation (a remat-style
+    # re-forward; the CUTLASS reference ships a handwritten bwd kernel,
+    # here the chunked-XLA path already has the right memory profile —
+    # at the CALLER's chunk_size, which bounds the live logits)
+    q, k, v, b1, b2 = res
+
+    def ref(q, k, v, b1, b2):
+        biases = [b1 if has_b1 else None, b2 if has_b2 else None]
+        return evoformer_attention(q, k, v, biases, chunk_size=chunk_size)
+
+    _, vjp = jax.vjp(ref, q, k, v, b1, b2)
+    return vjp(g)
+
+
+_evo_fused.defvjp(_evo_fused_fwd, _evo_fused_bwd)
+
+
+def ds4sci_evoformer_attention(
+    q, k, v, biases: Sequence[Optional[jax.Array]] = (),
+    use_kernel: bool = True, chunk_size: int = 512,
+):
+    """The DS4Sci_EvoformerAttention surface (ref: deepspeed/ops/
+    deepspeed4science/evoformer_attn.py): q/k/v [B, S, N, H, D], up to
+    two biases — [B, S, 1, 1, N] per-key mask and [B, 1, H, N, N] pair.
+
+    use_kernel=True routes the FORWARD through the fused Pallas kernel
+    (ops/pallas/evoformer_attention.py) when the shapes fit its tiling
+    (N % 128 == 0); gradients always come from the exact chunked path.
+    Anything off-contract falls back to chunked evoformer_attention."""
+    b1 = biases[0] if len(biases) > 0 else None
+    b2 = biases[1] if len(biases) > 1 else None
+    if use_kernel and q.ndim == 5:
+        B, S, N, H, D = q.shape
+        bq = min(256, N)
+        fits = (
+            # the kernel's tiling preconditions EXACTLY — anything the
+            # kernel would reject falls back instead of raising (e.g.
+            # N=384 divides 128 but not the 256 q-block)
+            N % bq == 0 and N % 128 == 0
+            and (b1 is None or b1.shape == (B, S, 1, 1, N))
+            and (b2 is None or b2.shape == (B, 1, H, N, N))
+        )
+    else:
+        fits = False
+    if not fits:
+        return evoformer_attention(q, k, v, biases, chunk_size=chunk_size)
+    # absent biases travel as TINY dummies (the kernel/chunked path
+    # never reads them; vjp returns zeros for them) — a [B,1,H,N,N]
+    # zeros placeholder would cost the very memory this kernel avoids
+    zb1 = b1 if b1 is not None else jnp.zeros((1,) * 5, q.dtype)
+    zb2 = b2 if b2 is not None else jnp.zeros((1,) * 5, q.dtype)
+    return _evo_fused(q, k, v, zb1, zb2, b1 is not None, b2 is not None,
+                      chunk_size)

@@ -1,0 +1,135 @@
+"""Pallas evoformer (MSA/triangle) fused attention forward.
+
+TPU-native analog of the DS4Science CUTLASS kernels
+(ref: csrc/deepspeed4science/evoformer_attn/ — fused non-causal
+attention over MSA tensors with up to two broadcastable pair/mask
+biases; python surface deepspeed/ops/deepspeed4science/
+evoformer_attn.py DS4Sci_EvoformerAttention). The reference contract:
+
+    q/k/v:  [B, S, N, H, D]   (batch, N_seq, N_res, heads, head_dim)
+    bias1:  [B, S, 1, 1, N]   per-key mask bias (broadcast over q, H)
+    bias2:  [B, 1, H, N, N]   pair bias (broadcast over N_seq)
+
+This kernel computes softmax(q·kᵀ/√d + bias1 + bias2)·v with an online
+softmax over key blocks — the [N, N] logits never materialize, and the
+bias tiles stream per block (the memory property the CUTLASS kernel
+exists for). The grid is one (q-block, key-block) walk per (B·S·H)
+slice; bias broadcasting is done by the BlockSpec index maps, not by
+materializing broadcast copies.
+
+Backward: the chunked-XLA implementation in ops/evoformer_attention.py
+is exact and O(N·chunk)-memory; the public entry point wires this
+kernel as the forward of a custom_vjp whose backward re-runs the
+chunked path under jax.vjp (a remat-style re-forward — the same
+trade the training engine makes everywhere else).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import NEG_INF, _dot, _interpret
+
+
+def _evo_kernel(
+    q_ref, k_ref, v_ref, b1_ref, b2_ref, o_ref, acc_sc, m_sc, l_sc,
+    *, scale: float, has_b1: bool, has_b2: bool,
+):
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    q = q_ref[0]  # (Bq, D)
+    k = k_ref[0]  # (Bk, D)
+    st = _dot(q, k, trans_b=True) * scale  # (Bq, Bk) f32
+    if has_b1:
+        st = st + b1_ref[0, 0].astype(jnp.float32)  # (1, Bk) broadcast
+    if has_b2:
+        st = st + b2_ref[0].astype(jnp.float32)     # (Bq, Bk)
+
+    m_prev = m_sc[:]
+    m_new = jnp.maximum(m_prev, jnp.max(st, axis=1, keepdims=True))
+    p = jnp.exp(st - m_new)
+    corr = jnp.exp(m_prev - m_new)
+    l_sc[:] = l_sc[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+    acc_sc[:] = acc_sc[:] * corr + _dot(p.astype(v_ref.dtype), v_ref[0])
+    m_sc[:] = m_new
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_sc[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_sc[:] / l_safe).astype(o_ref.dtype)
+
+
+def evoformer_flash_fwd(q, k, v, bias1=None, bias2=None,
+                        block_q: int = 256, block_k: int = 256):
+    """q/k/v [B, S, N, H, D]; bias1 [B, S, 1, 1, N] or None; bias2
+    [B, 1, H, N, N] or None -> [B, S, N, H, D]."""
+    B, S, N, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    bq = min(block_q, N)
+    bk = min(block_k, N)
+    if N % bq or N % bk:
+        raise ValueError(f"block sizes ({bq},{bk}) must divide N={N}")
+    G = B * S * H
+
+    # head-major flat views [G, N, D]: g = (b*S + s)*H + h
+    qf = jnp.moveaxis(q, 3, 2).reshape(G, N, D)
+    kf = jnp.moveaxis(k, 3, 2).reshape(G, N, D)
+    vf = jnp.moveaxis(v, 3, 2).reshape(G, N, D)
+    has_b1 = bias1 is not None
+    has_b2 = bias2 is not None
+    b1 = (bias1.reshape(B * S, 1, N) if has_b1
+          else jnp.zeros((1, 1, bk), q.dtype))
+    b2 = (bias2.reshape(B * H, N, N) if has_b2
+          else jnp.zeros((1, bq, bk), q.dtype))
+
+    grid = (G, 1, N // bq, N // bk)
+
+    def q_idx(g, _, iq, j):
+        return (g, iq, 0)
+
+    def kv_idx(g, _, iq, j):
+        return (g, j, 0)
+
+    def b1_idx(g, _, iq, j):
+        # g -> (b*S + s): drop the head component
+        return (g // H if has_b1 else 0, 0, j if has_b1 else 0)
+
+    def b2_idx(g, _, iq, j):
+        # g -> b*H + h: drop the N_seq component (pair bias is shared
+        # across sequences)
+        if not has_b2:
+            return (0, 0, 0)
+        return ((g // (S * H)) * H + g % H, iq, j)
+
+    out = pl.pallas_call(
+        functools.partial(_evo_kernel, scale=scale, has_b1=has_b1,
+                          has_b2=has_b2),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), q_idx),
+            pl.BlockSpec((1, bk, D), kv_idx),
+            pl.BlockSpec((1, bk, D), kv_idx),
+            pl.BlockSpec((1, 1, bk), b1_idx),
+            pl.BlockSpec((1, bq, bk), b2_idx),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), q_idx),
+        out_shape=jax.ShapeDtypeStruct((G, N, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qf, kf, vf, b1, b2)
+    return jnp.moveaxis(out.reshape(B, S, H, N, D), 2, 3)

@@ -701,6 +701,19 @@ class TestZeroInferenceOffload:
         out = off8.generate(prompts, max_new_tokens=5)
         assert len(out[0]) == 5
 
+    def test_exhausted_lazy_layers_raise(self, rng):
+        """A single-use lazy layer generator fed to a SECOND engine must
+        fail loudly, not serve a truncated model."""
+        cfg, params = small_model()
+        gen_params = dict(params)
+        gen_params["layers"] = iter([])  # exhausted-generator stand-in
+        with pytest.raises(ValueError, match="exhausted|layers"):
+            init_inference(
+                gen_params, cfg,
+                dict(max_seq_len=64, kv_block_size=8, num_kv_blocks=32,
+                     min_prefill_bucket=8, max_batch_size=8),
+                dtype=jnp.float32, offload={"device": "cpu"})
+
     def test_nvme_and_tp_rejected(self, rng):
         cfg, params = small_model()
         with pytest.raises(NotImplementedError, match="cpu"):

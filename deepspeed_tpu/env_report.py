@@ -10,6 +10,7 @@ Usage: python -m deepspeed_tpu.env_report
 """
 
 import importlib
+import os
 import shutil
 import sys
 
@@ -25,9 +26,12 @@ def _version(mod: str) -> str:
         return "not installed"
 
 
-def op_report() -> list:
+def op_report(backend: str = None) -> list:
     """(op name, buildable/compatible, status detail) rows
-    (ref: env_report.py op_report:30)."""
+    (ref: env_report.py op_report:30). `backend` is the platform name
+    discovered by main()'s watchdogged device probe — op_report itself
+    must never call jax.default_backend(): that would re-enter the very
+    backend init the watchdog exists to survive."""
     rows = []
     have_gxx = shutil.which("g++") is not None
     # native aio (csrc/aio)
@@ -40,17 +44,18 @@ def op_report() -> list:
     except Exception as e:
         rows.append(("async_io (csrc/aio)", False, f"error: {e}"))
     rows.append(("toolchain g++", have_gxx, shutil.which("g++") or "missing"))
-    # pallas kernel lanes compile on-demand; report platform readiness
-    try:
-        import jax
-
-        plat = jax.default_backend()
+    # pallas kernel lanes compile on-demand; report platform readiness.
+    # No backend = the device probe failed or timed out — the kernels
+    # CANNOT be called, so they are NOT okay (the pre-watchdog code had
+    # the same failure row via its try/except)
+    if backend:
         rows.append(("pallas flash attention", True,
-                     f"mosaic on tpu / interpret on {plat}"))
+                     f"mosaic on tpu / interpret on {backend}"))
         rows.append(("pallas paged attention", True,
-                     f"mosaic on tpu / interpret on {plat}"))
-    except Exception as e:
-        rows.append(("pallas kernels", False, f"jax error: {e}"))
+                     f"mosaic on tpu / interpret on {backend}"))
+    else:
+        rows.append(("pallas kernels", False,
+                     "backend unavailable (device probe failed/timed out)"))
     return rows
 
 
@@ -69,24 +74,62 @@ def main():
     print(f"  {'python':<18} {sys.version.split()[0]}")
     print("-" * 64)
     print("devices:")
-    try:
-        devs = jax.devices()
-        print(f"  backend            {jax.default_backend()}")
-        print(f"  device count       {len(devs)} "
-              f"({jax.process_count()} process(es))")
-        kinds = sorted({d.device_kind for d in devs})
-        print(f"  device kind        {', '.join(kinds)}")
-        from .platform.accelerator import get_accelerator
+    # backend init can HANG (not fail) when an accelerator runtime or
+    # its tunnel is wedged — a diagnostics tool must report that state,
+    # not inherit it. Device discovery runs on a watchdog thread; on
+    # timeout the report says so and the op-compatibility section (pure
+    # host-side) still prints. ref: ds_report's device block, which has
+    # the same job when CUDA is broken.
+    import threading
 
-        acc = get_accelerator()
-        print(f"  peak bf16 flops    {acc.peak_flops():.2e}/chip")
-    except Exception as e:
-        print(f"  jax init failed: {e}")
+    lines: list = []
+    seen_backend: list = []
+
+    def probe():
+        try:
+            devs = jax.devices()
+            seen_backend.append(jax.default_backend())
+            lines.append(f"  backend            {seen_backend[0]}")
+            lines.append(f"  device count       {len(devs)} "
+                         f"({jax.process_count()} process(es))")
+            kinds = sorted({d.device_kind for d in devs})
+            lines.append(f"  device kind        {', '.join(kinds)}")
+            from .platform.accelerator import get_accelerator
+
+            acc = get_accelerator()
+            lines.append(f"  peak bf16 flops    {acc.peak_flops():.2e}/chip")
+        except Exception as e:
+            lines.append(f"  jax init failed: {e}")
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    try:
+        probe_timeout = float(
+            os.environ.get("DS_TPU_DEVICE_PROBE_TIMEOUT", "60"))
+    except ValueError:
+        print("  (ignoring malformed DS_TPU_DEVICE_PROBE_TIMEOUT; using 60)")
+        probe_timeout = 60.0
+    t.join(timeout=probe_timeout)
+    timed_out = t.is_alive()
+    # snapshot: the probe may complete just past the deadline; a frozen
+    # copy keeps the devices section and the op table consistent
+    lines_snap = list(lines) if not timed_out else [
+        "  device backend init TIMED OUT (accelerator runtime or tunnel "
+        "unresponsive)"]
+    backend_snap = (seen_backend[0]
+                    if seen_backend and not timed_out else None)
+    for ln in lines_snap:
+        print(ln)
     print("-" * 64)
     print("op compatibility:")
-    for name, ok, detail in op_report():
+    for name, ok, detail in op_report(backend_snap):
         print(f"  {name:<28} {GREEN_OK if ok else RED_NO}  {detail}")
     print("-" * 64)
+    # a hung backend-init C call can block interpreter teardown even
+    # with the probe on a daemon thread; the report is complete, leave
+    if t.is_alive():
+        sys.stdout.flush()
+        os._exit(0)
 
 
 if __name__ == "__main__":

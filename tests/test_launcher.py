@@ -18,7 +18,11 @@ def test_env_report_runs():
     assert out.returncode == 0, out.stderr
     assert "op compatibility" in out.stdout
     assert "async_io" in out.stdout
-    assert "device count" in out.stdout
+    # the device probe runs under a watchdog: a healthy backend reports
+    # its devices, a wedged accelerator runtime/tunnel reports the
+    # timeout instead of hanging the tool (and this test with it)
+    assert ("device count" in out.stdout
+            or "TIMED OUT" in out.stdout), out.stdout
 
 
 def test_launch_local_spawns_world(tmp_path):

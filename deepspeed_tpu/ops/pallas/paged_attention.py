@@ -29,6 +29,16 @@ from jax.experimental.pallas import tpu as pltpu
 from .flash_attention import NEG_INF, _dot, _interpret
 
 
+def _arena_block(idx, n_blocks: int):
+    """THE containment clamp for every block index that reaches a DMA
+    or BlockSpec index map: a violated block-table contract (caller
+    bug) must produce wrong-but-contained traffic, never a wild DMA —
+    an out-of-bounds manual DMA doesn't just crash the program, it can
+    wedge the TPU runtime for every later client. Change containment
+    policy HERE, nowhere else."""
+    return jnp.clip(idx, 0, n_blocks - 1)
+
+
 # ---------------------------------------------------------------------------
 # decode attention
 # ---------------------------------------------------------------------------
@@ -222,7 +232,9 @@ def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens,
             # to a resident tile cost no DMA, so sparse decode saves
             # bandwidth as well as compute
             j = jnp.where(allow_ref[s, j] != 0, j, last)
-        return (tbl_ref[s, j], 0, 0, 0)
+        # clip to the arena: a violated table contract must stay
+        # contained (a wild block index can wedge the TPU runtime)
+        return (_arena_block(tbl_ref[s, j], NBLK), 0, 0, 0)
 
     def row_index(s, j, tbl_ref, ctx_ref, allow_ref, slot_ref):
         return (s, 0, 0)
@@ -233,7 +245,7 @@ def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens,
     def tgt_index(s, j, tbl_ref, ctx_ref, allow_ref, slot_ref):
         # constant in j: the sequence's NEWEST block — flushed once
         last = jnp.maximum(ctx_ref[s] - 1, 0) // bs
-        return (tbl_ref[s, last], 0, 0, 0)
+        return (_arena_block(tbl_ref[s, last], NBLK), 0, 0, 0)
 
     NBw = min(NB, pl.cdiv(window, bs) + 1) if window > 0 else NB
     kv_spec = pl.BlockSpec((1, bs, KV, D), kv_index)
@@ -362,8 +374,14 @@ def _decode_fused_kernel(
             return True
         return allow_ref[sq, j] != 0
 
+    # every HBM index is CLAMPED to the arena: a violated block-table
+    # contract (caller bug) must produce wrong-but-contained results,
+    # never a wild DMA — an out-of-bounds manual DMA doesn't just crash
+    # the program, it can wedge the TPU runtime for every later client
+    n_blk = k_any.shape[0]
+
     def load(sq, bufset, j, buf_slot):
-        blk = tbl_ref[sq, j]
+        blk = _arena_block(tbl_ref[sq, j], n_blk)
         pltpu.make_async_copy(k_any.at[blk], bufk.at[bufset, buf_slot],
                               lsem.at[bufset, buf_slot, 0]).start()
         pltpu.make_async_copy(v_any.at[blk], bufv.at[bufset, buf_slot],
@@ -460,7 +478,7 @@ def _decode_fused_kernel(
     # are distinct sequences). Waited at the final grid step.
     @pl.when(slot >= 0)
     def _write_row():
-        blk = slot // bs
+        blk = _arena_block(slot // bs, n_blk)
         off = slot % bs
         pltpu.make_async_copy(kn_ref.at[s], ck_any.at[blk, off],
                               wsem.at[s, 0]).start()
@@ -496,7 +514,7 @@ def _decode_fused_kernel(
         for sq in range(n_seqs):
             @pl.when(slot_ref[sq] >= 0)
             def _w(sq=sq):
-                blk = slot_ref[sq] // bs
+                blk = _arena_block(slot_ref[sq] // bs, n_blk)
                 off = slot_ref[sq] % bs
                 pltpu.make_async_copy(kn_ref.at[sq], ck_any.at[blk, off],
                                       wsem.at[sq, 0]).wait()
@@ -592,7 +610,7 @@ def paged_decode_fused(q, k_cache, v_cache, block_table, ctx_lens,
 
 def _kv_write_kernel(
     slots_ref, kn_ref, vn_ref, ck_in, cv_in, ck_out, cv_out,
-    *, block_size: int,
+    *, block_size: int, n_blocks: int,
 ):
     """Read-modify-write one token row into its cache block.
 
@@ -606,8 +624,8 @@ def _kv_write_kernel(
     t = pl.program_id(0)
     slot = slots_ref[t]
 
-    def cb(i):  # clamped block id of token i
-        return jnp.maximum(slots_ref[i], 0) // block_size
+    def cb(i):  # clamped block id of token i (same clip as cache_index)
+        return _arena_block(slots_ref[i] // block_size, n_blocks)
 
     first = jnp.logical_or(t == 0, cb(t) != cb(jnp.maximum(t - 1, 0)))
 
@@ -642,7 +660,9 @@ def paged_kv_write(cache_k, cache_v, k_new, v_new, flat_slots):
     vn = v_new[order]
 
     def cache_index(t, slots_ref):
-        return (jnp.maximum(slots_ref[t], 0) // bs, 0, 0, 0)
+        # clip both ends: negatives are pad rows, and an over-range slot
+        # (caller contract bug) must stay inside the arena
+        return (_arena_block(slots_ref[t] // bs, NBLK), 0, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -660,7 +680,7 @@ def paged_kv_write(cache_k, cache_v, k_new, v_new, flat_slots):
         scratch_shapes=[],
     )
     return pl.pallas_call(
-        functools.partial(_kv_write_kernel, block_size=bs),
+        functools.partial(_kv_write_kernel, block_size=bs, n_blocks=NBLK),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct(cache_k.shape, cache_k.dtype),

@@ -21,7 +21,29 @@ import numpy as np
 
 
 def main():
+    # backend init can HANG (not fail) when the accelerator runtime or
+    # its tunnel is wedged; a bench that never returns is worse than an
+    # error line, so device discovery runs under a watchdog first
     import jax
+
+    from deepspeed_tpu.platform.accelerator import (
+        probe_devices,
+        probe_timeout_from_env,
+    )
+
+    devs, probe_err, timed_out = probe_devices(
+        probe_timeout_from_env(default=300.0))
+    if devs is None:
+        print(json.dumps({
+            "metric": "llama_350m_bf16_zero1_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "error": ("device backend init timed out (accelerator runtime "
+                      "or tunnel unresponsive); bench did not run"
+                      if timed_out else
+                      f"device backend init failed: {probe_err}"),
+        }))
+        sys.stdout.flush()
+        os._exit(1)
 
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import transformer as T

@@ -76,50 +76,31 @@ def main():
     print("devices:")
     # backend init can HANG (not fail) when an accelerator runtime or
     # its tunnel is wedged — a diagnostics tool must report that state,
-    # not inherit it. Device discovery runs on a watchdog thread; on
-    # timeout the report says so and the op-compatibility section (pure
-    # host-side) still prints. ref: ds_report's device block, which has
-    # the same job when CUDA is broken.
-    import threading
+    # not inherit it. Device discovery runs under the shared watchdog
+    # (platform/accelerator.probe_devices); on timeout the report says
+    # so and the op-compatibility section (pure host-side) still
+    # prints. ref: ds_report's device block, which has the same job
+    # when CUDA is broken.
+    from .platform.accelerator import probe_devices, probe_timeout_from_env
 
-    lines: list = []
-    seen_backend: list = []
+    devs, probe_err, timed_out = probe_devices(probe_timeout_from_env())
+    backend_snap = None
+    if timed_out:
+        print("  device backend init TIMED OUT (accelerator runtime or "
+              "tunnel unresponsive)")
+    elif probe_err is not None:
+        print(f"  jax init failed: {probe_err}")
+    else:
+        backend_snap = jax.default_backend()
+        print(f"  backend            {backend_snap}")
+        print(f"  device count       {len(devs)} "
+              f"({jax.process_count()} process(es))")
+        kinds = sorted({d.device_kind for d in devs})
+        print(f"  device kind        {', '.join(kinds)}")
+        from .platform.accelerator import get_accelerator
 
-    def probe():
-        try:
-            devs = jax.devices()
-            seen_backend.append(jax.default_backend())
-            lines.append(f"  backend            {seen_backend[0]}")
-            lines.append(f"  device count       {len(devs)} "
-                         f"({jax.process_count()} process(es))")
-            kinds = sorted({d.device_kind for d in devs})
-            lines.append(f"  device kind        {', '.join(kinds)}")
-            from .platform.accelerator import get_accelerator
-
-            acc = get_accelerator()
-            lines.append(f"  peak bf16 flops    {acc.peak_flops():.2e}/chip")
-        except Exception as e:
-            lines.append(f"  jax init failed: {e}")
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    try:
-        probe_timeout = float(
-            os.environ.get("DS_TPU_DEVICE_PROBE_TIMEOUT", "60"))
-    except ValueError:
-        print("  (ignoring malformed DS_TPU_DEVICE_PROBE_TIMEOUT; using 60)")
-        probe_timeout = 60.0
-    t.join(timeout=probe_timeout)
-    timed_out = t.is_alive()
-    # snapshot: the probe may complete just past the deadline; a frozen
-    # copy keeps the devices section and the op table consistent
-    lines_snap = list(lines) if not timed_out else [
-        "  device backend init TIMED OUT (accelerator runtime or tunnel "
-        "unresponsive)"]
-    backend_snap = (seen_backend[0]
-                    if seen_backend and not timed_out else None)
-    for ln in lines_snap:
-        print(ln)
+        acc = get_accelerator()
+        print(f"  peak bf16 flops    {acc.peak_flops():.2e}/chip")
     print("-" * 64)
     print("op compatibility:")
     for name, ok, detail in op_report(backend_snap):
@@ -127,7 +108,7 @@ def main():
     print("-" * 64)
     # a hung backend-init C call can block interpreter teardown even
     # with the probe on a daemon thread; the report is complete, leave
-    if t.is_alive():
+    if timed_out:
         sys.stdout.flush()
         os._exit(0)
 

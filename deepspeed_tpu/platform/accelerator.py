@@ -142,3 +142,53 @@ def set_accelerator_platform(platform: Optional[str]):
     else:
         os.environ["DS_TPU_ACCELERATOR"] = platform
     get_accelerator.cache_clear()
+
+
+def probe_timeout_from_env(default: float = 60.0) -> float:
+    """DS_TPU_DEVICE_PROBE_TIMEOUT, falling back (never raising) on a
+    malformed or non-positive value — the consumers are diagnostics and
+    bench entry points whose output contract must survive a typo'd
+    knob."""
+    import os
+
+    raw = os.environ.get("DS_TPU_DEVICE_PROBE_TIMEOUT", "")
+    try:
+        val = float(raw)
+        if val > 0:
+            return val
+    except ValueError:
+        pass
+    return default
+
+
+def probe_devices(timeout: float):
+    """Device discovery under a watchdog thread:
+    (devices | None, error_message | None, timed_out).
+
+    Backend init can HANG (not fail) when an accelerator runtime or its
+    tunnel is wedged — observed: PJRT client creation blocking
+    indefinitely against an unresponsive relay. Tools that must emit
+    output (env_report, bench) probe through this instead of calling
+    jax.devices() on their main thread. A fast init FAILURE is reported
+    as the error it is, not as a timeout."""
+    import threading
+
+    import jax
+
+    out: list = []
+    err: list = []
+
+    def probe():
+        try:
+            out.append(jax.devices())
+        except Exception as e:  # report, don't die on a probe thread
+            err.append(f"{type(e).__name__}: {e}")
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout=timeout)
+    if t.is_alive():
+        return None, None, True
+    if err:
+        return None, err[0], False
+    return out[0], None, False

@@ -217,7 +217,7 @@ class InferenceEngine:
                 "path",
                 ranks=[0],
             )
-        if model_config.variant == "gpt2":
+        if model_config.use_learned_pos:
             # prefill pads prompts up to a power-of-two bucket, and every
             # padded position indexes the learned position table — so the
             # largest BUCKET (not just max_seq_len) must fit

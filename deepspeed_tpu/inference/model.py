@@ -320,9 +320,16 @@ def _rope_at(x, positions, cfg: T.TransformerConfig):
     angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [T, R/2]
     cos, sin = jnp.cos(angles), jnp.sin(angles)
     xr, xp = x[..., :R], x[..., R:]
-    x1, x2 = jnp.split(xr.astype(jnp.float32), 2, axis=-1)  # [T, H, R/2]
     c, s = cos[:, None, :], sin[:, None, :]
-    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    if cfg.rope_interleaved:
+        # GPT-J rotate_every_two pairing — must match T._rope exactly
+        xf = xr.astype(jnp.float32).reshape(*xr.shape[:-1], R // 2, 2)
+        x1, x2 = xf[..., 0], xf[..., 1]
+        out = jnp.stack([x1 * c - x2 * s, x2 * c + x1 * s],
+                        axis=-1).reshape(xr.shape)
+    else:
+        x1, x2 = jnp.split(xr.astype(jnp.float32), 2, axis=-1)  # [T, H, R/2]
+        out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
     return jnp.concatenate([out.astype(x.dtype), xp], axis=-1)
 
 
@@ -546,10 +553,13 @@ def _mlp(h, lp, cfg: T.TransformerConfig):
 
 def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None,
                       allowed_slots=None, window: int = 0, mesh=None,
-                      k_new=None, v_new=None, slots=None):
+                      k_new=None, v_new=None, slots=None, alibi=None):
     """k_new/v_new/slots non-None selects the FUSED write+attend kernel
     (single-token decode rows; ck/cv are the PRE-write arenas and the
-    returned (att, ck, cv) includes the in-kernel RMW)."""
+    returned (att, ck, cv) includes the in-kernel RMW).
+
+    alibi: optional [H] per-head slopes (Bloom-class) — every path below
+    biases scores by slope_h * key_pos (exact per single query row)."""
     fused = k_new is not None
     if allowed_slots is not None and use_kernel and _tp_size(mesh) <= 1:
         # block-sparse serving on the Pallas kernels: the layout rides
@@ -559,10 +569,12 @@ def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None,
         if fused and supports_fused_v2(q.shape[-1]):
             return paged_decode_fused(q, ck, cv, table, ctx,
                                       k_new, v_new, slots, window=window,
-                                      allowed_slots=allowed_slots)
+                                      allowed_slots=allowed_slots,
+                                      alibi_slopes=alibi)
         return paged_decode_attention(q, ck, cv, table, ctx, window=window,
                                       allowed_slots=allowed_slots,
-                                      k_new=k_new, v_new=v_new, slots=slots)
+                                      k_new=k_new, v_new=v_new, slots=slots,
+                                      alibi_slopes=alibi)
     if allowed is not None:
         # layout finer than the cache blocks (or TP mesh): XLA path with
         # the per-position mask. (window is passed through for
@@ -570,7 +582,8 @@ def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None,
         # both masks never actually combine today.)
         assert not fused
         return paged_decode_attention_xla(q, ck, cv, table, ctx,
-                                          allowed=allowed, window=window)
+                                          allowed=allowed, window=window,
+                                          alibi_slopes=alibi)
     tp = _tp_size(mesh)
     H, KV = q.shape[1], ck.shape[2]
     if tp > 1 and H % tp == 0 and KV % tp == 0:
@@ -580,6 +593,15 @@ def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None,
                      else paged_decode_attention_xla, window=window)
         qs = P(None, "model", None)
         kv = P(None, None, "model", None)
+        if alibi is not None:
+            # slopes shard with the heads (each device biases its own)
+            wrapped = (lambda q_, k_, v_, t_, c_, ab_:
+                       fn(q_, k_, v_, t_, c_, alibi_slopes=ab_))
+            return _shard_map_kernel(
+                wrapped, mesh,
+                in_specs=(qs, kv, kv, P(None, None), P(None), P("model")),
+                out_specs=qs,
+            )(q, ck, cv, table, ctx, jnp.asarray(alibi, jnp.float32))
         return _shard_map_kernel(
             fn, mesh,
             in_specs=(qs, kv, kv, P(None, None), P(None)),
@@ -591,13 +613,16 @@ def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None,
             # path (live blocks only, 2KB row writes instead of 256KB
             # block RMW through the output pipeline)
             return paged_decode_fused(q, ck, cv, table, ctx,
-                                      k_new, v_new, slots, window=window)
+                                      k_new, v_new, slots, window=window,
+                                      alibi_slopes=alibi)
         return paged_decode_attention(q, ck, cv, table, ctx, window=window,
-                                      k_new=k_new, v_new=v_new, slots=slots)
+                                      k_new=k_new, v_new=v_new, slots=slots,
+                                      alibi_slopes=alibi)
     # under a TP mesh with non-divisible heads, the XLA path lets SPMD
     # partition freely (a raw pallas_call over sharded operands cannot)
     assert not fused
-    return paged_decode_attention_xla(q, ck, cv, table, ctx, window=window)
+    return paged_decode_attention_xla(q, ck, cv, table, ctx, window=window,
+                                      alibi_slopes=alibi)
 
 
 # ---------------------------------------------------------------------------
@@ -650,8 +675,13 @@ def decode_step(
             allowed = _sparse_decode_allowed(
                 scfg, positions, tables.shape[1] * cache.block_size)
     x = _embed_rows(params["embed"], tokens)  # [S, E]
-    if cfg.variant == "gpt2":
+    if cfg.use_learned_pos:
         x = x + params["pos_embed"][positions].astype(x.dtype)
+    if cfg.embedding_layernorm:
+        x = T._norm(x, params["embed_ln_scale"],
+                    params.get("embed_ln_bias"), cfg)
+    alibi = (jnp.asarray(T.model_alibi_slopes(cfg)) if cfg.alibi
+             else None)
 
     # fused write+attend only on the single-device kernel path (the
     # shard_map TP path and the XLA fallbacks keep the separate write)
@@ -699,7 +729,7 @@ def decode_step(
             att, ck, cv = _decode_attention(
                 q, ck_in, cv_in, tables, ctx_lens, use_kernel,
                 allowed_slots=allowed_slots, window=cfg.sliding_window,
-                mesh=mesh, k_new=k, v_new=v, slots=flat_idx,
+                mesh=mesh, k_new=k, v_new=v, slots=flat_idx, alibi=alibi,
             )
         else:
             ck, cv = _write_kv(ck_in, cv_in, k, v, flat_idx, mesh)
@@ -708,7 +738,8 @@ def decode_step(
             att = _decode_attention(q, ck, cv, tables, ctx_lens, use_kernel,
                                     allowed=allowed,
                                     allowed_slots=allowed_slots,
-                                    window=cfg.sliding_window, mesh=mesh)
+                                    window=cfg.sliding_window, mesh=mesh,
+                                    alibi=alibi)
         new_k.append(ck)
         new_v.append(cv)
         out = _wmm("shd,hde->se", att, lp["wo"])
@@ -841,8 +872,13 @@ def prefill_batch(
         if scfg is not None and Tp % scfg.block != 0 else None
     )
     x = _embed_rows(params["embed"], tokens)  # [B, Tp, E]
-    if cfg.variant == "gpt2":
+    if cfg.use_learned_pos:
         x = x + params["pos_embed"][:Tp].astype(x.dtype)[None]
+    if cfg.embedding_layernorm:
+        x = T._norm(x, params["embed_ln_scale"],
+                    params.get("embed_ln_bias"), cfg)
+    alibi = (jnp.asarray(T.model_alibi_slopes(cfg)) if cfg.alibi
+             else None)
 
     # per-row flat cache slots for the real tokens; -1 rows drop
     flat_idx = jnp.where(
@@ -906,18 +942,26 @@ def prefill_batch(
         elif _heads_shardable(mesh, cfg):
             # flash kernel per head-shard; GQA grouping stays device-local
             hs = P(None, None, "model", None)
-            att = _shard_map_kernel(
-                partial(causal_attention,
-                        use_flash=use_kernel and cfg.use_flash,
-                        window=cfg.sliding_window),
-                mesh, in_specs=(hs, hs, hs), out_specs=hs,
-            )(q, k, v)
+            if alibi is not None:
+                att = _shard_map_kernel(
+                    lambda q_, k_, v_, ab_: causal_attention(
+                        q_, k_, v_, use_flash=use_kernel and cfg.use_flash,
+                        window=cfg.sliding_window, alibi=ab_),
+                    mesh, in_specs=(hs, hs, hs, P("model")), out_specs=hs,
+                )(q, k, v, alibi)
+            else:
+                att = _shard_map_kernel(
+                    partial(causal_attention,
+                            use_flash=use_kernel and cfg.use_flash,
+                            window=cfg.sliding_window),
+                    mesh, in_specs=(hs, hs, hs), out_specs=hs,
+                )(q, k, v)
         else:
             att = causal_attention(
                 q, k, v,
                 # a raw pallas_call cannot consume TP-sharded operands
                 use_flash=use_kernel and cfg.use_flash and _tp_size(mesh) <= 1,
-                window=cfg.sliding_window)
+                window=cfg.sliding_window, alibi=alibi)
         out = _wmm("bshd,hde->bse", att, lp["wo"])
         if "bo" in lp:
             out = out + lp["bo"].astype(x.dtype)

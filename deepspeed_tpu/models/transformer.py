@@ -138,6 +138,20 @@ class TransformerConfig:
     shared_ln: bool = False
     rotary_pct: float = 1.0               # Phi partial rotary
     lm_head_bias: bool = False            # Phi-2
+    # ALiBi positional bias (Bloom / falcon-rw; ref:
+    # module_inject/containers/bloom.py + the CUDA softmax alibi path).
+    # Replaces rope AND learned positions: per-head slopes bias every
+    # attention score by slope_h * (key_pos - query_pos).
+    alibi: bool = False
+    # Falcon's HF modeling applies the bias BEFORE the 1/sqrt(D) score
+    # scaling (bloom adds it after) — falcon-rw checkpoints therefore
+    # need slopes scaled by 1/sqrt(head_dim) to reproduce HF numerics.
+    alibi_slope_scale: float = 1.0
+    # GPT-J rope pairing: rotate_every_two (dims 2i/2i+1 form a rotation
+    # pair) instead of the Llama/NeoX split-halves convention.
+    rope_interleaved: bool = False
+    # Bloom: LayerNorm over the embedding output before the first block
+    embedding_layernorm: bool = False
 
     def __post_init__(self):
         if self.rope_scaling_type not in ("none", "linear", "llama3"):
@@ -181,11 +195,25 @@ class TransformerConfig:
             raise ValueError("rotary_pct applies to the rotary family")
         if self.lm_head_bias and self.tie_embeddings:
             raise ValueError("lm_head_bias requires an untied lm_head")
+        if self.alibi and self.attention_impl != "ulysses":
+            raise ValueError(
+                "alibi requires attention_impl='ulysses' (ring rotates KV "
+                "without absolute-position bookkeeping for the bias; "
+                "sparse layouts express position via blocks)"
+            )
+        if self.alibi and self.rotary_pct < 1.0:
+            raise ValueError("alibi replaces rotary embeddings entirely")
+        if self.rope_interleaved and not self.use_rope:
+            raise ValueError("rope_interleaved applies to the rotary family")
 
     # -- family-knob resolution (None -> variant preset) ---------------
     @property
     def use_rope(self) -> bool:
-        return self.variant != "gpt2"
+        return self.variant != "gpt2" and not self.alibi
+
+    @property
+    def use_learned_pos(self) -> bool:
+        return self.variant == "gpt2" and not self.alibi
 
     @property
     def norm_kind(self) -> str:
@@ -358,8 +386,12 @@ def init(cfg: TransformerConfig, rng) -> Dict[str, Any]:
         "embed": jax.random.normal(keys[0], (V, E), jnp.float32) * std,
         "ln_f_scale": jnp.ones((E,), jnp.float32),
     }
-    if cfg.variant == "gpt2":
+    if cfg.use_learned_pos:
         params["pos_embed"] = jax.random.normal(keys[1], (cfg.max_seq, E), jnp.float32) * std
+    if cfg.embedding_layernorm:
+        params["embed_ln_scale"] = jnp.ones((E,), jnp.float32)
+        if cfg.norm_has_bias:
+            params["embed_ln_bias"] = jnp.zeros((E,), jnp.float32)
     if cfg.norm_has_bias:
         params["ln_f_bias"] = jnp.zeros((E,), jnp.float32)
     if not cfg.tie_embeddings:
@@ -395,8 +427,12 @@ def logical_specs(cfg: TransformerConfig) -> Dict[str, Any]:
         "embed": ("vocab", "embed"),
         "ln_f_scale": ("embed",),
     }
-    if cfg.variant == "gpt2":
+    if cfg.use_learned_pos:
         specs["pos_embed"] = (None, "embed")
+    if cfg.embedding_layernorm:
+        specs["embed_ln_scale"] = ("embed",)
+        if cfg.norm_has_bias:
+            specs["embed_ln_bias"] = ("embed",)
     if cfg.norm_has_bias:
         specs["ln_f_bias"] = ("embed",)
     if not cfg.tie_embeddings:
@@ -429,6 +465,14 @@ def _norm(x, scale, bias, cfg: TransformerConfig):
         var = jnp.var(x32, axis=-1, keepdims=True)
         out = (x32 - mean) * jax.lax.rsqrt(var + cfg.norm_eps) * scale + bias
     return out.astype(x.dtype)
+
+
+def model_alibi_slopes(cfg: TransformerConfig):
+    """Per-head ALiBi slopes for this model (the Press et al. ladder
+    times the family's scale quirk — see alibi_slope_scale)."""
+    from ..ops.attention import alibi_slopes
+
+    return alibi_slopes(cfg.n_heads) * cfg.alibi_slope_scale
 
 
 def rope_dim(cfg: TransformerConfig) -> int:
@@ -482,10 +526,17 @@ def _rope(q, k, cfg: TransformerConfig, offset: int = 0, positions=None):
 
     def rot(x):
         xr, xp = x[..., :R], x[..., R:]  # partial rotary passthrough
-        x1, x2 = jnp.split(xr.astype(jnp.float32), 2, axis=-1)
         c = cos[:, :, None, :]
         s = sin[:, :, None, :]
-        out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+        if cfg.rope_interleaved:
+            # GPT-J rotate_every_two: dims (2i, 2i+1) are the pair
+            xf = xr.astype(jnp.float32).reshape(*xr.shape[:-1], R // 2, 2)
+            x1, x2 = xf[..., 0], xf[..., 1]
+            out = jnp.stack([x1 * c - x2 * s, x2 * c + x1 * s],
+                            axis=-1).reshape(xr.shape)
+        else:
+            x1, x2 = jnp.split(xr.astype(jnp.float32), 2, axis=-1)
+            out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
         return jnp.concatenate([out.astype(x.dtype), xp], axis=-1)
 
     return rot(q), rot(k)
@@ -593,10 +644,14 @@ def _attention_delta(h, lp, cfg: TransformerConfig, rng=None, positions=None):
         k = _shard(k, DP, None, ("model", "seq"), None)
         v = _shard(v, DP, None, ("model", "seq"), None)
 
+        slopes = None
+        if cfg.alibi:
+            slopes = jnp.asarray(model_alibi_slopes(cfg))
         out = causal_attention(q, k, v, use_flash=cfg.use_flash,
                                window=cfg.sliding_window,
                                block_q=cfg.flash_block_q,
-                               block_k=cfg.flash_block_k)  # [B,S,H,D]
+                               block_k=cfg.flash_block_k,
+                               alibi=slopes)  # [B,S,H,D]
 
     out = _shard(out, DP, "seq", "model", None)
     out = jnp.einsum("bshd,hde->bse", out, lp["wo"].astype(x.dtype))
@@ -847,8 +902,11 @@ def forward_hidden(
     reference's eval forward)."""
     x = params["embed"][tokens]
     x = _shard(x, DP, "seq", None)
-    if cfg.variant == "gpt2":
+    if cfg.use_learned_pos:
         x = x + params["pos_embed"][: tokens.shape[1]].astype(x.dtype)
+    if cfg.embedding_layernorm:
+        x = _norm(x, params["embed_ln_scale"], params.get("embed_ln_bias"),
+                  cfg)
 
     if rng is None:
         pld_theta = None  # eval: keep every layer
@@ -1051,8 +1109,11 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, loss_chunks: int = 8):
         # Embedding runs replicated over 'pipe' (cheap gather); the heavy
         # layer stack runs stage-sharded.
         x = params["embed"][inputs]
-        if cfg.variant == "gpt2":
+        if cfg.use_learned_pos:
             x = x + params["pos_embed"][:S].astype(x.dtype)
+        if cfg.embedding_layernorm:
+            x = _norm(x, params["embed_ln_scale"],
+                      params.get("embed_ln_bias"), cfg)
         x = _shard(x, None, DP, "seq", None)
 
         use_rng = rng is not None and _wants_rng(cfg)

@@ -16,12 +16,33 @@ KV heads in place via BlockSpec index maps — callers must NOT pre-repeat
 KV heads; only the XLA fallback materializes the repeat.
 """
 
+import math
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 _NEG_INF = -1e30
+
+
+def alibi_slopes(n_heads: int) -> np.ndarray:
+    """Per-head ALiBi slopes [H] (Press et al., arXiv 2108.12409 — the
+    rule the reference bakes into its Bloom containers, ref:
+    deepspeed/module_inject/containers/bloom.py + csrc softmax alibi
+    path). Power-of-two head counts use the geometric ladder from
+    2^(-8/n); other counts take the closest power's ladder plus every
+    other entry of the doubled ladder."""
+    def ladder(n: int):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start ** (i + 1) for i in range(n)]
+
+    if math.log2(n_heads).is_integer():
+        s = ladder(n_heads)
+    else:
+        c = 2 ** math.floor(math.log2(n_heads))
+        s = ladder(c) + ladder(2 * c)[0::2][: n_heads - c]
+    return np.asarray(s, np.float32)
 
 
 def _repeat_kv(k, n_rep: int):
@@ -31,13 +52,19 @@ def _repeat_kv(k, n_rep: int):
     return jnp.broadcast_to(k[:, :, :, None, :], (B, S, KV, n_rep, D)).reshape(B, S, KV * n_rep, D)
 
 
-def _xla_attention(q, k, v, causal: bool = True, window: int = 0):
+def _xla_attention(q, k, v, causal: bool = True, window: int = 0,
+                   alibi: Optional[jnp.ndarray] = None):
     B, S, H, D = q.shape
     scale = 1.0 / (D**0.5)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     logits = logits.astype(jnp.float32)
+    Sk = k.shape[1]
+    if alibi is not None:
+        # ALiBi: score[h, i, j] += slope_h * (j - i); non-positive under
+        # the causal mask, 0 on the diagonal
+        rel = (jnp.arange(Sk)[None, :] - jnp.arange(Sk - S, Sk)[:, None])
+        logits = logits + alibi[None, :, None, None] * rel[None, None]
     if causal:
-        Sk = k.shape[1]
         mask = jnp.tril(jnp.ones((S, Sk), bool), k=Sk - S)
         if window > 0:
             # token-exact sliding window (Mistral-class): q attends only
@@ -72,7 +99,8 @@ _flash_resolved = False
 
 
 def causal_attention(q, k, v, use_flash: bool = True, window: int = 0,
-                     block_q: int = 512, block_k: int = 1024):
+                     block_q: int = 512, block_k: int = 1024,
+                     alibi: Optional[jnp.ndarray] = None):
     """Causal self-attention, [B,S,H,D] x [B,S,KV,D] -> [B,S,H,D].
 
     GQA KV heads are consumed in-place by the flash kernel (index maps,
@@ -81,6 +109,10 @@ def causal_attention(q, k, v, use_flash: bool = True, window: int = 0,
     window > 0 enables a token-exact sliding window (Mistral-class);
     the flash kernels prune out-of-window blocks from compute AND DMA.
 
+    alibi: optional [H] per-head ALiBi slopes (Bloom-class); the bias
+    slope_h * (key_pos - query_pos) enters the flash kernels' online
+    softmax in-tile and the XLA fallback's logits identically.
+
     block_q/block_k tune the flash tiling (TransformerConfig
     flash_block_q/k — 1024x1024 measured fastest at S=2048/D=128,
     512x1024 at S=16384; docs/PROFILE_r03.md)."""
@@ -88,10 +120,10 @@ def causal_attention(q, k, v, use_flash: bool = True, window: int = 0,
         flash = _load_flash()
         if flash is not None:
             return flash(q, k, v, causal=True, window=window,
-                         block_q=block_q, block_k=block_k)
+                         block_q=block_q, block_k=block_k, alibi=alibi)
     n_rep = q.shape[2] // k.shape[2]
     return _xla_attention(q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep),
-                          causal=True, window=window)
+                          causal=True, window=window, alibi=alibi)
 
 
 def _on_tpu() -> bool:

@@ -70,13 +70,20 @@ def _win_j(i, j, bq: int, bk: int, window: int, nk: int):
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc,
-    *, scale: float, block_q: int, block_k: int, seq_len: int, causal: bool,
-    window: int, nk_total: int,
+    *refs, scale: float, block_q: int, block_k: int, seq_len: int,
+    causal: bool, window: int, nk_total: int, H: int, alibi: bool,
 ):
+    if alibi:
+        q_ref, k_ref, v_ref, ab_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc = refs
+        ab_ref = None
     i = pl.program_id(1)  # q block
     j = pl.program_id(2)  # k block step (sequential; window-relative)
     nk = pl.num_programs(2)
+    # program_id must stay OUT of pl.when bodies (cond sub-jaxprs don't
+    # substitute it under the interpreter)
+    slope = ab_ref[pl.program_id(0) % H] if alibi else None
 
     @pl.when(j == 0)
     def _init():
@@ -107,6 +114,8 @@ def _fwd_kernel(
 
         rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        if alibi:
+            s = s + slope * (cols - rows).astype(jnp.float32)
         mask = cols < seq_len  # k padding
         if causal:
             mask = jnp.logical_and(mask, cols <= rows)
@@ -185,7 +194,8 @@ def _clamp_i(i, j, bq: int, bk: int, causal: bool, window: int = 0, nq: int = 0)
     return i
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, H, KV, window=0):
+def _flash_fwd(q, k, v, slopes, causal, block_q, block_k, H, KV, window=0,
+               alibi=False):
     """q: [B*H, S, D]; k,v: [B*KV, S, D] → (o [B*H,S,D], lse [B*H,S])."""
     BH, S, D = q.shape
     G = H // KV
@@ -200,24 +210,30 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, H, KV, window=0):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, block_q=bq, block_k=bk, seq_len=S, causal=causal,
-        window=window, nk_total=nk,
+        window=window, nk_total=nk, H=H, alibi=alibi,
     )
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec(
+            (1, bk, D),
+            lambda b, i, j: (_kv_index(b, H, KV, G), _clamp_j(j, i, bq, bk, causal, window, nk), 0),
+        ),
+        pl.BlockSpec(
+            (1, bk, D),
+            lambda b, i, j: (_kv_index(b, H, KV, G), _clamp_j(j, i, bq, bk, causal, window, nk), 0),
+        ),
+    ]
+    inputs = [qp, kp, vp]
+    if alibi:
+        # per-q-head slopes, whole [H] array resident in SMEM
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        inputs.append(slopes)
     # window: the k grid walks only the blocks the band can touch
     nkw = min(nk, pl.cdiv(bq + window - 1, bk) + 1) if window > 0 else nk
     o, lse = pl.pallas_call(
         kernel,
         grid=(BH, nq, nkw),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec(
-                (1, bk, D),
-                lambda b, i, j: (_kv_index(b, H, KV, G), _clamp_j(j, i, bq, bk, causal, window, nk), 0),
-            ),
-            pl.BlockSpec(
-                (1, bk, D),
-                lambda b, i, j: (_kv_index(b, H, KV, G), _clamp_j(j, i, bq, bk, causal, window, nk), 0),
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
             # lse carries a singleton middle dim so the block's trailing two
@@ -234,7 +250,7 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, H, KV, window=0):
             pltpu.VMEM((bq, 1), jnp.float32),
         ],
         interpret=_interpret(),
-    )(qp, kp, vp)
+    )(*inputs)
     return o[:, :S], lse[:, 0, :S]
 
 
@@ -243,13 +259,19 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, H, KV, window=0):
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc,
-    *, scale: float, block_q: int, block_k: int, seq_len: int, causal: bool,
-    window: int, nk_total: int,
+    *refs, scale: float, block_q: int, block_k: int, seq_len: int,
+    causal: bool, window: int, nk_total: int, H: int, alibi: bool,
 ):
+    if alibi:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, ab_ref,
+         dq_ref, dq_sc) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc = refs
+        ab_ref = None
     i = pl.program_id(1)  # q block
     j = pl.program_id(2)  # k block step (sequential; window-relative)
     nk = pl.num_programs(2)
+    slope = ab_ref[pl.program_id(0) % H] if alibi else None
 
     @pl.when(j == 0)
     def _init():
@@ -275,6 +297,8 @@ def _bwd_dq_kernel(
 
         rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        if alibi:
+            s = s + slope * (cols - rows).astype(jnp.float32)
         mask = cols < seq_len
         if causal:
             mask = jnp.logical_and(mask, cols <= rows)
@@ -295,15 +319,24 @@ def _bwd_dq_kernel(
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_sc, dv_sc,
-    *, scale: float, block_q: int, block_k: int, seq_len: int, causal: bool,
-    window: int, n_group: int, nq_total: int,
+    *refs, scale: float, block_q: int, block_k: int, seq_len: int,
+    causal: bool, window: int, n_group: int, nq_total: int, KV: int,
+    alibi: bool,
 ):
+    if alibi:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, ab_ref,
+         dk_ref, dv_ref, dk_sc, dv_sc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_sc, dv_sc) = refs
+        ab_ref = None
     j = pl.program_id(1)   # k block
     g = pl.program_id(2)   # q-head within the kv group (sequential)
     i = pl.program_id(3)   # q block step (sequential; window-relative)
     nq = pl.num_programs(3)
+    # q head this (b, g) step attends with
+    slope = (ab_ref[(pl.program_id(0) % KV) * n_group + g] if alibi
+             else None)
 
     @pl.when(jnp.logical_and(g == 0, i == 0))
     def _init():
@@ -336,6 +369,8 @@ def _bwd_dkv_kernel(
 
         cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 0)
         rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 1)
+        if alibi:
+            s_t = s_t + slope * (cols - rows).astype(jnp.float32)
         mask = cols < seq_len
         if causal:
             mask = jnp.logical_and(mask, cols <= rows)
@@ -357,7 +392,8 @@ def _bwd_dkv_kernel(
         dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, H, KV, window=0):
+def _flash_bwd(q, k, v, slopes, o, lse, do, causal, block_q, block_k, H, KV,
+               window=0, alibi=False):
     BH, S, D = q.shape
     BKV = k.shape[0]
     G = H // KV
@@ -381,43 +417,56 @@ def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, H, KV, window=0):
     nkw = min(nk, pl.cdiv(bq + window - 1, bk) + 1) if window > 0 else nk
     niw = min(nq, pl.cdiv(bk + window - 1, bq) + 1) if window > 0 else nq
 
+    dq_in_specs = [
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (kv_ix(b), _clamp_j(j, i, bq, bk, causal, window, nk), 0)),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (kv_ix(b), _clamp_j(j, i, bq, bk, causal, window, nk), 0)),
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+        pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+    ]
+    dq_inputs = [qp, kp, vp, dop, lsep, deltap]
+    if alibi:
+        dq_in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        dq_inputs.append(slopes)
+
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, block_q=bq, block_k=bk, seq_len=S,
-            causal=causal, window=window, nk_total=nk,
+            causal=causal, window=window, nk_total=nk, H=H, alibi=alibi,
         ),
         grid=(BH, nq, nkw),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (kv_ix(b), _clamp_j(j, i, bq, bk, causal, window, nk), 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (kv_ix(b), _clamp_j(j, i, bq, bk, causal, window, nk), 0)),
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
-            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, Sp, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=_interpret(),
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(*dq_inputs)
 
     # q-head index for the dk/dv grid: (b_kv, g) → q head row in [B*H)
     q_ix = lambda b, g: (b // KV) * H + (b % KV) * G + g
 
+    dkv_in_specs = [
+        pl.BlockSpec((1, bq, D), lambda b, j, g, i: (q_ix(b, g), _clamp_i(i, j, bq, bk, causal, window, nq), 0)),
+        pl.BlockSpec((1, bk, D), lambda b, j, g, i: (b, j, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, j, g, i: (b, j, 0)),
+        pl.BlockSpec((1, bq, D), lambda b, j, g, i: (q_ix(b, g), _clamp_i(i, j, bq, bk, causal, window, nq), 0)),
+        pl.BlockSpec((1, 1, bq), lambda b, j, g, i: (q_ix(b, g), 0, _clamp_i(i, j, bq, bk, causal, window, nq))),
+        pl.BlockSpec((1, 1, bq), lambda b, j, g, i: (q_ix(b, g), 0, _clamp_i(i, j, bq, bk, causal, window, nq))),
+    ]
+    dkv_inputs = [qp, kp, vp, dop, lsep, deltap]
+    if alibi:
+        dkv_in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        dkv_inputs.append(slopes)
+
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, n_group=G, scale=scale, block_q=bq, block_k=bk,
-            seq_len=S, causal=causal, window=window, nq_total=nq,
+            seq_len=S, causal=causal, window=window, nq_total=nq, KV=KV,
+            alibi=alibi,
         ),
         grid=(BKV, nk, G, niw),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, j, g, i: (q_ix(b, g), _clamp_i(i, j, bq, bk, causal, window, nq), 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j, g, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j, g, i: (b, j, 0)),
-            pl.BlockSpec((1, bq, D), lambda b, j, g, i: (q_ix(b, g), _clamp_i(i, j, bq, bk, causal, window, nq), 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, j, g, i: (q_ix(b, g), 0, _clamp_i(i, j, bq, bk, causal, window, nq))),
-            pl.BlockSpec((1, 1, bq), lambda b, j, g, i: (q_ix(b, g), 0, _clamp_i(i, j, bq, bk, causal, window, nq))),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda b, j, g, i: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, j, g, i: (b, j, 0)),
@@ -431,7 +480,7 @@ def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, H, KV, window=0):
             pltpu.VMEM((bk, D), jnp.float32),
         ],
         interpret=_interpret(),
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(*dkv_inputs)
 
     return dq[:, :S], dk[:, :S], dv[:, :S]
 
@@ -440,14 +489,17 @@ def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, H, KV, window=0):
 # custom VJP + public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, block_q, block_k, H, KV, window):
-    o, _ = _flash_fwd(q, k, v, causal, block_q, block_k, H, KV, window)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, slopes, causal, block_q, block_k, H, KV, window, alibi):
+    o, _ = _flash_fwd(q, k, v, slopes, causal, block_q, block_k, H, KV,
+                      window, alibi)
     return o
 
 
-def _flash_fwd_rule(q, k, v, causal, block_q, block_k, H, KV, window):
-    o, lse = _flash_fwd(q, k, v, causal, block_q, block_k, H, KV, window)
+def _flash_fwd_rule(q, k, v, slopes, causal, block_q, block_k, H, KV, window,
+                    alibi):
+    o, lse = _flash_fwd(q, k, v, slopes, causal, block_q, block_k, H, KV,
+                        window, alibi)
     # Named for remat policies: models/transformer remat="save_attn"
     # saves exactly these (the kernel's own residuals), so the layer-body
     # recompute in the backward skips re-running the fwd kernel while
@@ -456,13 +508,15 @@ def _flash_fwd_rule(q, k, v, causal, block_q, block_k, H, KV, window):
 
     o = checkpoint_name(o, "flash_o")
     lse = checkpoint_name(lse, "flash_lse")
-    return o, (q, k, v, o, lse)
+    return o, (q, k, v, slopes, o, lse)
 
 
-def _flash_bwd_rule(causal, block_q, block_k, H, KV, window, res, do):
-    q, k, v, o, lse = res
-    return _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, H, KV,
-                      window)
+def _flash_bwd_rule(causal, block_q, block_k, H, KV, window, alibi, res, do):
+    q, k, v, slopes, o, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, slopes, o, lse, do, causal, block_q,
+                            block_k, H, KV, window, alibi)
+    # ALiBi slopes are architectural constants, never trained
+    return dq, dk, dv, jnp.zeros_like(slopes)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -470,7 +524,7 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 def flash_attention(
     q, k, v, causal: bool = True, block_q: int = 512, block_k: int = 1024,
-    window: int = 0,
+    window: int = 0, alibi=None,
 ):
     """[B,S,H,D] x [B,S,KV,D] x [B,S,KV,D] → [B,S,H,D] flash attention.
 
@@ -480,7 +534,12 @@ def flash_attention(
     window > 0: token-exact sliding window (Mistral-class) — requires
     causal; out-of-window blocks are pruned from both compute (@pl.when)
     and DMA (index-map clamps), so FLOPs/traffic scale with window, not
-    S^2."""
+    S^2.
+
+    alibi: optional [H] per-head ALiBi slopes (Bloom-class; ref the CUDA
+    attn_softmax_context alibi path) — the bias slope_h * (col - row)
+    joins each score tile from SMEM before the online softmax; the
+    backward kernels recompute probabilities with the same bias."""
     B, S, H, D = q.shape
     KV = k.shape[2]
     assert H % KV == 0, f"n_heads {H} not a multiple of kv_heads {KV}"
@@ -488,9 +547,14 @@ def flash_attention(
     bq = min(block_q, S)
     bk = min(block_k, S)
 
+    use_alibi = alibi is not None
+    slopes = (jnp.asarray(alibi, jnp.float32).reshape(H) if use_alibi
+              else jnp.zeros((1,), jnp.float32))
+
     def to_bh(x):
         h = x.shape[2]
         return x.transpose(0, 2, 1, 3).reshape(B * h, S, D)
 
-    o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, bq, bk, H, KV, window)
+    o = _flash(to_bh(q), to_bh(k), to_bh(v), slopes, causal, bq, bk, H, KV,
+               window, use_alibi)
     return o.reshape(B, H, S, D).transpose(0, 2, 1, 3)

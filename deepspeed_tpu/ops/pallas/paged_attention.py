@@ -55,13 +55,22 @@ def _decode_kernel(
     # write+attend; all -1 sentinel when not fused)
     q_ref, *rest,
     block_size: int, scale: float, n_kv: int, gp: int, window: int,
-    sparse: bool, fused: bool,
+    sparse: bool, fused: bool, alibi: bool,
 ):
+    # [KV, Gp] ALiBi slopes ride as the LAST input when alibi is on
+    ab_ref = None
     if fused:
-        (kn_ref, vn_ref, k_ref, v_ref,
-         o_ref, ck_out, cv_out, acc_sc, m_sc, l_sc) = rest
+        if alibi:
+            (kn_ref, vn_ref, k_ref, v_ref, ab_ref,
+             o_ref, ck_out, cv_out, acc_sc, m_sc, l_sc) = rest
+        else:
+            (kn_ref, vn_ref, k_ref, v_ref,
+             o_ref, ck_out, cv_out, acc_sc, m_sc, l_sc) = rest
     else:
-        k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc = rest
+        if alibi:
+            k_ref, v_ref, ab_ref, o_ref, acc_sc, m_sc, l_sc = rest
+        else:
+            k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc = rest
         kn_ref = vn_ref = ck_out = cv_out = None
     s = pl.program_id(0)
     j = pl.program_id(1)  # table slot (sequential; window-relative)
@@ -113,6 +122,10 @@ def _decode_kernel(
             q = q_ref[0, h]  # (Gp, D)
             kh = k[:, h, :]  # (bs, D)
             st = _dot(q, kh, trans_b=True) * scale  # (Gp, bs) f32
+            if alibi:
+                # bias slope_h * key_pos: exact up to the per-row shift
+                # softmax cancels (single query at position ctx-1)
+                st = st + ab_ref[h, :][:, None] * cols.astype(jnp.float32)
             st = jnp.where(live, st, NEG_INF)
 
             row = slice(h * gp, (h + 1) * gp)
@@ -136,6 +149,10 @@ def _decode_kernel(
                 stn = (jnp.sum(q * kn_ref[0, h][None, :], axis=1,
                                keepdims=True) * scale
                        ).astype(jnp.float32)  # (Gp, 1)
+                if alibi:
+                    # the new token sits at key position ctx-1
+                    stn = stn + (ab_ref[h, :][:, None]
+                                 * (ctx - 1).astype(jnp.float32))
                 row = slice(h * gp, (h + 1) * gp)
                 m_prev = m_sc[row]
                 m_new = jnp.maximum(m_prev, stn)
@@ -175,7 +192,8 @@ def _decode_kernel(
 
 def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens,
                            window: int = 0, allowed_slots=None,
-                           k_new=None, v_new=None, slots=None):
+                           k_new=None, v_new=None, slots=None,
+                           alibi_slopes=None):
     """One-token-per-sequence attention over the paged KV cache.
 
     q: [S, H, D] (the new token's queries)
@@ -212,6 +230,7 @@ def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens,
     scale = 1.0 / (D**0.5)
     sparse = allowed_slots is not None
     fused = k_new is not None
+    alibi = alibi_slopes is not None
     allow = (allowed_slots.astype(jnp.int32) if sparse
              else jnp.ones((S, NB), jnp.int32))
     slots_arr = (slots.astype(jnp.int32) if fused
@@ -220,6 +239,11 @@ def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens,
     qg = q.reshape(S, KV, G, D)
     if Gp != G:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, Gp - G), (0, 0)))
+    ab = None
+    if alibi:
+        ab = jnp.asarray(alibi_slopes, jnp.float32).reshape(KV, G)
+        if Gp != G:
+            ab = jnp.pad(ab, ((0, 0), (0, Gp - G)))
 
     def kv_index(s, j, tbl_ref, ctx_ref, allow_ref, slot_ref):
         last = jnp.maximum(ctx_ref[s] - 1, 0) // bs
@@ -254,6 +278,10 @@ def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens,
         in_specs += [pl.BlockSpec((1, KV, D), row_index),
                      pl.BlockSpec((1, KV, D), row_index)]
     in_specs += [kv_spec, kv_spec]
+    if alibi:  # whole [KV, Gp] slope table resident in VMEM
+        in_specs.append(pl.BlockSpec(
+            (KV, Gp), lambda s, j, tbl_ref, ctx_ref, allow_ref, slot_ref:
+            (0, 0)))
     o_spec = pl.BlockSpec((1, KV, Gp, D), q_index)
     o_shape = jax.ShapeDtypeStruct((S, KV, Gp, D), q.dtype)
     if fused:
@@ -282,23 +310,26 @@ def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens,
     call = pl.pallas_call(
         functools.partial(
             _decode_kernel, block_size=bs, scale=scale, n_kv=KV, gp=Gp,
-            window=window, sparse=sparse, fused=fused,
+            window=window, sparse=sparse, fused=fused, alibi=alibi,
         ),
         grid_spec=grid_spec,
         out_shape=out_shape,
         input_output_aliases=aliases,
         interpret=_interpret(),
     )
+    tail = (ab,) if alibi else ()
     if fused:
         out, ck, cv = call(block_table, ctx_lens, allow, slots_arr, qg,
-                           k_new, v_new, k_cache, v_cache)
+                           k_new, v_new, k_cache, v_cache, *tail)
         return out[:, :, :G, :].reshape(S, H, D), ck, cv
-    out = call(block_table, ctx_lens, allow, slots_arr, qg, k_cache, v_cache)
+    out = call(block_table, ctx_lens, allow, slots_arr, qg, k_cache, v_cache,
+               *tail)
     return out[:, :, :G, :].reshape(S, H, D)
 
 
 def paged_decode_attention_xla(q, k_cache, v_cache, block_table, ctx_lens,
-                               allowed=None, window: int = 0):
+                               allowed=None, window: int = 0,
+                               alibi_slopes=None):
     """jnp oracle for the kernel (tests; also a CPU fallback, and the
     block-sparse serving path via `allowed`).
 
@@ -307,7 +338,9 @@ def paged_decode_attention_xla(q, k_cache, v_cache, block_table, ctx_lens,
 
     allowed: optional [S, NB*bs] bool — extra per-position mask (the
     block-sparse layout row of each query's position).
-    window > 0: token-exact sliding window per row."""
+    window > 0: token-exact sliding window per row.
+    alibi_slopes: optional [H] — score bias slope_h * key_pos (the
+    single query row makes the absolute form exact under softmax)."""
     S, H, D = q.shape
     _, bs, KV, _ = k_cache.shape
     G = H // KV
@@ -319,6 +352,10 @@ def paged_decode_attention_xla(q, k_cache, v_cache, block_table, ctx_lens,
     logits = jnp.einsum("shd,skhd->shk", q, k).astype(jnp.float32)
     logits = logits / (D**0.5)
     pos = jnp.arange(k.shape[1])
+    if alibi_slopes is not None:
+        slopes = jnp.asarray(alibi_slopes, jnp.float32)
+        logits = logits + (slopes[None, :, None]
+                           * pos.astype(jnp.float32)[None, None, :])
     mask = pos[None, :] < ctx_lens[:, None]  # [S, NB*bs]
     if window > 0:
         mask = mask & (pos[None, :] >= ctx_lens[:, None] - window)
@@ -336,11 +373,15 @@ def paged_decode_attention_xla(q, k_cache, v_cache, block_table, ctx_lens,
 def _decode_fused_kernel(
     tbl_ref, ctx_ref, slot_ref, allow_ref,          # scalar prefetch
     q_ref, kn_ref, vn_ref, k_any, v_any,            # inputs (caches in HBM)
-    o_ref, ck_any, cv_any,                          # outputs (caches aliased)
-    bufk, bufv, wsem, lsem,                         # scratch
-    *, n_seqs: int, block_size: int, scale: float, n_kv: int, gp: int,
-    window: int, sparse: bool,
+    *rest,                                          # [ab], outs, scratch
+    n_seqs: int, block_size: int, scale: float, n_kv: int, gp: int,
+    window: int, sparse: bool, alibi: bool,
 ):
+    if alibi:  # [KV, Gp] ALiBi slope table rides as the LAST input
+        ab_ref, o_ref, ck_any, cv_any, bufk, bufv, wsem, lsem = rest
+    else:
+        o_ref, ck_any, cv_any, bufk, bufv, wsem, lsem = rest
+        ab_ref = None
     """One grid step per SEQUENCE (compile size O(1) in batch — an
     earlier all-sequences-unrolled variant ran ~8us/call faster at S=8
     but its Mosaic compile exploded at S=64). The KV arenas stay in HBM
@@ -443,6 +484,8 @@ def _decode_fused_kernel(
         for h in range(n_kv):
             q = q_ref[s, h]  # (Gp, D)
             st = _dot(q, kb[:, h, :], trans_b=True) * scale  # (Gp, bs)
+            if alibi:
+                st = st + ab_ref[h, :][:, None] * cols.astype(jnp.float32)
             st = jnp.where(live, st, NEG_INF)
             m_new = jnp.maximum(ms[h], jnp.max(st, axis=1, keepdims=True))
             p = jnp.exp(st - m_new)
@@ -470,6 +513,11 @@ def _decode_fused_kernel(
     ms, ls, accs = jax.lax.fori_loop(jbase_of(ctx), nblk_of(ctx),
                                      body, init)
 
+    if alibi:
+        # fold the new token's ALiBi bias into its online-softmax column
+        ab_newcol = [ab_ref[h, :][:, None] * (ctx - 1).astype(jnp.float32)
+                     for h in range(n_kv)]
+
     # this sequence's new row -> its cache slot, started only AFTER its
     # own block loads are consumed: the write may tear bf16 values
     # mid-DMA, and although the row's column is masked out of the
@@ -493,6 +541,8 @@ def _decode_fused_kernel(
             q = q_ref[s, h]
             stn = (jnp.sum(q * kn_ref[s, h][None, :], axis=1,
                            keepdims=True) * scale).astype(jnp.float32)
+            if alibi:
+                stn = stn + ab_newcol[h]
             m_new = jnp.maximum(ms[h], stn)
             p = jnp.exp(stn - m_new)
             corr = jnp.exp(ms[h] - m_new)
@@ -530,7 +580,7 @@ def supports_fused_v2(head_dim: int) -> bool:
 
 def paged_decode_fused(q, k_cache, v_cache, block_table, ctx_lens,
                        k_new, v_new, slots, window: int = 0,
-                       allowed_slots=None):
+                       allowed_slots=None, alibi_slopes=None):
     """Fused single-token decode: write the batch's new KV rows into the
     paged arenas AND attend over them, one kernel launch. The serving
     engine's hot path for dense AND (via allowed_slots) block-sparse
@@ -557,12 +607,19 @@ def paged_decode_fused(q, k_cache, v_cache, block_table, ctx_lens,
     Gp = max(G, 8)
     scale = 1.0 / (D**0.5)
     sparse = allowed_slots is not None
+    alibi = alibi_slopes is not None
     allow = (allowed_slots.astype(jnp.int32) if sparse
              else jnp.zeros((S, NB), jnp.int32))
 
     qg = q.reshape(S, KV, G, D)
     if Gp != G:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, Gp - G), (0, 0)))
+    ab = ()
+    if alibi:
+        ab_arr = jnp.asarray(alibi_slopes, jnp.float32).reshape(KV, G)
+        if Gp != G:
+            ab_arr = jnp.pad(ab_arr, ((0, 0), (0, Gp - G)))
+        ab = (ab_arr,)
 
     vmem = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -572,7 +629,7 @@ def paged_decode_fused(q, k_cache, v_cache, block_table, ctx_lens,
             vmem(), vmem(), vmem(),
             pl.BlockSpec(memory_space=pltpu.ANY),
             pl.BlockSpec(memory_space=pltpu.ANY),
-        ],
+        ] + ([vmem()] if alibi else []),
         out_specs=[
             vmem(),
             pl.BlockSpec(memory_space=pltpu.ANY),
@@ -588,7 +645,7 @@ def paged_decode_fused(q, k_cache, v_cache, block_table, ctx_lens,
     out, ck, cv = pl.pallas_call(
         functools.partial(
             _decode_fused_kernel, n_seqs=S, block_size=bs, scale=scale,
-            n_kv=KV, gp=Gp, window=window, sparse=sparse,
+            n_kv=KV, gp=Gp, window=window, sparse=sparse, alibi=alibi,
         ),
         grid_spec=grid_spec,
         out_shape=[
@@ -596,11 +653,11 @@ def paged_decode_fused(q, k_cache, v_cache, block_table, ctx_lens,
             jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
             jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
         ],
-        # args: 4 scalar prefetch, q, kn, vn, k_cache, v_cache
+        # args: 4 scalar prefetch, q, kn, vn, k_cache, v_cache [, ab]
         input_output_aliases={7: 1, 8: 2},
         interpret=_interpret(),
     )(block_table, ctx_lens, slots.astype(jnp.int32), allow, qg,
-      k_new, v_new, k_cache, v_cache)
+      k_new, v_new, k_cache, v_cache, *ab)
     return out[:, :, :G, :].reshape(S, H, D), ck, cv
 
 

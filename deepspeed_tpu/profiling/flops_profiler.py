@@ -193,7 +193,7 @@ def module_profile_tree(cfg, seq_len: int, batch_size: int = 1
                      n_ln * 5 * T * E),
     })
     top = {
-        "embed": mod(V * E + (cfg.max_seq * E if cfg.variant == "gpt2"
+        "embed": mod(V * E + (cfg.max_seq * E if cfg.use_learned_pos
                               else 0), 0),
         "layers": mod(0, 0, {f"layer_{i}": layer for i in range(L)}),
         "final_norm": mod(E * (2 if cfg.norm_has_bias else 1), 5 * T * E),

@@ -138,6 +138,7 @@ SUPPORTED_ARCHITECTURES = sorted(_LLAMA_FAMILY | {
     "GPT2LMHeadModel", "OPTForCausalLM", "FalconForCausalLM",
     "RWForCausalLM",  # falcon's pre-rename arch string
     "PhiForCausalLM", "QWenLMHeadModel",
+    "BloomForCausalLM", "GPTNeoXForCausalLM", "GPTJForCausalLM",
 })
 
 
@@ -195,10 +196,9 @@ def config_from_hf(hf: Dict[str, Any], **overrides) -> TransformerConfig:
     elif arch in ("FalconForCausalLM", "RWForCausalLM"):
         # ref: inference/v2/model_implementations/falcon/model.py —
         # parallel attn+MLP residual; 7B: multi-query + ONE layernorm,
-        # 40B+ (new_decoder_architecture): GQA + ln_attn/ln_mlp pair
-        if hf.get("alibi"):
-            raise ValueError("falcon with alibi positions is unsupported "
-                             "(rotary falcon checkpoints only)")
+        # 40B+ (new_decoder_architecture): GQA + ln_attn/ln_mlp pair.
+        # falcon-rw class checkpoints set alibi=True (ALiBi replaces
+        # rotary — ref containers/bloom.py alibi path applies equally).
         new_arch = bool(hf.get("new_decoder_architecture"))
         n_heads = hf.get("num_attention_heads", hf.get("n_head"))
         if new_arch:
@@ -226,7 +226,12 @@ def config_from_hf(hf: Dict[str, Any], **overrides) -> TransformerConfig:
             rope_theta=float(hf.get("rope_theta", 10000.0)),
             norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
             tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
+            alibi=bool(hf.get("alibi", False)),
         )
+        if kw["alibi"]:
+            # falcon applies alibi before the 1/sqrt(D) score scale
+            D = kw["d_model"] // kw["n_heads"]
+            kw["alibi_slope_scale"] = 1.0 / (D ** 0.5)
     elif arch == "OPTForCausalLM":
         # ref: inference/v2/model_implementations/opt/model.py — learned
         # positions (+2 row offset in the HF table), ReLU MLP, biases
@@ -291,6 +296,81 @@ def config_from_hf(hf: Dict[str, Any], **overrides) -> TransformerConfig:
             rope_theta=float(hf.get("rotary_emb_base", 10000.0)),
             norm_eps=float(hf.get("layer_norm_epsilon", 1e-6)),
             tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        )
+    elif arch == "BloomForCausalLM":
+        # ref: module_inject/containers/bloom.py — ALiBi positions (no
+        # rope, no learned table), embedding layernorm, fused per-head
+        # QKV, tanh-approx GELU, biases everywhere, tied head
+        E = hf.get("hidden_size", hf.get("n_embed"))
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            n_layers=hf.get("num_hidden_layers", hf.get("n_layer")),
+            n_heads=hf.get("num_attention_heads", hf.get("n_head")),
+            d_model=E,
+            d_ff=4 * E,
+            max_seq=int(hf.get("seq_length", 2048)),
+            variant="gpt2",           # LayerNorm + gelu + biases family
+            alibi=True,
+            embedding_layernorm=True,
+            activation="gelu",        # BloomGelu is the tanh approximation
+            norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
+            tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
+        )
+    elif arch == "GPTNeoXForCausalLM":
+        # ref: module_inject/containers/gptneox.py — partial rotary
+        # (rotary_pct, split-halves pairing), parallel residual with TWO
+        # layernorms, fused per-head QKV, biases, untied embed_out
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            n_layers=hf["num_hidden_layers"],
+            n_heads=hf["num_attention_heads"],
+            d_model=hf["hidden_size"],
+            d_ff=hf.get("intermediate_size") or 4 * hf["hidden_size"],
+            max_seq=hf.get("max_position_embeddings", 2048),
+            variant="llama",
+            norm_type="layer",
+            gated_mlp=False,
+            # HF hidden_act default "gelu" is the erf form
+            activation={"gelu": "gelu_exact", "gelu_new": "gelu",
+                        "gelu_fast": "gelu",
+                        "relu": "relu"}.get(hf.get("hidden_act", "gelu"),
+                                            "gelu_exact"),
+            qkv_bias=True,
+            attn_out_bias=True,
+            mlp_bias=True,
+            parallel_residual=bool(hf.get("use_parallel_residual", True)),
+            rotary_pct=float(hf.get("rotary_pct", 0.25)),
+            rope_theta=float(hf.get("rotary_emb_base", 10000.0)),
+            norm_eps=float(hf.get("layer_norm_eps", 1e-5)),
+            tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        )
+    elif arch == "GPTJForCausalLM":
+        # ref: module_inject/containers/gptj.py — partial rotary with
+        # the INTERLEAVED (rotate_every_two) pairing, parallel residual
+        # sharing ONE layernorm, unbiased attn, biased MLP + lm_head
+        E = hf.get("n_embd", hf.get("hidden_size"))
+        H = hf.get("n_head", hf.get("num_attention_heads"))
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            n_layers=hf.get("n_layer", hf.get("num_hidden_layers")),
+            n_heads=H,
+            d_model=E,
+            d_ff=hf.get("n_inner") or 4 * E,
+            max_seq=hf.get("n_positions", 2048),
+            variant="llama",
+            norm_type="layer",
+            gated_mlp=False,
+            activation="gelu",        # gelu_new (tanh approximation)
+            qkv_bias=False,
+            attn_out_bias=False,
+            mlp_bias=True,
+            parallel_residual=True,
+            shared_ln=True,
+            rotary_pct=float(hf.get("rotary_dim") or (E // H)) / (E // H),
+            rope_interleaved=True,
+            norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
+            tie_embeddings=False,
+            lm_head_bias=True,
         )
     elif arch == "GPT2LMHeadModel":
         kw = dict(
@@ -508,6 +588,65 @@ def _map_qwen_layer(r: _CheckpointReader, i: int,
     }
 
 
+def _split_headmajor_qkv(w: np.ndarray, cfg: TransformerConfig):
+    """Bloom/GPT-NeoX fused query_key_value: output rows laid out
+    HEAD-MAJOR as (H, [q, k, v], D) — unlike GPT-2's three contiguous
+    E-sized chunks. w arrives transposed [E, 3E] (or [1, 3E] for the
+    bias-as-row trick)."""
+    H, D = cfg.n_heads, cfg.head_dim
+    lead = w.shape[0]
+    g = w.reshape(lead, H, 3, D)
+    return g[:, :, 0], g[:, :, 1], g[:, :, 2]
+
+
+def _map_headmajor_layer(r: _CheckpointReader, i: int,
+                         cfg: TransformerConfig, layer_prefix: str,
+                         attn: str) -> Dict[str, np.ndarray]:
+    """Bloom ('transformer.h.', 'self_attention.') and GPT-NeoX
+    ('gpt_neox.layers.', 'attention.') share this exact layer shape:
+    two layernorms, head-major fused QKV, biased dense + 4h MLP."""
+    E, H, D = cfg.d_model, cfg.n_heads, cfg.head_dim
+    p = f"{layer_prefix}{i}."
+    a = p + attn
+    wq, wk, wv = _split_headmajor_qkv(r.get(a + "query_key_value.weight").T,
+                                      cfg)
+    bq, bk, bv = _split_headmajor_qkv(
+        r.get(a + "query_key_value.bias")[None], cfg)
+    return {
+        "ln1_scale": r.get(p + "input_layernorm.weight"),
+        "ln1_bias": r.get(p + "input_layernorm.bias"),
+        "ln2_scale": r.get(p + "post_attention_layernorm.weight"),
+        "ln2_bias": r.get(p + "post_attention_layernorm.bias"),
+        "wq": wq, "wk": wk, "wv": wv,
+        "bq": bq[0], "bk": bk[0], "bv": bv[0],
+        "wo": r.get(a + "dense.weight").T.reshape(H, D, E),
+        "bo": r.get(a + "dense.bias"),
+        "w_in": r.get(p + "mlp.dense_h_to_4h.weight").T,
+        "b_in": r.get(p + "mlp.dense_h_to_4h.bias"),
+        "w_out": r.get(p + "mlp.dense_4h_to_h.weight").T,
+        "b_out": r.get(p + "mlp.dense_4h_to_h.bias"),
+    }
+
+
+def _map_gptj_layer(r: _CheckpointReader, i: int,
+                    cfg: TransformerConfig) -> Dict[str, np.ndarray]:
+    E, H, D = cfg.d_model, cfg.n_heads, cfg.head_dim
+    p = f"transformer.h.{i}."
+    a = p + "attn."
+    return {
+        "ln1_scale": r.get(p + "ln_1.weight"),
+        "ln1_bias": r.get(p + "ln_1.bias"),
+        "wq": r.get(a + "q_proj.weight").T.reshape(E, H, D),
+        "wk": r.get(a + "k_proj.weight").T.reshape(E, H, D),
+        "wv": r.get(a + "v_proj.weight").T.reshape(E, H, D),
+        "wo": r.get(a + "out_proj.weight").T.reshape(H, D, E),
+        "w_in": r.get(p + "mlp.fc_in.weight").T,
+        "b_in": r.get(p + "mlp.fc_in.bias"),
+        "w_out": r.get(p + "mlp.fc_out.weight").T,
+        "b_out": r.get(p + "mlp.fc_out.bias"),
+    }
+
+
 def _gpt2_top(r: _CheckpointReader) -> Dict[str, str]:
     pre = "transformer." if "transformer.wte.weight" in r else ""
     return {
@@ -609,6 +748,37 @@ def import_external(
         if not cfg.tie_embeddings:
             params["lm_head"] = cast(r.get("lm_head.weight").T)
         layer_fn = lambda i: _map_qwen_layer(r, i, cfg)
+    elif arch == "BloomForCausalLM":
+        params = {
+            "embed": cast(r.get("transformer.word_embeddings.weight")),
+            "embed_ln_scale": cast(
+                r.get("transformer.word_embeddings_layernorm.weight")),
+            "embed_ln_bias": cast(
+                r.get("transformer.word_embeddings_layernorm.bias")),
+            "ln_f_scale": cast(r.get("transformer.ln_f.weight")),
+            "ln_f_bias": cast(r.get("transformer.ln_f.bias")),
+        }
+        layer_fn = lambda i: _map_headmajor_layer(
+            r, i, cfg, "transformer.h.", "self_attention.")
+    elif arch == "GPTNeoXForCausalLM":
+        params = {
+            "embed": cast(r.get("gpt_neox.embed_in.weight")),
+            "ln_f_scale": cast(r.get("gpt_neox.final_layer_norm.weight")),
+            "ln_f_bias": cast(r.get("gpt_neox.final_layer_norm.bias")),
+        }
+        if not cfg.tie_embeddings:
+            params["lm_head"] = cast(r.get("embed_out.weight").T)
+        layer_fn = lambda i: _map_headmajor_layer(
+            r, i, cfg, "gpt_neox.layers.", "attention.")
+    elif arch == "GPTJForCausalLM":
+        params = {
+            "embed": cast(r.get("transformer.wte.weight")),
+            "ln_f_scale": cast(r.get("transformer.ln_f.weight")),
+            "ln_f_bias": cast(r.get("transformer.ln_f.bias")),
+            "lm_head": cast(r.get("lm_head.weight").T),
+            "lm_head_b": cast(r.get("lm_head.bias")),
+        }
+        layer_fn = lambda i: _map_gptj_layer(r, i, cfg)
     else:
         params = {
             "embed": cast(r.get("model.embed_tokens.weight")),

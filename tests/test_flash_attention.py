@@ -53,7 +53,7 @@ class TestForwardKernel:
         k = jnp.asarray(rng.normal(size=(BH, S, D)), jnp.float32)
         v = jnp.asarray(rng.normal(size=(BH, S, D)), jnp.float32)
         with jax.default_matmul_precision("highest"):
-            o, lse = _flash_fwd(q, k, v, causal, BLK, BLK, H=1, KV=1)
+            o, lse = _flash_fwd(q, k, v, None, causal, BLK, BLK, H=1, KV=1)
             ref = oracle(q[:, :, None], k[:, :, None], v[:, :, None], causal)[:, :, 0]
             # reference lse
             scale = 1.0 / (D**0.5)
@@ -87,8 +87,9 @@ class TestBackwardKernels:
 
         dq_ref, dk_ref, dv_ref = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
 
-        o, lse = _flash_fwd(q, k, v, causal, BLK, BLK, H=1, KV=1)
-        dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, causal, BLK, BLK, H=1, KV=1)
+        o, lse = _flash_fwd(q, k, v, None, causal, BLK, BLK, H=1, KV=1)
+        dq, dk, dv = _flash_bwd(q, k, v, None, o, lse, do, causal, BLK, BLK,
+                                H=1, KV=1)
         np.testing.assert_allclose(dq, dq_ref, rtol=2e-3, atol=2e-3)
         np.testing.assert_allclose(dk, dk_ref, rtol=2e-3, atol=2e-3)
         np.testing.assert_allclose(dv, dv_ref, rtol=2e-3, atol=2e-3)
@@ -194,3 +195,52 @@ class TestSlidingWindowKernel:
                                  jnp.repeat(v, n_rep, axis=2),
                                  causal=True, window=32)
         np.testing.assert_allclose(o, ref, rtol=2e-3, atol=2e-3)
+
+
+class TestAlibi:
+    """ALiBi-biased flash kernels vs the XLA oracle (Bloom-class models;
+    ref: the CUDA softmax alibi path in csrc/transformer/inference)."""
+
+    def _slopes(self, H):
+        from deepspeed_tpu.ops.attention import alibi_slopes
+
+        return jnp.asarray(alibi_slopes(H))
+
+    @pytest.mark.parametrize("KV", [2, 4])
+    def test_fwd_and_grads_match_oracle(self, rng, KV):
+        H = 4
+        q, k, v = make_qkv(rng, B=2, S=2 * BLK, H=H, KV=KV, D=64)
+        ab = self._slopes(H)
+
+        def orc(q, k, v):
+            n_rep = H // KV
+            return _xla_attention(jnp.repeat(q, 1, axis=2),
+                                  jnp.repeat(k, n_rep, axis=2),
+                                  jnp.repeat(v, n_rep, axis=2),
+                                  causal=True, alibi=ab)
+
+        with jax.default_matmul_precision("highest"):
+            out = flash_attention(q, k, v, causal=True, block_q=BLK,
+                                  block_k=BLK, alibi=ab)
+            ref = orc(q, k, v)
+            np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+            do = jnp.asarray(rng.normal(size=out.shape), out.dtype)
+            gk = jax.grad(lambda *a: jnp.sum(flash_attention(
+                *a, causal=True, block_q=BLK, block_k=BLK, alibi=ab) * do),
+                argnums=(0, 1, 2))(q, k, v)
+            go = jax.grad(lambda *a: jnp.sum(orc(*a) * do),
+                          argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gk, go):
+            np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-3)
+
+    def test_alibi_with_window(self, rng):
+        """ALiBi composes with the sliding-window mask."""
+        H = 4
+        q, k, v = make_qkv(rng, B=1, S=2 * BLK, H=H, D=64)
+        ab = self._slopes(H)
+        with jax.default_matmul_precision("highest"):
+            out = flash_attention(q, k, v, causal=True, block_q=BLK,
+                                  block_k=BLK, window=40, alibi=ab)
+            ref = _xla_attention(q, k, v, causal=True, window=40, alibi=ab)
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)

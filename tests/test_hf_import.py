@@ -313,12 +313,77 @@ class TestFamilyZoo:
         assert not cfg.parallel_residual and not cfg.shared_ln
         assert cfg.has_qkv_bias and cfg.kv_heads == 4
 
-    def test_falcon_alibi_rejected(self, tmp_path):
-        with pytest.raises(ValueError, match="alibi"):
-            config_from_hf({"architectures": ["FalconForCausalLM"],
-                            "alibi": True, "vocab_size": 8,
-                            "hidden_size": 8, "num_hidden_layers": 1,
-                            "num_attention_heads": 1})
+    def test_falcon_alibi_form(self, rng, tmp_path):
+        """falcon-rw class: ALiBi positions + sequential residuals
+        (round-5: alibi is now a first-class position encoding)."""
+        torch.manual_seed(26)
+        hf_cfg = transformers.FalconConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, new_decoder_architecture=False,
+            multi_query=False, parallel_attn=False, bias=True, alibi=True,
+            tie_word_embeddings=True)
+        m = transformers.FalconForCausalLM(hf_cfg).eval()
+        path = _save(m, tmp_path)
+        cfg, _ = self._check(m, path, rng)
+        assert cfg.alibi and not cfg.use_rope
+        self._serve(path, rng, m)
+
+    def test_bloom(self, rng, tmp_path):
+        """Bloom: ALiBi + embedding layernorm + head-major fused QKV.
+        ref: module_inject/containers/bloom.py."""
+        torch.manual_seed(27)
+        hf_cfg = transformers.BloomConfig(
+            vocab_size=128, hidden_size=64, n_layer=2, n_head=4,
+            tie_word_embeddings=True)
+        m = transformers.BloomForCausalLM(hf_cfg).eval()
+        path = _save(m, tmp_path)
+        cfg, _ = self._check(m, path, rng)
+        assert cfg.alibi and cfg.embedding_layernorm
+        assert not cfg.use_learned_pos
+        self._serve(path, rng, m)
+
+    def test_gpt_neox(self, rng, tmp_path):
+        """GPT-NeoX: partial rotary (pct), parallel residual with two
+        layernorms, head-major fused QKV, untied embed_out.
+        ref: module_inject/containers/gptneox.py."""
+        torch.manual_seed(28)
+        hf_cfg = transformers.GPTNeoXConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=96, rotary_pct=0.25,
+            use_parallel_residual=True, tie_word_embeddings=False)
+        m = transformers.GPTNeoXForCausalLM(hf_cfg).eval()
+        path = _save(m, tmp_path)
+        cfg, _ = self._check(m, path, rng)
+        assert cfg.parallel_residual and not cfg.shared_ln
+        assert cfg.rotary_pct == 0.25 and not cfg.rope_interleaved
+        self._serve(path, rng, m)
+
+    def test_gpt_neox_sequential(self, rng, tmp_path):
+        """use_parallel_residual=False NeoX trains sequentially."""
+        torch.manual_seed(29)
+        hf_cfg = transformers.GPTNeoXConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=96, rotary_pct=1.0,
+            use_parallel_residual=False, tie_word_embeddings=False)
+        m = transformers.GPTNeoXForCausalLM(hf_cfg).eval()
+        path = _save(m, tmp_path)
+        cfg, _ = self._check(m, path, rng)
+        assert not cfg.parallel_residual
+
+    def test_gptj(self, rng, tmp_path):
+        """GPT-J: interleaved (rotate_every_two) partial rotary, ONE
+        shared layernorm, unbiased attention, biased lm_head.
+        ref: module_inject/containers/gptj.py."""
+        torch.manual_seed(30)
+        hf_cfg = transformers.GPTJConfig(
+            vocab_size=128, n_embd=64, n_layer=2, n_head=4,
+            rotary_dim=8, tie_word_embeddings=False)
+        m = transformers.GPTJForCausalLM(hf_cfg).eval()
+        path = _save(m, tmp_path)
+        cfg, _ = self._check(m, path, rng)
+        assert cfg.rope_interleaved and cfg.shared_ln
+        assert cfg.rotary_pct == 0.5 and cfg.lm_head_bias
+        self._serve(path, rng, m)
 
     def test_opt(self, rng, tmp_path):
         """learned positions (+2 offset), ReLU, biases everywhere."""

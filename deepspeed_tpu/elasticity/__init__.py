@@ -1,3 +1,11 @@
+from .agent import (
+    HealthMonitor,
+    Heartbeat,
+    WorldDegradedError,
+    heartbeat_from_env,
+    run_elastic,
+    scan_heartbeats,
+)
 from .elasticity import (
     ElasticityError,
     ElasticityIncompatibleWorldSize,

@@ -1,0 +1,379 @@
+"""Runtime failure detection + elastic restart.
+
+TPU-native redesign of the reference's elastic agent
+(ref: elasticity/elastic_agent.py:28 DSElasticAgent — a torchelastic
+agent whose `_invoke_run` monitor loop (:121) polls worker health every
+monitor_interval and tears down / restarts the world on failure).
+
+The TPU shape (SURVEY §5): one controller process per host; XLA
+collectives have NO timeout, so a dead or hung host leaves every
+survivor blocked inside the next collective forever. Detection must
+therefore happen OUTSIDE the compiled step, on the host control plane:
+
+- every controller writes a monotonic **heartbeat file** around its
+  step loop (`Heartbeat.beat`, wired into engine.train_batch when
+  `DS_ELASTIC_HEARTBEAT_DIR` is set). The medium is a shared
+  filesystem — the same medium the checkpoint engine already requires
+  on a pod (GCS/NFS fuse) — so no extra service and no rank-0 single
+  point of failure.
+- a **HealthMonitor** thread on each controller scans peers'
+  heartbeats; when one goes stale the monitor flips `degraded`, and
+  the training loop's next `check()` raises WorldDegradedError BEFORE
+  issuing another collective (survivors exit cleanly instead of
+  hanging; their state is at the last committed checkpoint).
+- a per-host **supervisor** (`run_elastic`) owns the worker process:
+  it relaunches the world at the surviving size with a bumped
+  generation, exactly DSElasticAgent's restart-and-continue journey.
+  Workers resume from the last committed checkpoint; the elastic batch
+  arithmetic (elasticity.compute_elastic_config, already enforced by
+  the engine config) re-derives the SAME global batch at the new world
+  size, and universal/orbax checkpoints make the resharded load legal
+  (tests/test_elastic_autotune.py::TestElasticResume proves the
+  trajectory continues).
+
+Worker-side env contract (set by run_elastic):
+  DS_ELASTIC_HEARTBEAT_DIR  — heartbeat directory (shared fs)
+  DS_ELASTIC_GENERATION     — restart generation (0 = first launch)
+  DS_ELASTIC_RESUME_DIR     — checkpoint dir to resume from (generation
+                              > 0; workers load it if it has a 'latest')
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+HEARTBEAT_DIR_ENV = "DS_ELASTIC_HEARTBEAT_DIR"
+GENERATION_ENV = "DS_ELASTIC_GENERATION"
+RESUME_DIR_ENV = "DS_ELASTIC_RESUME_DIR"
+
+
+class WorldDegradedError(RuntimeError):
+    """A peer controller missed its heartbeat: the world is degraded and
+    issuing further collectives would hang. Checkpoint (if state is
+    clean) and exit; the supervisor restarts at the surviving size."""
+
+    def __init__(self, failed_ranks: Sequence[int]):
+        self.failed_ranks = list(failed_ranks)
+        super().__init__(
+            f"world degraded: no heartbeat from rank(s) {self.failed_ranks}"
+        )
+
+
+def _hb_path(hb_dir: str, rank: int) -> str:
+    return os.path.join(hb_dir, f"hb_{rank}.json")
+
+
+class Heartbeat:
+    """One controller's liveness record: an atomically-replaced file
+    carrying (rank, step, generation, wall time). Written around the
+    step loop — a wedged step loop stops beating, which is exactly the
+    failure the monitor must catch (a process can be alive and hung)."""
+
+    def __init__(self, hb_dir: str, rank: int, generation: int = 0):
+        self.dir = hb_dir
+        self.rank = rank
+        self.generation = generation
+        os.makedirs(hb_dir, exist_ok=True)
+
+    def beat(self, step: int) -> None:
+        payload = json.dumps({
+            "rank": self.rank, "step": int(step),
+            "generation": self.generation, "time": time.time(),
+        })
+        tmp = _hb_path(self.dir, self.rank) + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(payload)
+        os.replace(tmp, _hb_path(self.dir, self.rank))  # atomic publish
+
+
+def scan_heartbeats(hb_dir: str, world: int,
+                    generation: Optional[int] = None) -> Dict[int, dict]:
+    """rank → latest heartbeat payload (missing/corrupt files omitted;
+    `generation` filters out stale files from a previous incarnation)."""
+    out: Dict[int, dict] = {}
+    for r in range(world):
+        try:
+            with open(_hb_path(hb_dir, r)) as f:
+                hb = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if generation is not None and hb.get("generation") != generation:
+            continue
+        out[r] = hb
+    return out
+
+
+class StalenessTracker:
+    """Judge staleness by when THIS observer last saw a peer's heartbeat
+    CONTENT change — never by comparing the peer's embedded wall clock
+    against the local clock (cross-host clock skew would otherwise make
+    a healthy peer look permanently stale, or silently stretch
+    detection latency)."""
+
+    def __init__(self, timeout_s: float):
+        self.timeout_s = timeout_s
+        self._seen: Dict[int, Tuple[tuple, float]] = {}
+
+    def observe(self, hbs: Dict[int, dict], now: float) -> List[int]:
+        """Feed one scan; returns ranks whose content is stale by the
+        LOCAL clock. Ranks that never produced a heartbeat are not
+        reported (startup is the first-beat deadline's job)."""
+        stale = []
+        for r, hb in hbs.items():
+            fp = (hb.get("step"), hb.get("time"))
+            prev = self._seen.get(r)
+            if prev is None or prev[0] != fp:
+                self._seen[r] = (fp, now)
+            elif now - prev[1] > self.timeout_s:
+                stale.append(r)
+        return stale
+
+
+class HealthMonitor:
+    """Background scanner of peer heartbeats (the worker-side half of
+    DSElasticAgent._invoke_run's monitor loop).
+
+    A peer is declared failed when it HAS beaten this generation but its
+    latest beat is older than `timeout_s` (startup/compile time is
+    excluded by the has-beaten condition; the supervisor separately
+    bounds startup with its own first-beat deadline). The training loop
+    calls `check()` between steps — before the next collective."""
+
+    def __init__(self, hb_dir: str, rank: int, world: int,
+                 timeout_s: float = 60.0, interval_s: float = 1.0,
+                 generation: int = 0,
+                 on_degraded: Optional[Callable[[List[int]], None]] = None):
+        self.hb_dir = hb_dir
+        self.rank = rank
+        self.world = world
+        self.timeout_s = timeout_s
+        self.interval_s = interval_s
+        self.generation = generation
+        self.on_degraded = on_degraded
+        self.failed_ranks: List[int] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "HealthMonitor":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ds-health-monitor")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- queries --------------------------------------------------------
+    @property
+    def degraded(self) -> bool:
+        return bool(self.failed_ranks)
+
+    def check(self) -> None:
+        """Raise WorldDegradedError if a peer died — call between steps,
+        BEFORE issuing the next collective."""
+        if self.degraded:
+            raise WorldDegradedError(self.failed_ranks)
+
+    # -- scanner --------------------------------------------------------
+    def _run(self) -> None:
+        tracker = StalenessTracker(self.timeout_s)
+        while not self._stop.wait(self.interval_s):
+            hbs = scan_heartbeats(self.hb_dir, self.world, self.generation)
+            hbs.pop(self.rank, None)
+            failed = tracker.observe(hbs, time.monotonic())
+            if failed and not self.failed_ranks:
+                self.failed_ranks = failed
+                if self.on_degraded is not None:
+                    try:
+                        self.on_degraded(failed)
+                    except Exception:  # callback must not kill the scanner
+                        pass
+
+
+# ---------------------------------------------------------------------------
+# supervisor: launch, watch, restart (the DSElasticAgent node loop)
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _launch_generation(
+    cmd: List[str], num_procs: int, generation: int, hb_dir: str,
+    hb_timeout_s: float, first_beat_timeout_s: float,
+    devices_per_proc: int = 0, env_extra=None, timeout_s: float = 0,
+) -> Tuple[int, str]:
+    """One world incarnation: spawn num_procs ranks, watch BOTH process
+    exits and heartbeat staleness (launch_local only catches death; a
+    hung-but-alive rank needs the heartbeat). Returns (rc, reason) with
+    reason in {'ok', 'exit', 'heartbeat', 'timeout', 'startup'}."""
+    port = str(_free_port())
+    procs: List[subprocess.Popen] = []
+    threads: List[threading.Thread] = []
+
+    def _stream(p: subprocess.Popen, rank: int) -> None:
+        for line in p.stdout:  # type: ignore[union-attr]
+            sys.stdout.write(f"[g{generation} rank{rank}] {line}")
+            sys.stdout.flush()
+
+    for rank in range(num_procs):
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        env["MASTER_ADDR"] = "127.0.0.1"
+        env["MASTER_PORT"] = port
+        env["WORLD_SIZE"] = str(num_procs)
+        env["RANK"] = str(rank)
+        env["LOCAL_RANK"] = str(rank)
+        env[HEARTBEAT_DIR_ENV] = hb_dir
+        env[GENERATION_ENV] = str(generation)
+        if devices_per_proc:
+            env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "cpu")
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={devices_per_proc}"
+            )
+        p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+        procs.append(p)
+        t = threading.Thread(target=_stream, args=(p, rank), daemon=True)
+        t.start()
+        threads.append(t)
+
+    def _kill_all():
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + 10
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(0.1, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+    start = time.monotonic()
+    rc, reason = 0, "ok"
+    tracker = StalenessTracker(hb_timeout_s)
+    try:
+        while True:
+            codes = [p.poll() for p in procs]
+            failed = [(i, c) for i, c in enumerate(codes)
+                      if c not in (None, 0)]
+            if failed:
+                rank, rc = failed[0]
+                print(f"[elastic-agent g{generation}] rank {rank} exited "
+                      f"rc={rc}; tearing down the world", file=sys.stderr)
+                reason = "exit"
+                _kill_all()
+                break
+            if all(c is not None for c in codes):
+                break
+            hbs = scan_heartbeats(hb_dir, num_procs, generation)
+            # a rank that already exited CLEANLY stops beating by
+            # design — never count its silence as a failure
+            live_hbs = {r: hb for r, hb in hbs.items() if codes[r] is None}
+            stale = tracker.observe(live_hbs, time.monotonic())
+            if stale:
+                print(f"[elastic-agent g{generation}] rank(s) {stale} "
+                      "missed heartbeat; tearing down the world",
+                      file=sys.stderr)
+                rc, reason = 1, "heartbeat"
+                _kill_all()
+                break
+            elapsed = time.monotonic() - start
+            if (first_beat_timeout_s and len(hbs) < num_procs
+                    and elapsed > first_beat_timeout_s):
+                missing = sorted(set(range(num_procs)) - set(hbs))
+                print(f"[elastic-agent g{generation}] rank(s) {missing} "
+                      "never produced a first heartbeat", file=sys.stderr)
+                rc, reason = 1, "startup"
+                _kill_all()
+                break
+            if timeout_s and elapsed > timeout_s:
+                print(f"[elastic-agent g{generation}] generation timeout",
+                      file=sys.stderr)
+                rc, reason = 124, "timeout"
+                _kill_all()
+                break
+            time.sleep(0.2)
+    finally:
+        for t in threads:
+            t.join(timeout=5)
+    return rc, reason
+
+
+def run_elastic(
+    cmd: List[str],
+    num_procs: int,
+    heartbeat_dir: str,
+    resume_dir: str,
+    heartbeat_timeout_s: float = 30.0,
+    first_beat_timeout_s: float = 300.0,
+    min_procs: int = 1,
+    max_restarts: int = 3,
+    devices_per_proc: int = 0,
+    env_extra=None,
+    generation_timeout_s: float = 0,
+    shrink_on_failure: bool = True,
+) -> int:
+    """The DSElasticAgent journey as one call: launch the world, and on
+    any rank's death OR missed heartbeat tear it down and relaunch at
+    the surviving size (num_procs-1 per failure when shrink_on_failure,
+    modeling a lost host — the reference restarts on whatever nodes the
+    rendezvous still has, ref elastic_agent.py:121 _invoke_run). Workers
+    resume from `resume_dir` (they receive it via DS_ELASTIC_RESUME_DIR
+    and load the last committed checkpoint). Returns the final rc."""
+    os.makedirs(heartbeat_dir, exist_ok=True)
+    world = num_procs
+    extra = dict(env_extra or {})
+    extra[RESUME_DIR_ENV] = resume_dir
+    for generation in range(max_restarts + 1):
+        # clear heartbeats from the previous incarnation so staleness is
+        # judged against THIS generation only
+        for r in range(max(world, num_procs)):
+            try:
+                os.remove(_hb_path(heartbeat_dir, r))
+            except OSError:
+                pass
+        rc, reason = _launch_generation(
+            cmd, world, generation, heartbeat_dir,
+            hb_timeout_s=heartbeat_timeout_s,
+            first_beat_timeout_s=first_beat_timeout_s,
+            devices_per_proc=devices_per_proc, env_extra=extra,
+            timeout_s=generation_timeout_s,
+        )
+        if rc == 0:
+            return 0
+        if generation == max_restarts:
+            print(f"[elastic-agent] giving up after {generation + 1} "
+                  f"generations (last reason: {reason})", file=sys.stderr)
+            return rc
+        if shrink_on_failure and world > min_procs:
+            world -= 1
+        print(f"[elastic-agent] restarting at world={world} "
+              f"(generation {generation + 1}, reason {reason})",
+              file=sys.stderr)
+    return rc
+
+
+def heartbeat_from_env(rank: int) -> Optional[Heartbeat]:
+    """Engine integration: a Heartbeat when the supervisor's env
+    contract is present, else None (zero overhead outside elastic
+    runs)."""
+    hb_dir = os.environ.get(HEARTBEAT_DIR_ENV)
+    if not hb_dir:
+        return None
+    gen = int(os.environ.get(GENERATION_ENV, "0"))
+    return Heartbeat(hb_dir, rank, generation=gen)

@@ -804,22 +804,33 @@ def _make_layer_body(cfg: TransformerConfig, use_rng: bool, positions=None,
             r1 = r2 = None
 
         def run(h0):
-            h1 = _act_quant(
-                _norm(h0, lp["ln1_scale"], lp.get("ln1_bias"), cfg), cfg)
-            attn = _attention_delta(h1, lp, cfg, r1, positions=positions)
+            # named scopes land in every HLO op's metadata op_name, so
+            # the xplane/chrome trace attributes MEASURED device time to
+            # these modules (profiling/latency.py; ref: profiler.py:282
+            # measures the same boundaries with forward hooks)
+            with jax.named_scope("norm1"):
+                h1 = _act_quant(
+                    _norm(h0, lp["ln1_scale"], lp.get("ln1_bias"), cfg), cfg)
+            with jax.named_scope("attention"):
+                attn = _attention_delta(h1, lp, cfg, r1, positions=positions)
             if cfg.parallel_residual:
                 # Falcon/Phi form: both branches read the SAME residual
                 # stream (shared_ln additionally shares the norm)
-                h2 = h1 if cfg.shared_ln else _act_quant(
-                    _norm(h0, lp["ln2_scale"], lp.get("ln2_bias"), cfg), cfg)
-                mlp, l_aux = _mlp_delta(h2, lp, cfg, r2)
+                with jax.named_scope("norm2"):
+                    h2 = h1 if cfg.shared_ln else _act_quant(
+                        _norm(h0, lp["ln2_scale"], lp.get("ln2_bias"), cfg),
+                        cfg)
+                with jax.named_scope("mlp"):
+                    mlp, l_aux = _mlp_delta(h2, lp, cfg, r2)
                 h = h0 + attn + mlp
             else:
                 hmid = h0 + attn
-                h2 = _act_quant(
-                    _norm(hmid, lp["ln2_scale"], lp.get("ln2_bias"), cfg),
-                    cfg)
-                mlp, l_aux = _mlp_delta(h2, lp, cfg, r2)
+                with jax.named_scope("norm2"):
+                    h2 = _act_quant(
+                        _norm(hmid, lp["ln2_scale"], lp.get("ln2_bias"), cfg),
+                        cfg)
+                with jax.named_scope("mlp"):
+                    mlp, l_aux = _mlp_delta(h2, lp, cfg, r2)
                 h = hmid + mlp
             h = _shard(h, DP, "seq", None)
             return h, l_aux
@@ -900,13 +911,14 @@ def forward_hidden(
     pld_theta: traced scalar keep-floor for Progressive Layer Dropping
     (requires rng; eval passes rng=None, which disables PLD like the
     reference's eval forward)."""
-    x = params["embed"][tokens]
-    x = _shard(x, DP, "seq", None)
-    if cfg.use_learned_pos:
-        x = x + params["pos_embed"][: tokens.shape[1]].astype(x.dtype)
-    if cfg.embedding_layernorm:
-        x = _norm(x, params["embed_ln_scale"], params.get("embed_ln_bias"),
-                  cfg)
+    with jax.named_scope("embed"):
+        x = params["embed"][tokens]
+        x = _shard(x, DP, "seq", None)
+        if cfg.use_learned_pos:
+            x = x + params["pos_embed"][: tokens.shape[1]].astype(x.dtype)
+        if cfg.embedding_layernorm:
+            x = _norm(x, params["embed_ln_scale"],
+                      params.get("embed_ln_bias"), cfg)
 
     if rng is None:
         pld_theta = None  # eval: keep every layer
@@ -1047,9 +1059,10 @@ def make_loss_fn(cfg: TransformerConfig, loss_chunks: int = 8):
             pld_theta=batch.get("pld_theta"),
         )
         n = _ce_chunk_count(inputs.shape[1], loss_chunks)
-        loss = _token_mean_ce(x, _lm_head(params, cfg), targets,
-                              _shift_mask(batch, targets), n,
-                              head_b=params.get("lm_head_b"))
+        with jax.named_scope("lm_head"):
+            loss = _token_mean_ce(x, _lm_head(params, cfg), targets,
+                                  _shift_mask(batch, targets), n,
+                                  head_b=params.get("lm_head_b"))
         if cfg.n_experts > 0:
             # Load-balancing aux loss, coefficient per the reference's
             # Megatron-DeepSpeed recipe (ref: sharded_moe.py l_aux usage).

@@ -50,6 +50,7 @@ class FlopsProfiler:
         self.config = config
         self.batch_size = batch_size
         self._last: Optional[Dict[str, Any]] = None
+        self._measured: Optional[Dict[str, Any]] = None
 
     def profile(self, compiled, step_time_s: Optional[float] = None,
                 model_flops_per_step: Optional[float] = None) -> Dict[str, Any]:
@@ -118,7 +119,9 @@ class FlopsProfiler:
                             file=None) -> None:
         """Reference-style per-module tree (ref: profiler.py
         print_model_profile:282) — see module_profile_tree for how the
-        numbers are derived under jit."""
+        numbers are derived under jit. When measure_module_latency ran,
+        the MEASURED per-module device-time table follows the analytic
+        tree (the reference's hook-timed latency column)."""
         step_t = (self._last or {}).get("step_time_s")
         print_model_profile(
             model_config, seq_len,
@@ -127,6 +130,21 @@ class FlopsProfiler:
             top_modules=top_modules, file=file,
             output_file=self.config.output_file,
         )
+        if self._measured is not None:
+            from .latency import print_measured_profile
+
+            print_measured_profile(self._measured, file=file)
+
+    def measure_module_latency(self, engine, batch,
+                               trace_dir: str = "/tmp/ds_module_trace",
+                               steps: int = 3):
+        """Trace real engine steps and attribute measured device time to
+        the model's named-scope modules (profiling/latency.py); the
+        result also feeds print_model_profile's measured table."""
+        from .latency import measure_module_latency as _measure
+
+        self._measured = _measure(engine, batch, trace_dir, steps=steps)
+        return self._measured
 
 
 # ---------------------------------------------------------------------------

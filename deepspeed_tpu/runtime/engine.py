@@ -23,6 +23,7 @@ State lives as a `TrainState` pytree of sharded global arrays:
 import contextlib
 import dataclasses
 import functools
+import os
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -392,6 +393,23 @@ class DeepSpeedTPUEngine:
         self.monitor = MonitorMaster(config.monitor)
         self.global_steps = 0
         self._metrics_host: Dict[str, float] = {}
+
+        # elastic-agent integration (ref: elasticity/elastic_agent.py:28
+        # DSElasticAgent): when launched under run_elastic, beat the
+        # heartbeat each step and watch peers — a dead host must be seen
+        # BEFORE the next collective (XLA collectives never time out)
+        from ..elasticity.agent import HealthMonitor, heartbeat_from_env
+
+        self._heartbeat = heartbeat_from_env(jax.process_index())
+        self._health_monitor = None
+        if self._heartbeat is not None and jax.process_count() > 1:
+            self._health_monitor = HealthMonitor(
+                self._heartbeat.dir, jax.process_index(),
+                jax.process_count(),
+                timeout_s=float(os.environ.get(
+                    "DS_ELASTIC_HEARTBEAT_TIMEOUT_S", "60")),
+                generation=self._heartbeat.generation,
+            ).start()
 
         if config.nebula.enabled:
             # tiered fast/durable checkpointing (ref: nebula engine role)
@@ -1317,8 +1335,16 @@ class DeepSpeedTPUEngine:
         sync — lets the host dispatch the next step / prefetch data while
         the device runs (the async-dispatch win over the reference's
         per-step .item() reads). Read values with float() when needed."""
+        if self._health_monitor is not None:
+            self._health_monitor.check()
         metrics = self._dispatch_step(batch)
         self.global_steps += 1
+        if self._heartbeat is not None:
+            # async path: this beat certifies host-loop liveness only —
+            # a device wedged in a collective keeps the host dispatching
+            # until the queue backs up, so device-side detection arrives
+            # later than on the synchronous train_batch path
+            self._heartbeat.beat(self.global_steps)
         return metrics
 
     def next_curriculum_batch(self, dataset) -> Dict[str, Any]:
@@ -1354,6 +1380,10 @@ class DeepSpeedTPUEngine:
         Accepts host arrays shaped [train_batch_size, ...] or
         [gas, train_batch_size/gas, ...]; returns host metrics (synced).
         """
+        if self._health_monitor is not None:
+            # refuse to enter a collective against a dead peer — raises
+            # WorldDegradedError for the elastic supervisor to handle
+            self._health_monitor.check()
         if self.curriculum is not None:
             from .data_pipeline import truncate_to_seqlen
 
@@ -1369,6 +1399,10 @@ class DeepSpeedTPUEngine:
         step_time = self.timers(BATCH_TIMER).elapsed(reset=True)
         self.tput.stop()
         self.global_steps += 1
+        if self._heartbeat is not None:
+            # metrics were device_get'd above, so this beat certifies a
+            # COMPLETED step, not just a dispatched one
+            self._heartbeat.beat(self.global_steps)
         self._metrics_host = metrics
         if self.global_steps % self.config.steps_per_print == 0:
             log_dist(

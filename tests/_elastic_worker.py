@@ -1,0 +1,123 @@
+"""Worker for the elastic-agent test lane (test_elastic_agent.py).
+
+One generation of the DSElasticAgent journey (ref:
+elasticity/elastic_agent.py:28): train under an ELASTIC config, beat the
+heartbeat every step (wired automatically by the engine from
+DS_ELASTIC_HEARTBEAT_DIR), checkpoint every step, and — when the fault
+injection env says so — die mid-run so the supervisor must detect,
+resize, and resume the world.
+
+Fault injection (generation 0 only):
+  DS_TEST_KILL_RANK  — rank that fails
+  DS_TEST_KILL_STEP  — global step AFTER which it fails
+  DS_TEST_KILL_MODE  — 'exit' (hard death) | 'hang' (alive but silent —
+                       only the heartbeat can catch this)
+
+Args: <ckpt_dir> <total_steps>
+"""
+
+import os
+import sys
+import time
+
+
+def main():
+    ckpt_dir = sys.argv[1]
+    total_steps = int(sys.argv[2])
+    rank = int(os.environ["RANK"])
+    generation = int(os.environ.get("DS_ELASTIC_GENERATION", "0"))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_threefry_partitionable", True)
+
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import transformer as T
+
+    ds.comm.init_distributed()
+    n_procs = int(os.environ["WORLD_SIZE"])
+    assert ds.comm.get_process_count() == n_procs
+
+    mcfg = T.TransformerConfig(vocab_size=128, n_layers=2, n_heads=4,
+                               d_model=64, max_seq=32, variant="llama",
+                               use_flash=False)
+    # ELASTIC batch config: the same global batch must re-derive at any
+    # world size the agent restarts us at (ref: elasticity/config.py)
+    engine = ds.initialize(
+        {
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "elasticity": {
+                "enabled": True,
+                "max_train_batch_size": 64,
+                "micro_batch_sizes": [2, 4],
+                "min_gpus": 1,
+                "max_gpus": 64,
+            },
+            "zero_optimization": {"stage": 1},
+            "mesh": {"data": -1},
+            "seed": 7,
+            "steps_per_print": 10**9,
+        },
+        loss_fn=T.make_loss_fn(mcfg),
+        param_init_fn=lambda k: T.init(mcfg, k),
+        param_logical_specs=T.logical_specs(mcfg),
+    )
+    B = engine.config.train_batch_size
+
+    start_step = 0
+    resume_dir = os.environ.get("DS_ELASTIC_RESUME_DIR", ckpt_dir)
+    if generation > 0 and os.path.exists(os.path.join(resume_dir, "latest")):
+        tag, _ = engine.load_checkpoint(resume_dir)
+        start_step = engine.global_steps
+        print(f"WORKER-RESUMED rank={rank} gen={generation} "
+              f"from={tag} step={start_step}", flush=True)
+
+    kill_rank = int(os.environ.get("DS_TEST_KILL_RANK", "-1"))
+    kill_step = int(os.environ.get("DS_TEST_KILL_STEP", "-1"))
+    kill_mode = os.environ.get("DS_TEST_KILL_MODE", "exit")
+
+    # the data stream is a pure function of the global step, so the
+    # resumed world consumes exactly the batches the dead world would
+    # have (same global batch via the elastic derivation)
+    def batch_at(step):
+        r = np.random.default_rng(1000 + step)
+        return {"tokens": r.integers(0, 128, (B, 33)).astype(np.int32)}
+
+    from deepspeed_tpu.elasticity import WorldDegradedError
+
+    losses = []
+    for step in range(start_step, total_steps):
+        try:
+            m = engine.train_batch(batch_at(step))
+        except WorldDegradedError as e:
+            # a peer died: exit cleanly; state is at the last committed
+            # checkpoint and the supervisor will resize + resume
+            print(f"WORKER-DEGRADED rank={rank} gen={generation} "
+                  f"step={step} failed={e.failed_ranks}", flush=True)
+            sys.exit(3)
+        losses.append(m["loss"])
+        print(f"WORKER-STEP rank={rank} gen={generation} "
+              f"step={engine.global_steps} loss={m['loss']:.6f}", flush=True)
+        engine.save_checkpoint(ckpt_dir)
+        ds.comm.barrier("post-save")
+
+        if (generation == 0 and rank == kill_rank
+                and engine.global_steps == kill_step):
+            if kill_mode == "hang":
+                # alive but wedged: stop beating, never step again —
+                # only the heartbeat monitor can catch this
+                print(f"WORKER-HANGING rank={rank}", flush=True)
+                time.sleep(3600)
+            print(f"WORKER-DYING rank={rank}", flush=True)
+            os._exit(17)
+
+    print(f"WORKER-OK rank={rank} gen={generation} world={n_procs} "
+          f"steps={engine.global_steps} last_loss={losses[-1]:.6f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,155 @@
+"""Elastic-agent lane: kill → detect → resize → resume, end to end.
+
+The reference's DSElasticAgent test journey (ref:
+elasticity/elastic_agent.py:28 + _invoke_run:121 monitor loop): a real
+multi-process world loses a rank mid-training (hard exit, or alive-but-
+hung so only the heartbeat catches it); the supervisor tears the world
+down, relaunches at the surviving size, and the workers resume from the
+last committed checkpoint with the SAME elastic global batch.
+
+Unit pieces (Heartbeat / HealthMonitor / scan) are tested in-process;
+the e2e journeys run real OS processes through run_elastic.
+"""
+
+import json
+import os
+import re
+import sys
+import time
+
+import pytest
+
+from deepspeed_tpu.elasticity import (
+    HealthMonitor,
+    Heartbeat,
+    WorldDegradedError,
+    run_elastic,
+    scan_heartbeats,
+)
+
+pytestmark = pytest.mark.slow
+
+TOTAL_STEPS = 6
+KILL_STEP = 3
+
+
+class TestHeartbeatUnits:
+    def test_beat_scan_roundtrip(self, tmp_path):
+        hb = Heartbeat(str(tmp_path), rank=2, generation=1)
+        hb.beat(5)
+        got = scan_heartbeats(str(tmp_path), world=4, generation=1)
+        assert list(got) == [2] and got[2]["step"] == 5
+
+    def test_generation_filter_drops_stale_files(self, tmp_path):
+        Heartbeat(str(tmp_path), rank=0, generation=0).beat(9)
+        assert scan_heartbeats(str(tmp_path), 1, generation=1) == {}
+
+    def test_corrupt_file_ignored(self, tmp_path):
+        (tmp_path / "hb_0.json").write_text("{not json")
+        assert scan_heartbeats(str(tmp_path), 1) == {}
+
+    def test_monitor_flags_stale_peer_not_fresh_one(self, tmp_path):
+        Heartbeat(str(tmp_path), 0).beat(1)   # self
+        Heartbeat(str(tmp_path), 1).beat(1)   # fresh peer
+        stale = Heartbeat(str(tmp_path), 2)   # stale peer
+        stale.beat(1)
+        mon = HealthMonitor(str(tmp_path), rank=0, world=3, timeout_s=0.4,
+                            interval_s=0.05).start()
+        try:
+            mon.check()  # nobody stale yet
+            deadline = time.time() + 5
+            while not mon.degraded and time.time() < deadline:
+                Heartbeat(str(tmp_path), 1).beat(2)  # peer 1 keeps beating
+                time.sleep(0.05)
+            assert mon.failed_ranks == [2]
+            with pytest.raises(WorldDegradedError) as ei:
+                mon.check()
+            assert ei.value.failed_ranks == [2]
+        finally:
+            mon.stop()
+
+    def test_monitor_excludes_never_started_peer(self, tmp_path):
+        """Startup (compile) time must not count as a missed heartbeat —
+        a rank that never beat is the supervisor's first-beat deadline's
+        job, not the peer monitor's."""
+        Heartbeat(str(tmp_path), 0).beat(1)
+        mon = HealthMonitor(str(tmp_path), rank=0, world=2, timeout_s=0.2,
+                            interval_s=0.05).start()
+        try:
+            time.sleep(0.5)
+            assert not mon.degraded
+        finally:
+            mon.stop()
+
+
+def _run_agent(tmp_path, capsys, kill_mode, num_procs=2,
+               hb_timeout=45.0):
+    # hb_timeout must exceed the slowest legitimate beat-to-beat gap —
+    # here the first orbax save + next-step compile on a cold CPU world
+    worker = os.path.join(os.path.dirname(__file__), "_elastic_worker.py")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(worker)))
+    ckpt = str(tmp_path / "ckpt")
+    rc = run_elastic(
+        [sys.executable, worker, ckpt, str(TOTAL_STEPS)],
+        num_procs=num_procs,
+        heartbeat_dir=str(tmp_path / "hb"),
+        resume_dir=ckpt,
+        heartbeat_timeout_s=hb_timeout,
+        first_beat_timeout_s=240.0,
+        min_procs=1,
+        max_restarts=2,
+        devices_per_proc=2,
+        env_extra={
+            "PYTHONPATH": repo_root,
+            "XLA_FLAGS": "",
+            "JAX_PLATFORMS": "cpu",
+            "DS_TEST_KILL_RANK": "1",
+            "DS_TEST_KILL_STEP": str(KILL_STEP),
+            "DS_TEST_KILL_MODE": kill_mode,
+            "DS_ELASTIC_HEARTBEAT_TIMEOUT_S": str(hb_timeout),
+        },
+        generation_timeout_s=420,
+    )
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    return out
+
+
+def _check_resumed_world(out, num_procs):
+    # generation 1 ran at the SHRUNK world and resumed from the last
+    # committed checkpoint (the kill step), not from scratch
+    resumed = [l for l in out.splitlines() if "WORKER-RESUMED" in l]
+    assert len(resumed) == num_procs - 1, out
+    assert all(f"step={KILL_STEP}" in l for l in resumed), resumed
+    done = sorted(l for l in out.splitlines() if "WORKER-OK" in l)
+    assert len(done) == num_procs - 1, out
+    assert all(f"gen=1 world={num_procs - 1} steps={TOTAL_STEPS}" in l
+               for l in done), done
+    # trajectory: the resumed world re-ran steps 4..6 exactly once;
+    # every rank agrees on the final loss
+    finals = {l.split("last_loss=")[1] for l in done}
+    assert len(finals) == 1, done
+    # steps seen in generation 1 are exactly KILL_STEP+1..TOTAL_STEPS
+    g1_steps = sorted({
+        int(m.group(1))
+        for m in re.finditer(r"gen=1 step=(\d+)", out)
+    })
+    assert g1_steps == list(range(KILL_STEP + 1, TOTAL_STEPS + 1)), g1_steps
+
+
+def test_hard_exit_detect_resize_resume(tmp_path, capsys):
+    """Rank 1 dies hard at step 3; the agent detects the exit, restarts
+    at world-1, and the survivors resume from the step-3 checkpoint and
+    finish the run."""
+    out = _run_agent(tmp_path, capsys, kill_mode="exit")
+    assert "WORKER-DYING rank=1" in out
+    _check_resumed_world(out, num_procs=2)
+
+
+def test_hang_detect_via_heartbeat(tmp_path, capsys):
+    """Rank 1 wedges (alive, never beats again): only the heartbeat can
+    catch this. The agent must declare the world degraded and resume at
+    the surviving size."""
+    out = _run_agent(tmp_path, capsys, kill_mode="hang")
+    assert "WORKER-HANGING rank=1" in out
+    _check_resumed_world(out, num_procs=2)

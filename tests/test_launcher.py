@@ -1,8 +1,11 @@
 """Launcher + env-report tests (ref: tests/unit/launcher)."""
 
+import json
 import os
 import subprocess
 import sys
+
+import numpy as np
 
 from deepspeed_tpu.launcher.runner import launch_local
 
@@ -117,3 +120,45 @@ class TestPodLauncher:
                    "echo", "ok"])
         assert rc == 0
         assert "ok" in capsys.readouterr().out
+
+
+class TestCommBench:
+    """ds_bench analog (ref: bin/ds_bench → benchmarks/communication/):
+    the sweep must run every op on the virtual mesh and report busbw
+    with the reference's ring-correction convention."""
+
+    def test_sweep_all_ops(self):
+        import jax
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.comm.bench import OPS, _busbw_factor, sweep
+
+        records = sweep(list(OPS), [64 * 1024], trials=2,
+                        dtype=jnp.float32)
+        assert {r["op"] for r in records} == set(OPS)
+        n = len(jax.devices())
+        for r in records:
+            assert r["devices"] == n
+            assert r["bytes_per_device"] > 0
+            assert r["algbw_GBps"] > 0
+            np.testing.assert_allclose(
+                r["busbw_GBps"],
+                r["algbw_GBps"] * _busbw_factor(r["op"], n))
+
+    def test_busbw_convention(self):
+        from deepspeed_tpu.comm.bench import _busbw_factor
+
+        # ref benchmarks/communication/utils.py busbw notes
+        assert _busbw_factor("all_reduce", 8) == 2 * 7 / 8
+        assert _busbw_factor("all_gather", 8) == 7 / 8
+        assert _busbw_factor("ppermute", 8) == 1.0
+
+    def test_cli_json_line(self, capsys):
+        from deepspeed_tpu.comm.bench import main
+
+        rc = main(["--ops", "all_gather", "--sizes-mb", "0.0625",
+                   "--trials", "1", "--dtype", "float32", "--json"])
+        assert rc == 0
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        rec = json.loads(line)["ds_bench"]
+        assert rec[0]["op"] == "all_gather"

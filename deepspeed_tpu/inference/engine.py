@@ -65,6 +65,35 @@ def _bucket(n: int, lo: int) -> int:
     return b
 
 
+def _layer_logical_specs(lp: Any, cfg: T.TransformerConfig) -> Dict[str, Any]:
+    """Logical-axis specs for ONE prepared layer dict (the single
+    source for both park-time and fetch-time sharding)."""
+    moe = cfg.n_experts > 0
+    return {
+        name: (M._MOE_SPECS[name] if moe and name in M._MOE_SPECS
+               else M._SERVING_SPECS[name][1])
+        for name in lp
+    }
+
+
+def _leaf_sharding(pspec, leaf, mesh: Mesh, memory_kind: str = "device"):
+    """Sharding(s) for one prepared leaf: plain leaves take the rules-
+    table spec; quantized leaves shard their int codes by that spec and
+    replicate the scales (small, and a sharded-scale/packed-codes
+    pairing is not worth the bookkeeping)."""
+    from .quantization import ChannelQuantWeight, QuantizedWeight
+
+    mk = NamedSharding(mesh, pspec, memory_kind=memory_kind)
+    repl = NamedSharding(mesh, P(), memory_kind=memory_kind)
+    if isinstance(leaf, QuantizedWeight):
+        return QuantizedWeight(q=mk, scale=repl, bits=leaf.bits,
+                               dtype_name=leaf.dtype_name)
+    if isinstance(leaf, ChannelQuantWeight):
+        return ChannelQuantWeight(q=mk, scale=repl,
+                                  dtype_name=leaf.dtype_name)
+    return mk
+
+
 def _prepared_specs(prepared: Any, cfg: T.TransformerConfig) -> Any:
     """Logical-axis tree matching a PREPARED serving tree (M.prepare
     layout: per-layer list, unfused under TP)."""
@@ -72,17 +101,8 @@ def _prepared_specs(prepared: Any, cfg: T.TransformerConfig) -> Any:
     # truth; prepare() leaves them untouched)
     top = {k: v for k, v in T.logical_specs(cfg).items() if k != "layers"}
     specs: Dict[str, Any] = {k: top[k] for k in prepared if k != "layers"}
-    moe = cfg.n_experts > 0
-    lspecs = []
-    for lp in prepared["layers"]:
-        d = {}
-        for name in lp:
-            if moe and name in M._MOE_SPECS:
-                d[name] = M._MOE_SPECS[name]
-            else:
-                d[name] = M._SERVING_SPECS[name][1]
-        lspecs.append(d)
-    specs["layers"] = lspecs
+    specs["layers"] = [_layer_logical_specs(lp, cfg)
+                       for lp in prepared["layers"]]
     return specs
 
 
@@ -109,25 +129,11 @@ def _shard_serving_params(params: Any, cfg: T.TransformerConfig,
     )
     pspecs = Sh.tree_logical_to_mesh(specs, Sh.make_rules(), mesh,
                                      shapes=shapes)
-    repl = NamedSharding(mesh, P())
-
-    def put(pspec, leaf):
-        if isinstance(leaf, QuantizedWeight):
-            return QuantizedWeight(
-                q=jax.device_put(leaf.q, NamedSharding(mesh, pspec)),
-                scale=jax.device_put(leaf.scale, repl),
-                bits=leaf.bits, dtype_name=leaf.dtype_name,
-            )
-        if isinstance(leaf, ChannelQuantWeight):
-            return ChannelQuantWeight(
-                q=jax.device_put(leaf.q, NamedSharding(mesh, pspec)),
-                scale=jax.device_put(leaf.scale, repl),
-                dtype_name=leaf.dtype_name,
-            )
-        return jax.device_put(leaf, NamedSharding(mesh, pspec))
-
-    return jax.tree.map(put, pspecs, params,
-                        is_leaf=lambda x: isinstance(x, P))
+    shardings = jax.tree.map(
+        lambda ps, leaf: _leaf_sharding(ps, leaf, mesh),
+        pspecs, params,
+        is_leaf=lambda x: isinstance(x, P))
+    return jax.tree.map(jax.device_put, params, shardings)
 
 
 class InferenceEngine:
@@ -158,12 +164,13 @@ class InferenceEngine:
         throughput scales with batch until HBM/compute bind).
         Embeddings / lm_head / final norm stay HBM-resident (they are
         the hot constant set). Composes with per-channel int8
-        quantization (halves the streamed bytes). Not supported under a
-        TP mesh. {"device": "nvme"} is intentionally NOT implemented
-        for serving: at single-chip scale host DRAM exceeds any model
-        this chip can usefully serve, and the NVMe aio tier
-        (runtime/swap.py) exists for the TRAINING state, which is ~16x
-        params; pass cpu.
+        quantization (halves the streamed bytes) AND with a TP mesh
+        (each device parks + streams its own weight SHARD — per-device
+        stream shrinks by 1/tp). {"device": "nvme", "path": ...} parks
+        layers in per-leaf NVMe files instead (bigger-than-DRAM models;
+        ref partitioned_param_swapper.py:36): each step's layer fetch
+        is an in-program io_callback over the aio read-ahead window
+        (inference/offload_store.py); single-chip only.
 
         mesh: explicit serving mesh; when absent and config.tp_size > 1,
         a {'model': tp_size} mesh is built over the first tp_size devices
@@ -229,24 +236,41 @@ class InferenceEngine:
                     "lower max_seq_len so its bucket fits"
                 )
         self._offload = None
+        self._nvme_store = None
         if offload is not None:  # {} is a config error, not "disabled"
             dev = offload.get("device")
+            if dev not in ("cpu", "nvme"):
+                raise ValueError(
+                    f"offload.device must be 'cpu' or 'nvme' (got {dev!r})")
             if dev == "nvme":
-                raise NotImplementedError(
-                    "offload={'device': 'nvme'} serving: use 'cpu' — host "
-                    "DRAM exceeds single-chip-servable models; the NVMe "
-                    "aio tier (runtime/swap.py) backs the ~16x-larger "
-                    "TRAINING state"
-                )
-            if dev != "cpu":
-                raise ValueError(f"offload.device must be 'cpu' (got {dev!r})")
-            if self.mesh is not None:
-                raise NotImplementedError(
-                    "offload serving under a TP mesh is not supported; "
-                    "large models on multiple chips should shard (tp_size) "
-                    "instead of streaming"
-                )
-            self._offload = {"device": "cpu"}
+                # bigger-than-DRAM tier (ref: partitioned_param_swapper
+                # :36 + the 30 tok/s OPT-30B NVMe case, zero-inference
+                # post:52): layers live in per-leaf NVMe files and each
+                # step's layer fetch is an in-program io_callback over
+                # the aio read-ahead window (inference/offload_store.py)
+                if self.mesh is not None:
+                    raise NotImplementedError(
+                        "nvme offload serving under a TP mesh: the "
+                        "io_callback fetch is single-process; use the "
+                        "cpu tier with TP, or nvme single-chip"
+                    )
+                if not offload.get("path"):
+                    raise ValueError(
+                        "offload={'device': 'nvme'} requires 'path' "
+                        "(an NVMe-backed directory)")
+                self._offload = {
+                    "device": "nvme",
+                    "path": offload["path"],
+                    "n_threads": int(offload.get("n_threads", 4)),
+                    "block_size": int(offload.get("block_size", 1 << 20)),
+                    "read_ahead": int(offload.get("read_ahead", 2)),
+                }
+            else:
+                # cpu tier composes with a TP mesh: each device's weight
+                # SHARD parks in its pinned_host and streams to its own
+                # HBM inside the step (the per-device stream shrinks by
+                # 1/tp, so offload TP scales the weight-stream roofline)
+                self._offload = {"device": "cpu"}
         self._dtype = dtype
         self._quantization = dict(quantization) if quantization else None
         self._per_channel = bool(self._quantization
@@ -345,8 +369,30 @@ class InferenceEngine:
             prepared = _shard_serving_params(prepared, self.cfg, self.mesh)
         self.params = prepared
 
+    def _layer_pspec_sharding(self, lp: Any, memory_kind: str):
+        """Per-leaf NamedShardings for ONE prepared layer under the TP
+        mesh — the same rules/packing _shard_serving_params uses
+        (_layer_logical_specs + _leaf_sharding), restricted to a layer
+        subtree, in the given memory kind."""
+        from ..parallel import sharding as Sh
+        from .quantization import ChannelQuantWeight, QuantizedWeight
+
+        is_q = lambda x: isinstance(x, (QuantizedWeight, ChannelQuantWeight))
+        specs = _layer_logical_specs(lp, self.cfg)
+        shapes = jax.tree.map(
+            lambda leaf: leaf.q.shape if is_q(leaf) else leaf.shape,
+            lp, is_leaf=is_q)
+        pspecs = Sh.tree_logical_to_mesh(specs, Sh.make_rules(), self.mesh,
+                                         shapes=shapes)
+        return jax.tree.map(
+            lambda ps, leaf: _leaf_sharding(ps, leaf, self.mesh,
+                                            memory_kind),
+            pspecs, lp, is_leaf=lambda x: isinstance(x, P))
+
     def _refresh_offload(self, params: Any) -> Any:
-        """Layer-at-a-time staging into the pinned_host tier."""
+        """Layer-at-a-time staging into the offload tier: pinned_host
+        (cpu — per-device SHARDS under a TP mesh) or per-leaf NVMe files
+        (nvme — inference/offload_store.py)."""
         cfg, dtype = self.cfg, self._dtype
         if self._quantization and not self._per_channel:
             raise NotImplementedError(
@@ -354,9 +400,9 @@ class InferenceEngine:
                 "dequantize the whole tree on device each step; use "
                 "per_channel int8 (streams codes, scales on output)"
             )
-        dev = jax.devices()[0]
-        host = jax.sharding.SingleDeviceSharding(dev,
-                                                 memory_kind="pinned_host")
+        nvme = self._offload["device"] == "nvme"
+        host = jax.sharding.SingleDeviceSharding(
+            jax.devices()[0], memory_kind="pinned_host")
 
         from .quantization import ChannelQuantWeight
 
@@ -373,9 +419,10 @@ class InferenceEngine:
                 p, is_leaf=is_cq)
 
         per_channel = self._per_channel
+        fuse = self.mesh is None  # TP keeps QKV/gate-up unfused
 
         def layer_xform(lp):
-            lp = M.prepare_layer(cast(lp), cfg, fuse=True)
+            lp = M.prepare_layer(cast(lp), cfg, fuse=fuse)
             if per_channel and not any(is_cq(v) for v in lp.values()):
                 lp = M.quantize_layer(lp, cfg)
             return lp
@@ -398,9 +445,39 @@ class InferenceEngine:
             # per-layer list, or the lazy HF import's single-use
             # generator (import_external(lazy_layers=True))
             layer_dicts = st
-        park = lambda lp: jax.tree.map(
-            lambda w: jax.device_put(w, host), lp)
-        layers = [park(self._layer_xform(lp)) for lp in layer_dicts]
+
+        if nvme:
+            from .offload_store import NvmeLayerStore
+
+            if self._nvme_store is not None:
+                # params refresh: reclaim the previous model's NVMe
+                # footprint before staging the new one
+                self._nvme_store.close()
+            self._nvme_store = NvmeLayerStore(
+                self._offload["path"], cfg.n_layers,
+                n_threads=self._offload["n_threads"],
+                block_size=self._offload["block_size"],
+                read_ahead=self._offload["read_ahead"])
+
+            def park(l, lp):
+                # pull to host and release the device copy immediately
+                lp_host = jax.tree.map(
+                    lambda w: np.asarray(jax.device_get(w)), lp)
+                self._nvme_store.stage_layer(l, lp_host)
+                # the served tree carries NO arrays for this layer —
+                # the step's io_callback materializes them per use,
+                # selected by the static loop index
+                return {}
+        elif self.mesh is not None:
+            def park(l, lp):
+                sh = self._layer_pspec_sharding(lp, "pinned_host")
+                return jax.tree.map(jax.device_put, lp, sh)
+        else:
+            def park(l, lp):
+                return jax.tree.map(lambda w: jax.device_put(w, host), lp)
+
+        layers = [park(l, self._layer_xform(lp))
+                  for l, lp in enumerate(layer_dicts)]
         if len(layers) != cfg.n_layers:
             raise ValueError(
                 f"offload staging got {len(layers)} layers for a "
@@ -408,31 +485,73 @@ class InferenceEngine:
                 "lazy import generator (re-import for a second engine) "
                 "or a pipeline-partitioned stack (merge partitions first)"
             )
+        if nvme:
+            self._nvme_store.finish_staging()
         top_in = {k: v for k, v in params.items() if k != "layers"}
         top = self._top_xform(top_in)
+        if self.mesh is not None:
+            top = _shard_serving_params({**top, "layers": []}, cfg,
+                                        self.mesh)
         top.pop("layers", None)
         top["layers"] = layers
         return top
 
     def _fetch_layer(self):
-        """In-jit pinned_host→HBM fetch for one layer's weights (None
+        """In-jit offload-tier→HBM fetch for one layer's weights (None
         when weights are HBM-resident).
 
         The fetch is scheduling-barriered on the activations from TWO
         layers back: without the barrier XLA's scheduler hoists every
-        layer's host stream to the program start — for a
-        bigger-than-HBM model that is an immediate OOM (observed on the
-        19 GiB 70B-width slice). The 2-layer window still overlaps
-        layer l+1's stream with layer l's compute."""
+        layer's host stream (or NVMe callback) to the program start —
+        for a bigger-than-HBM model that is an immediate OOM (observed
+        on the 19 GiB 70B-width slice). The 2-layer window still
+        overlaps layer l+1's stream with layer l's compute."""
         if self._offload is None:
             return None
+
+        def barrier(lp, dep):
+            if dep is None:
+                return lp
+            return jax.tree.map(
+                lambda w: jax.lax.optimization_barrier((w, dep))[0], lp)
+
+        if self._offload["device"] == "nvme":
+            from jax.experimental import io_callback
+
+            store = self._nvme_store
+
+            def fetch(lp, dep=None, idx=None):
+                # the layer entry carries no arrays; the STATIC loop
+                # index selects the manifest row at trace time
+                specs = store.layer_specs(idx)
+                l = idx
+                # the dep rides as a callback ARGUMENT: the runtime may
+                # not start the read before the activations two layers
+                # back exist, so reads stay inside the rolling window
+                # (read_ahead submits the NEXT layers on each wait)
+                token = (jnp.zeros((), jnp.int32) if dep is None
+                         else jnp.sum(jnp.ravel(dep)[:1]).astype(jnp.int32))
+                return io_callback(
+                    lambda _tok, _l=l: store.read_layer(int(_l)),
+                    specs, token)
+
+            return fetch
+
+        if self.mesh is not None:
+            def fetch(lp, dep=None, idx=None):
+                lp = barrier(lp, dep)
+                # shardings recomputed from leaf names+shapes at trace
+                # time: the same rules table that parked the shards
+                sh = self._layer_pspec_sharding(lp, "device")
+                return jax.tree.map(jax.device_put, lp, sh)
+
+            return fetch
+
         dev_s = jax.sharding.SingleDeviceSharding(
             jax.devices()[0], memory_kind="device")
 
-        def fetch(lp, dep=None):
-            if dep is not None:
-                lp = jax.tree.map(
-                    lambda w: jax.lax.optimization_barrier((w, dep))[0], lp)
+        def fetch(lp, dep=None, idx=None):
+            lp = barrier(lp, dep)
             return jax.tree.map(lambda w: jax.device_put(w, dev_s), lp)
 
         return fetch
@@ -826,6 +945,147 @@ class InferenceEngine:
     def flush(self, uid: int) -> None:
         """Free a sequence's KV blocks (ref: engine_v2.py flush:242)."""
         self.state.flush(uid)
+
+    # -- speculative (multi-token-per-stream) decoding -------------------
+    def _verify_chunks(
+        self, uids: Sequence[int], chunks: Sequence[np.ndarray],
+    ) -> List[np.ndarray]:
+        """Run each in-flight uid's candidate chunk through ONE decode
+        program and return EVERY row's logits ([len(chunk), V] per uid)
+        — the verification half of speculative decoding. KV for all
+        candidate rows is written, but seen_tokens is NOT committed:
+        the caller commits only the accepted prefix (rejected rows'
+        slots are simply overwritten by the next real tokens)."""
+        rows = sum(len(c) for c in chunks)
+        if rows > self.config.max_batch_size:
+            raise RuntimeError(
+                f"{rows} verify rows > max_batch_size "
+                f"{self.config.max_batch_size}")
+        sp = _bucket(rows, 8)
+        toks = np.zeros((sp,), np.int32)
+        ctx = np.zeros((sp,), np.int32)
+        tables = np.full((sp, self.config.blocks_per_seq),
+                         self.pad_block, np.int32)
+        spans: List[Tuple[int, int]] = []
+        row = 0
+        for uid, chunk in zip(uids, chunks):
+            base = self.state.get(uid).seen_tokens
+            self.state.extend(uid, len(chunk))
+            table = self.state.block_table(
+                [uid], self.config.blocks_per_seq, self.pad_block)[0]
+            spans.append((row, row + len(chunk)))
+            for j, tok in enumerate(chunk):
+                toks[row] = int(tok)
+                ctx[row] = base + j + 1
+                tables[row] = table
+                row += 1
+        logits, self.cache = self._decode_fn(sp, False)(
+            self.params, self.cache, self._dev(toks),
+            self._dev(tables), self._dev(ctx),
+        )
+        logits_np = np.asarray(logits[:rows])
+        return [logits_np[a:b] for a, b in spans]
+
+    @staticmethod
+    def _ngram_draft(hist: List[int], ngram: int, k: int) -> List[int]:
+        """Prompt-lookup drafting: the most recent earlier occurrence of
+        the last `ngram` tokens proposes the k tokens that followed it
+        (no draft model — the sequence drafts itself)."""
+        if k <= 0 or len(hist) <= ngram:
+            return []
+        pat = hist[-ngram:]
+        for i in range(len(hist) - ngram - 1, -1, -1):
+            if hist[i:i + ngram] == pat:
+                return hist[i + ngram: i + ngram + k]
+        return []
+
+    def generate_speculative(
+        self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 32,
+        eos_token_id: Optional[int] = None, ngram: int = 3,
+        draft_len: int = 4,
+    ) -> List[List[int]]:
+        """Greedy generation with prompt-lookup self-speculation.
+
+        Each step feeds [committed_next, draft_1..draft_k] through ONE
+        forward and accepts the longest greedy-consistent prefix — so a
+        run of k accepted tokens streams the weights ONCE instead of k
+        times. For full-offload serving the step cost IS the weight
+        stream (docs/PROFILE_r04.md: 88% of the host-link roofline), so
+        effective tok/s scales with the mean accepted length — the
+        policy lever the r4 profile names for bigger-than-HBM models.
+        Exact: the output equals plain greedy decoding token for token
+        (worst case accepts 1 token/step = standard decode).
+        ref: the reference ecosystem's prompt-lookup/self-speculative
+        decoding (MII generation path); arXiv 2304.04487-class
+        draft-and-verify with the sequence as its own draft model."""
+        if len(prompts) > self.config.max_batch_size:
+            raise ValueError(
+                f"{len(prompts)} prompts > max_batch_size "
+                f"{self.config.max_batch_size} (every live sequence "
+                "needs at least one verify row per step)")
+        taken = set(self.state.tracked_uids)
+        uids, cand = [], 0
+        while len(uids) < len(prompts):
+            if cand not in taken:
+                uids.append(cand)
+            cand += 1
+        try:
+            logits = self.put(uids,
+                              [np.asarray(p, np.int32) for p in prompts])
+            hist = [list(map(int, p)) for p in prompts]
+            nxt = [int(np.argmax(l)) for l in logits]
+            outs: List[List[int]] = [[] for _ in prompts]
+            live = [max_new_tokens > 0] * len(prompts)
+            while any(live):
+                lu, lc = [], []
+                # drafts share the verify batch: split the row budget
+                # across live sequences (each needs >= 1 committed row)
+                n_live = sum(live)
+                per_seq = max(1, self.config.max_batch_size // n_live)
+                for i, uid in enumerate(uids):
+                    if not live[i]:
+                        continue
+                    budget = max_new_tokens - len(outs[i])
+                    k = min(draft_len, budget - 1, per_seq - 1)
+                    draft = self._ngram_draft(hist[i] + [nxt[i]], ngram, k)
+                    # a full context drops the sequence (same contract
+                    # as generate(): stop rather than overflow the
+                    # block table)
+                    room = self.config.max_seq_len \
+                        - self.state.get(uid).seen_tokens
+                    if room < 1:
+                        live[i] = False
+                        continue
+                    lu.append(i)
+                    lc.append(np.asarray(
+                        [nxt[i]] + draft[:max(0, room - 1)], np.int32))
+                if not lu:
+                    break
+                all_logits = self._verify_chunks([uids[i] for i in lu], lc)
+                for i, chunk, lg in zip(lu, lc, all_logits):
+                    # row j predicts the token AFTER chunk[:j+1]; accept
+                    # drafts while they match the greedy argmax chain
+                    accepted = 1
+                    while (accepted < len(chunk)
+                           and int(np.argmax(lg[accepted - 1]))
+                           == int(chunk[accepted])):
+                        accepted += 1
+                    self.state.commit(uids[i], accepted)
+                    new = [int(t) for t in chunk[:accepted]]
+                    outs[i].extend(new)
+                    hist[i].extend(new)
+                    nxt[i] = int(np.argmax(lg[accepted - 1]))
+                    if eos_token_id is not None and eos_token_id in new:
+                        outs[i] = outs[i][: outs[i].index(eos_token_id) + 1]
+                        live[i] = False
+                    elif len(outs[i]) >= max_new_tokens:
+                        outs[i] = outs[i][:max_new_tokens]
+                        live[i] = False
+        finally:
+            for uid in uids:
+                if self.state.get(uid) is not None:
+                    self.flush(uid)
+        return outs
 
     # -- sampling (v1 generate inherits full HF sampling; here the same
     # -- knobs applied host-side over put() logits, ref:

@@ -700,9 +700,10 @@ def decode_step(
 
     new_k, new_v = [], []
     x_hist = []  # layer outputs; fetch l is barriered on output l-2
-    for lp in params["layers"]:
+    for li, lp in enumerate(params["layers"]):
         if fetch_layer is not None:
-            lp = fetch_layer(lp, x_hist[-2] if len(x_hist) >= 2 else None)
+            lp = fetch_layer(lp, x_hist[-2] if len(x_hist) >= 2 else None,
+                             li)
         h1 = T._act_quant(T._norm(x, lp["ln1_scale"], lp.get("ln1_bias"), cfg), cfg)
         if "w_qkv" in lp:
             qkv = _wmm("se,ehd->shd", h1, lp["w_qkv"])
@@ -891,9 +892,10 @@ def prefill_batch(
 
     new_k, new_v = [], []
     x_hist = []  # layer outputs; fetch l is barriered on output l-2
-    for lp in params["layers"]:
+    for li, lp in enumerate(params["layers"]):
         if fetch_layer is not None:
-            lp = fetch_layer(lp, x_hist[-2] if len(x_hist) >= 2 else None)
+            lp = fetch_layer(lp, x_hist[-2] if len(x_hist) >= 2 else None,
+                             li)
         h1 = T._act_quant(T._norm(x, lp["ln1_scale"], lp.get("ln1_bias"), cfg), cfg)
         if "w_qkv" in lp:
             qkv = _wmm("bse,ehd->bshd", h1, lp["w_qkv"])

@@ -1,0 +1,159 @@
+"""NVMe layer store for ZeRO-Inference full-offload serving.
+
+The serving analog of the reference's parameter swapper
+(ref: runtime/swap_tensor/partitioned_param_swapper.py:36
+AsyncPartitionedParameterSwapper — NVMe-resident fp16 params swapped in
+around each module's forward over the csrc/aio thread pool;
+docs/_posts/2022-09-10-zero-inference.md:52 serves OPT-30B from NVMe at
+30 tok/s). Here the unit is one PREPARED serving layer:
+
+- staging writes each layer's leaves to one file per leaf through the
+  C++ aio handle (ops/aio); host RAM holds O(1) layers at any moment,
+  so the model bounds at NVMe capacity, not DRAM.
+- serving reads ride an `io_callback` INSIDE the compiled step: the
+  callback for layer l waits on l's prefetched reads, SUBMITS reads for
+  layer l+read_ahead (the async_swapper double-buffer pattern), and
+  returns the host arrays, which XLA then transfers to HBM. Ordering
+  against the rest of the program comes from the same
+  activations-two-back dependency the pinned-host tier uses
+  (inference/engine._fetch_layer) — the callback cannot be hoisted to
+  program start, which for a bigger-than-HBM model would be an OOM.
+
+Fresh buffers are allocated per read round: the returned arrays are
+handed to the runtime for the HBM transfer, and reusing them for the
+next prefetch round would race that transfer.
+"""
+
+import os
+import shutil
+import uuid
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..ops.aio import AsyncIOHandle
+from ..utils.logging import log_dist
+
+
+class NvmeLayerStore:
+    """Per-leaf NVMe files + in-flight prefetch state for one engine."""
+
+    def __init__(self, path: str, n_layers: int, n_threads: int = 4,
+                 block_size: int = 1 << 20, read_ahead: int = 2):
+        tag = f"serve-rank{jax.process_index()}-{uuid.uuid4().hex[:8]}"
+        self.dir = os.path.join(path, "ds_tpu_swap", tag)
+        os.makedirs(self.dir, exist_ok=True)
+        self.aio = AsyncIOHandle(n_threads=n_threads, block_size=block_size)
+        self.n_layers = n_layers
+        self.read_ahead = max(1, read_ahead)
+        # per layer: list of (flat_leaf_index, file, shape, dtype)
+        self._manifest: List[Optional[List[tuple]]] = [None] * n_layers
+        self._treedef = None
+        self._spec_tree: List[Any] = [None] * n_layers
+        # layer -> list of (ticket, buf) for in-flight prefetch reads
+        self._inflight: Dict[int, List[tuple]] = {}
+        import atexit
+        import functools
+
+        # belt for processes that never close(); close() is the braces.
+        # A per-store partial so close()'s unregister removes only THIS
+        # store's hook (unregister matches by function identity).
+        self._cleanup = functools.partial(shutil.rmtree, self.dir,
+                                          ignore_errors=True)
+        atexit.register(self._cleanup)
+        self._closed = False
+
+    def close(self) -> None:
+        """Drain in-flight reads, drop the aio pool, reclaim the NVMe
+        space — the engine calls this when a params refresh replaces
+        the store (a long-lived server cycling models must not leak a
+        model copy per refresh)."""
+        if self._closed:
+            return
+        self._closed = True
+        for pairs in self._inflight.values():
+            for t, _ in pairs:
+                try:
+                    self.aio.wait(t)
+                except Exception:
+                    pass
+        self._inflight.clear()
+        self.aio = None
+        shutil.rmtree(self.dir, ignore_errors=True)
+        import atexit
+
+        try:
+            atexit.unregister(self._cleanup)
+        except Exception:
+            pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- staging --------------------------------------------------------
+    def stage_layer(self, l: int, lp_host: Any) -> None:
+        """Write one prepared layer's leaves (host numpy/jax arrays) to
+        NVMe; blocks until the writes are durable so the layer's host
+        memory can be released immediately."""
+        leaves, treedef = jax.tree_util.tree_flatten(lp_host)
+        if self._treedef is None:
+            self._treedef = treedef
+        rows = []
+        tickets = []
+        for i, leaf in enumerate(leaves):
+            arr = np.ascontiguousarray(np.asarray(leaf))
+            f = os.path.join(self.dir, f"l{l}_leaf{i}.bin")
+            tickets.append(self.aio.async_pwrite(arr, f))
+            rows.append((i, f, arr.shape, arr.dtype))
+        for t in tickets:
+            self.aio.wait(t)
+        self._manifest[l] = rows
+        self._spec_tree[l] = jax.tree_util.tree_unflatten(
+            treedef,
+            [jax.ShapeDtypeStruct(r[2], r[3]) for r in rows],
+        )
+
+    def finish_staging(self) -> None:
+        staged = [l for l, m in enumerate(self._manifest) if m is None]
+        if staged:
+            raise ValueError(f"layers {staged} were never staged")
+        total = sum(int(np.prod(r[2]) * np.dtype(r[3]).itemsize)
+                    for m in self._manifest for r in m)
+        log_dist(
+            f"NVMe serving tier: {self.n_layers} layers, "
+            f"{total / 2**30:.2f} GiB under {self.dir} "
+            f"(read_ahead={self.read_ahead})", ranks=[0],
+        )
+
+    def layer_specs(self, l: int) -> Any:
+        return self._spec_tree[l]
+
+    # -- serving reads --------------------------------------------------
+    def _submit(self, l: int) -> None:
+        if l in self._inflight:
+            return
+        pairs = []
+        for _, f, shape, dtype in self._manifest[l]:
+            buf = np.empty(shape, dtype)
+            pairs.append((self.aio.async_pread(buf, f), buf))
+        self._inflight[l] = pairs
+
+    def read_layer(self, l: int) -> Any:
+        """Blocking read of layer l (waits on its prefetch if in flight),
+        then submits prefetch for the next read_ahead layers — called
+        from the step's io_callback, so the wait overlaps the PREVIOUS
+        layer's device compute."""
+        self._submit(l)
+        pairs = self._inflight.pop(l)
+        for t, _ in pairs:
+            self.aio.wait(t)
+        # decode walks layers cyclically (every step re-streams the
+        # model): prefetch wraps around
+        for d in range(1, self.read_ahead + 1):
+            self._submit((l + d) % self.n_layers)
+        return jax.tree_util.tree_unflatten(self._treedef,
+                                            [b for _, b in pairs])

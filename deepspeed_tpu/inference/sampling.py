@@ -69,56 +69,78 @@ class SamplingConfig:
         return dataclasses.astuple(self)
 
 
-def apply_penalty_and_filters(logits, cfg: SamplingConfig,
-                              presence: Optional[Any] = None):
-    """[S, V] f32 logits -> filtered logits (still [S, V]; filtered-out
-    entries at -inf). CTRL repetition-penalty rule (divide positive
-    seen logits, multiply negative — ref HF RepetitionPenaltyLogitsProcessor,
-    which the reference engine inherits), then temperature, then top-k,
-    then top-p."""
+def _penalized(logits, cfg: SamplingConfig, presence: Optional[Any]):
+    """Repetition penalty (CTRL rule — divide positive seen logits,
+    multiply negative; ref HF RepetitionPenaltyLogitsProcessor, which
+    the reference engine inherits) + temperature."""
     logits = logits.astype(jnp.float32)
     if cfg.needs_presence and presence is not None:
         seen = presence.astype(jnp.bool_)
         pen = jnp.float32(cfg.repetition_penalty)
         logits = jnp.where(
             seen, jnp.where(logits > 0, logits / pen, logits * pen), logits)
+    if not cfg.greedy:
+        logits = logits / jnp.float32(max(cfg.temperature, 1e-6))
+    return logits
+
+
+def _pool_width(cfg: SamplingConfig, V: int) -> int:
+    """Candidate-pool width: top-k bounds the nucleus when set (TopP
+    sees the TOP-K-FILTERED distribution per the HF chain order), so
+    the pool never needs to exceed k — pooling at cand_width when k=40
+    would pay a 6x-wider lax.top_k for rows that can never win
+    (r4 bench: the sampled-decode tax)."""
+    k_eff = cfg.top_k if cfg.top_k and 0 < cfg.top_k < V else 0
+    if k_eff:
+        return min(V, k_eff)
+    if 0.0 < cfg.top_p < 1.0:
+        return min(V, cfg.cand_width)
+    return 0  # pure temperature sampling: full vocab
+
+
+def _pool_filter(logits, vals, cfg: SamplingConfig):
+    """-inf out pool entries (descending [S, W]) cut by top-k/top-p.
+
+    top-k keeps exactly the first k columns (the pool IS the top-k).
+    top-p masses come from the top-k-renormalized distribution when
+    top-k is set, else from the FULL softmax (pool renormalization
+    would inflate every cumulative mass and push the nucleus cutoff
+    too deep — r4 review finding). Keeps the smallest prefix reaching
+    top_p (always at least the top-1)."""
+    if 0.0 < cfg.top_p < 1.0:
+        V = logits.shape[-1]
+        k_eff = cfg.top_k if cfg.top_k and 0 < cfg.top_k < V else 0
+        if k_eff:
+            lse = jax.scipy.special.logsumexp(vals, axis=-1, keepdims=True)
+        else:
+            lse = jax.scipy.special.logsumexp(logits, axis=-1,
+                                              keepdims=True)
+        probs = jnp.exp(vals - lse)  # true masses, descending order
+        csum = jnp.cumsum(probs, axis=-1)
+        keep = (csum - probs) < jnp.float32(cfg.top_p)
+        vals = jnp.where(keep, vals, -jnp.inf)
+    return vals
+
+
+def apply_penalty_and_filters(logits, cfg: SamplingConfig,
+                              presence: Optional[Any] = None):
+    """[S, V] f32 logits -> filtered logits (still [S, V]; filtered-out
+    entries at -inf). Full-vocab form of the filter chain — kept for
+    distribution-level tests; the sampling hot path draws from the
+    candidate pool instead (sample_tokens) so the PRNG + argmax run
+    over W candidates, not 32k logits."""
+    logits = _penalized(logits, cfg, presence)
     if cfg.greedy:
         return logits
-    logits = logits / jnp.float32(max(cfg.temperature, 1e-6))
     V = logits.shape[-1]
-    k_eff = 0
-    if cfg.top_k and 0 < cfg.top_k < V:
-        k_eff = cfg.top_k
-    need_pool = k_eff or (0.0 < cfg.top_p < 1.0)
-    if need_pool:
-        width = min(V, max(k_eff or 1, cfg.cand_width
-                           if 0.0 < cfg.top_p < 1.0 else (k_eff or 1)))
-        vals = jax.lax.top_k(logits, width)[0]  # [S, width] descending
-        if k_eff:
-            kth = vals[:, k_eff - 1][:, None]
-            logits = jnp.where(logits < kth, -jnp.inf, logits)
-        if 0.0 < cfg.top_p < 1.0:
-            # HF chain order: TopP sees the TOP-K-FILTERED distribution
-            # (renormalized over the k survivors); without top-k, masses
-            # come from the FULL softmax (exp(v - lse(all logits))), not
-            # a pool-renormalized one — pool renormalization would
-            # inflate every cumulative mass by 1/pool_mass and push the
-            # nucleus cutoff too deep (r4 review finding).
-            if k_eff:
-                pool = vals[:, :k_eff]
-                lse = jax.scipy.special.logsumexp(pool, axis=-1,
-                                                  keepdims=True)
-            else:
-                pool = vals
-                lse = jax.scipy.special.logsumexp(logits, axis=-1,
-                                                  keepdims=True)
-            probs = jnp.exp(pool - lse)  # true masses, descending order
-            csum = jnp.cumsum(probs, axis=-1)
-            # keep the smallest prefix reaching top_p (always the top-1)
-            keep = (csum - probs) < jnp.float32(cfg.top_p)
-            thr = jnp.min(jnp.where(keep, pool, jnp.inf), axis=-1)[:, None]
-            logits = jnp.where(logits < thr, -jnp.inf, logits)
-    return logits
+    W = _pool_width(cfg, V)
+    if not W:
+        return logits
+    vals = jax.lax.top_k(logits, W)[0]
+    filt = _pool_filter(logits, vals, cfg)
+    thr = jnp.min(jnp.where(jnp.isfinite(filt), filt, jnp.inf),
+                  axis=-1)[:, None]
+    return jnp.where(logits < thr, -jnp.inf, logits)
 
 
 def sample_tokens(logits, cfg: SamplingConfig, keys=None, step=None,
@@ -127,10 +149,22 @@ def sample_tokens(logits, cfg: SamplingConfig, keys=None, step=None,
 
     keys: [S] per-sequence PRNG keys (jax.random key array); step: [S]
     int32 per-sequence draw counters (folded into the key so fused
-    multi-step decode advances each stream exactly like stepwise)."""
-    filtered = apply_penalty_and_filters(logits, cfg, presence)
+    multi-step decode advances each stream exactly like stepwise).
+
+    The draw is gumbel-max over the CANDIDATE POOL (top-k/top-p
+    survivors, [S, W]): exact for the filtered categorical, and the
+    per-step PRNG cost is W draws per row instead of V (the r4 bench's
+    28% sampled-decode tax was threefry over [32, 32000] every step)."""
+    logits = _penalized(logits, cfg, presence)
     if cfg.greedy:
-        return jnp.argmax(filtered, axis=-1).astype(jnp.int32)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    V = logits.shape[-1]
+    W = _pool_width(cfg, V)
+    if W:
+        vals, idx = jax.lax.top_k(logits, W)  # [S, W] descending
+        pool = _pool_filter(logits, vals, cfg)
+    else:
+        pool, idx = logits, None
 
     def draw(key, t, row):
         u = jax.random.uniform(
@@ -139,7 +173,11 @@ def sample_tokens(logits, cfg: SamplingConfig, keys=None, step=None,
         g = -jnp.log(-jnp.log(u))
         return jnp.argmax(row + g).astype(jnp.int32)
 
-    return jax.vmap(draw)(keys, step, filtered)
+    choice = jax.vmap(draw)(keys, step, pool)
+    if idx is None:
+        return choice
+    return jnp.take_along_axis(idx, choice[:, None], axis=1)[:, 0] \
+        .astype(jnp.int32)
 
 
 def update_presence(presence, tokens):
@@ -166,16 +204,16 @@ def host_oracle_token(logits, cfg: SamplingConfig, key, t,
                       presence_row=None) -> int:
     """Replay one draw host-side (numpy logits + the same key/step):
     must reproduce sample_tokens bit-exactly — the parity contract the
-    tests pin down."""
+    tests pin down. Runs the SAME pooled draw as the device path (the
+    PRNG stream depends on the pool width, so the oracle must pool
+    identically)."""
     import numpy as np
 
     row = jnp.asarray(np.asarray(logits, np.float32))[None]
     pres = (jnp.asarray(np.asarray(presence_row, np.uint8))[None]
             if presence_row is not None else None)
-    filtered = apply_penalty_and_filters(row, cfg, pres)
     if cfg.greedy:
-        return int(jnp.argmax(filtered[0]))
-    u = jax.random.uniform(jax.random.fold_in(key, t), filtered[0].shape,
-                           minval=jnp.float32(1e-20), maxval=1.0)
-    g = -jnp.log(-jnp.log(u))
-    return int(jnp.argmax(filtered[0] + g))
+        return int(jnp.argmax(_penalized(row, cfg, pres)[0]))
+    keys = jnp.asarray(key)[None]
+    steps = jnp.asarray(t, jnp.int32)[None]
+    return int(sample_tokens(row, cfg, keys, steps, pres)[0])

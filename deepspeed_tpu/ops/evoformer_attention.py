@@ -97,12 +97,13 @@ def evoformer_attention(
 # reference-contract surface with the fused Pallas forward
 # ---------------------------------------------------------------------------
 
-def _kernel_fwd(q, k, v, b1, b2, has_b1, has_b2):
+def _kernel_fwd(q, k, v, b1, b2, has_b1, has_b2, with_lse=False):
     from .pallas.evoformer_attention import evoformer_flash_fwd
 
     return evoformer_flash_fwd(q, k, v,
                                bias1=b1 if has_b1 else None,
-                               bias2=b2 if has_b2 else None)
+                               bias2=b2 if has_b2 else None,
+                               with_lse=with_lse)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
@@ -111,22 +112,28 @@ def _evo_fused(q, k, v, b1, b2, has_b1, has_b2, chunk_size):
 
 
 def _evo_fused_fwd(q, k, v, b1, b2, has_b1, has_b2, chunk_size):
-    return _kernel_fwd(q, k, v, b1, b2, has_b1, has_b2), (q, k, v, b1, b2)
+    o, lse = _kernel_fwd(q, k, v, b1, b2, has_b1, has_b2, with_lse=True)
+    return o, (q, k, v, b1, b2, o, lse)
 
 
 def _evo_fused_bwd(has_b1, has_b2, chunk_size, res, g):
-    # backward = vjp of the exact chunked implementation (a remat-style
-    # re-forward; the CUTLASS reference ships a handwritten bwd kernel,
-    # here the chunked-XLA path already has the right memory profile —
-    # at the CALLER's chunk_size, which bounds the live logits)
-    q, k, v, b1, b2 = res
+    # handwritten Pallas backward (round 5; the CUTLASS reference ships
+    # attention_back.cu because science training is bwd-dominated):
+    # dq/dkv walks recompute probabilities from the saved logsumexp, and
+    # bias grads come from the dkv row-sums (dbias1) and the
+    # N_seq-innermost accumulation kernel (dbias2) — see
+    # ops/pallas/evoformer_attention.py
+    from .pallas.evoformer_attention import evoformer_flash_bwd
 
-    def ref(q, k, v, b1, b2):
-        biases = [b1 if has_b1 else None, b2 if has_b2 else None]
-        return evoformer_attention(q, k, v, biases, chunk_size=chunk_size)
-
-    _, vjp = jax.vjp(ref, q, k, v, b1, b2)
-    return vjp(g)
+    q, k, v, b1, b2, o, lse = res
+    dq, dk, dv, db1, db2 = evoformer_flash_bwd(
+        q, k, v, b1 if has_b1 else None, b2 if has_b2 else None,
+        o, lse, g)
+    if db1 is None:
+        db1 = jnp.zeros_like(b1)
+    if db2 is None:
+        db2 = jnp.zeros_like(b2)
+    return dq, dk, dv, db1, db2
 
 
 _evo_fused.defvjp(_evo_fused_fwd, _evo_fused_bwd)
@@ -140,10 +147,11 @@ def ds4sci_evoformer_attention(
     deepspeed4science/evoformer_attn.py): q/k/v [B, S, N, H, D], up to
     two biases — [B, S, 1, 1, N] per-key mask and [B, 1, H, N, N] pair.
 
-    use_kernel=True routes the FORWARD through the fused Pallas kernel
-    (ops/pallas/evoformer_attention.py) when the shapes fit its tiling
-    (N % 128 == 0); gradients always come from the exact chunked path.
-    Anything off-contract falls back to chunked evoformer_attention."""
+    use_kernel=True routes BOTH the forward and the backward through
+    the fused Pallas kernels (ops/pallas/evoformer_attention.py —
+    handwritten dq/dkv/dbias walks, the attention_back.cu analog) when
+    the shapes fit the tiling (N % 128 == 0). Anything off-contract
+    falls back to chunked evoformer_attention (exact, O(N·chunk))."""
     b1 = biases[0] if len(biases) > 0 else None
     b2 = biases[1] if len(biases) > 1 else None
     if use_kernel and q.ndim == 5:

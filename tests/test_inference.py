@@ -714,18 +714,24 @@ class TestZeroInferenceOffload:
                      min_prefill_bucket=8, max_batch_size=8),
                 dtype=jnp.float32, offload={"device": "cpu"})
 
-    def test_nvme_and_tp_rejected(self, rng):
+    def test_offload_guardrails(self, rng):
+        """Round 5 lifted the nvme and cpu-x-TP refusals; the remaining
+        guards: nvme needs a path, nvme under TP stays refused (the
+        io_callback fetch is single-process), unknown devices raise."""
         cfg, params = small_model()
-        with pytest.raises(NotImplementedError, match="cpu"):
+        with pytest.raises(ValueError, match="path"):
             init_inference(params, cfg, dict(max_seq_len=32),
                            offload={"device": "nvme"})
+        with pytest.raises(ValueError, match="cpu.*nvme|nvme.*cpu"):
+            init_inference(params, cfg, dict(max_seq_len=32),
+                           offload={"device": "disk"})
         cfg2, params2 = small_model(n_heads=8)
         with pytest.raises(NotImplementedError, match="TP mesh"):
             init_inference(params2, cfg2,
                            dict(max_seq_len=64, kv_block_size=8,
                                 num_kv_blocks=32, min_prefill_bucket=8,
                                 max_batch_size=8, tp_size=2),
-                           offload={"device": "cpu"})
+                           offload={"device": "nvme", "path": "/tmp/x"})
 
 
 class TestDecodeMulti:
@@ -1335,3 +1341,168 @@ class TestAlibiServing:
             logits = eng.put([0], [np.asarray([tok], np.int32)])
             ref = oracle_next_logits(params, cfg, ctx)
             np.testing.assert_allclose(logits[0], ref, rtol=5e-4, atol=5e-4)
+
+
+class TestNvmeOffloadServing:
+    """NVMe-tier full-offload serving (ref: partitioned_param_swapper
+    .py:36 + the OPT-30B-from-NVMe case, zero-inference post:52): layer
+    weights live in per-leaf NVMe files; each step's layer fetch is an
+    in-program io_callback over the aio read-ahead window."""
+
+    def _nvme_engine(self, params, cfg, tmp_path, quant=None):
+        return init_inference(
+            params, cfg,
+            dict(max_seq_len=64, kv_block_size=8, num_kv_blocks=32,
+                 min_prefill_bucket=8, max_batch_size=8),
+            dtype=jnp.float32, quantization=quant,
+            offload={"device": "nvme", "path": str(tmp_path),
+                     "read_ahead": 2})
+
+    def test_layers_on_disk_not_in_memory(self, rng, tmp_path):
+        cfg, params = small_model()
+        off = self._nvme_engine(params, cfg, tmp_path)
+        # the served tree carries only layer indices; bytes are on disk
+        for lp in off.params["layers"]:
+            assert lp == {}
+        files = list((tmp_path / "ds_tpu_swap").rglob("l*_leaf*.bin"))
+        assert len(files) >= cfg.n_layers * 5, files
+
+    def test_matches_resident_engine(self, rng, tmp_path):
+        cfg, params = small_model()
+        plain = engine_for(cfg, params)
+        off = self._nvme_engine(params, cfg, tmp_path)
+        prompts = [np.asarray(rng.integers(0, 128, n), np.int32)
+                   for n in (9, 4)]
+        l1 = plain.put([0, 1], [p.copy() for p in prompts])
+        l2 = off.put([0, 1], [p.copy() for p in prompts])
+        np.testing.assert_allclose(l2, l1, rtol=2e-5, atol=2e-5)
+        for _ in range(3):
+            nxt = [np.argmax(l1[i])[None].astype(np.int32)
+                   for i in range(2)]
+            l1 = plain.put([0, 1], nxt)
+            l2 = off.put([0, 1], nxt)
+            np.testing.assert_allclose(l2, l1, rtol=2e-5, atol=2e-5)
+
+    def test_int8_composes(self, rng, tmp_path):
+        from deepspeed_tpu.inference.quantization import ChannelQuantWeight
+
+        cfg, params = small_model()
+        off8 = self._nvme_engine(params, cfg, tmp_path,
+                                 quant={"bits": 8, "per_channel": True})
+        specs = off8._nvme_store.layer_specs(0)
+        assert isinstance(specs["w_qkv"], ChannelQuantWeight)
+        out = off8.generate([list(rng.integers(0, 128, 6))],
+                            max_new_tokens=5)
+        assert len(out[0]) == 5
+
+    def test_nvme_requires_path(self, rng):
+        cfg, params = small_model()
+        with pytest.raises(ValueError, match="path"):
+            init_inference(params, cfg,
+                           dict(max_seq_len=64, kv_block_size=8,
+                                num_kv_blocks=32, max_batch_size=8),
+                           offload={"device": "nvme"})
+
+
+class TestTPOffloadServing:
+    """cpu-tier offload under a TP mesh: each device's weight SHARD
+    parks in pinned_host and streams to its own HBM inside the step
+    (the per-device stream shrinks by 1/tp — offload TP scales the
+    weight-stream roofline; the reference's multi-GPU ZeRO-Inference
+    analog)."""
+
+    def _mesh(self, n):
+        from deepspeed_tpu.platform.mesh import build_mesh
+
+        return build_mesh({"model": n}, devices=jax.devices()[:n])
+
+    def test_shards_parked_pinned_and_serving_matches(self, rng):
+        cfg, params = small_model()
+        plain = engine_for(cfg, params)
+        off = init_inference(
+            params, cfg,
+            dict(max_seq_len=64, kv_block_size=8, num_kv_blocks=32,
+                 min_prefill_bucket=8, max_batch_size=8, tensor_parallel=2),
+            dtype=jnp.float32, mesh=self._mesh(2),
+            offload={"device": "cpu"})
+        lp0 = off.params["layers"][0]
+        assert "wq" in lp0  # TP keeps projections unfused
+        assert lp0["wq"].sharding.memory_kind == "pinned_host"
+        # head-dim sharded over 'model'
+        assert "model" in str(lp0["wq"].sharding.spec)
+        prompts = [np.asarray(rng.integers(0, 128, 9), np.int32)]
+        l1 = plain.put([0], [prompts[0].copy()])
+        l2 = off.put([0], [prompts[0].copy()])
+        np.testing.assert_allclose(l2, l1, rtol=2e-4, atol=2e-4)
+        for _ in range(2):
+            nxt = [np.argmax(l1[0])[None].astype(np.int32)]
+            l1 = plain.put([0], nxt)
+            l2 = off.put([0], nxt)
+            np.testing.assert_allclose(l2, l1, rtol=2e-4, atol=2e-4)
+
+
+class TestSpeculativeDecoding:
+    """Prompt-lookup self-speculative greedy decoding (the r4 profile's
+    named policy lever for offload serving: more tokens per weight
+    stream). Exactness contract: output == plain greedy, token for
+    token; on repetitive text the verify program must accept multi-token
+    runs (fewer weight streams than tokens)."""
+
+    def _rep_prompt(self, rng):
+        # strongly periodic prompt: n-gram lookup should fire constantly
+        base = list(rng.integers(0, 128, 6))
+        return (base * 4)[:22]
+
+    def test_matches_plain_greedy(self, rng):
+        cfg, params = small_model()
+        a = engine_for(cfg, params)
+        b = engine_for(cfg, params)
+        prompt = self._rep_prompt(rng)
+        want = a.generate([prompt], max_new_tokens=12)
+        got = b.generate_speculative([prompt], max_new_tokens=12,
+                                     ngram=2, draft_len=4)
+        assert got == want
+
+    def test_accepts_multi_token_runs(self, rng):
+        cfg, params = small_model()
+        eng = engine_for(cfg, params)
+        calls = {"n": 0}
+        orig = eng._verify_chunks
+
+        def counting(uids, chunks):
+            calls["n"] += 1
+            return orig(uids, chunks)
+
+        eng._verify_chunks = counting
+        prompt = self._rep_prompt(rng)
+        out = eng.generate_speculative([prompt], max_new_tokens=12,
+                                       ngram=2, draft_len=4)
+        assert len(out[0]) == 12
+        # fewer verify steps than tokens = multi-token acceptance
+        assert calls["n"] < 12, calls
+
+    def test_offload_engine_speculative(self, rng):
+        """The headline composition: bigger-than-HBM serving pays one
+        weight stream per ACCEPTED RUN, not per token."""
+        cfg, params = small_model()
+        plain = engine_for(cfg, params)
+        off = init_inference(
+            params, cfg,
+            dict(max_seq_len=64, kv_block_size=8, num_kv_blocks=32,
+                 min_prefill_bucket=8, max_batch_size=8),
+            dtype=jnp.float32, offload={"device": "cpu"})
+        prompt = self._rep_prompt(rng)
+        want = plain.generate([prompt], max_new_tokens=10)
+        got = off.generate_speculative([prompt], max_new_tokens=10,
+                                       ngram=2, draft_len=4)
+        assert got == want
+
+    def test_batched_prompts(self, rng):
+        cfg, params = small_model()
+        a = engine_for(cfg, params)
+        b = engine_for(cfg, params)
+        prompts = [self._rep_prompt(rng), list(rng.integers(0, 128, 9))]
+        want = a.generate(prompts, max_new_tokens=8)
+        got = b.generate_speculative(prompts, max_new_tokens=8,
+                                     ngram=2, draft_len=3)
+        assert got == want

@@ -120,6 +120,14 @@ def main():
     mfu = achieved / peak
 
     serving = _serving_bench(mcfg if on_tpu else None, engine)
+    # free the training state (fp32 master + opt moments, ~5 GiB at the
+    # flagship size) before the 7B build: 6.7 GiB of int8 codes + cache
+    # must fit alongside whatever is still resident
+    engine = None
+    import gc
+
+    gc.collect()
+    serving_7b = _serving_7b_bench(on_tpu)
 
     target_mfu = 0.45  # BASELINE.json north star
     out = {
@@ -137,6 +145,8 @@ def main():
     }
     if serving:
         out.update(serving)
+    if serving_7b:
+        out.update(serving_7b)
     # committed real-chip artifacts from the scaling / offload lanes
     # (scripts/bench_scaling.py, scripts/ici_projection.py,
     # scripts/bench_offload.py) ride along so the headline line carries
@@ -160,6 +170,87 @@ def main():
             for e in json.load(open(off))
         }
     print(json.dumps(out))
+
+
+def _measure_rtt():
+    """Measured tunnel round trip: trivial dispatch + 1-element fetch
+    (only a host readback synchronizes through the axon relay; see
+    scripts/tpu_timing.py for the measured facts)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    triv = jax.jit(lambda x: x + 1)
+    np.asarray(jax.device_get(triv(jnp.zeros(8))))[:1]
+    rtts = []
+    for i in range(5):
+        t0 = time.perf_counter()
+        np.asarray(jax.device_get(triv(jnp.full(8, float(i)))))[:1]
+        rtts.append(time.perf_counter() - t0)
+    return min(rtts)
+
+
+def _ttft_lane(eng, ttft_len: int, trials: int, rtt: float,
+               scratch_uid: int):
+    """p50 TTFT of the compiled single-prompt prefill program,
+    RTT-corrected, via a scratch uid (flushed after)."""
+    import time
+
+    import jax
+    import numpy as np
+
+    r = np.random.default_rng(7)
+    ptoks = np.asarray(
+        r.integers(0, eng.cfg.vocab_size, ttft_len), np.int32)
+    eng.state.extend(scratch_uid, ttft_len)
+    table = eng.state.block_table([scratch_uid],
+                                  eng.config.blocks_per_seq,
+                                  eng.pad_block)[0]
+    pf = eng._prefill_batch_fn(1, ttft_len)
+    ts = []
+    for i in range(trials + 1):
+        t0 = time.perf_counter()
+        lg, eng.cache = pf(eng.params, eng.cache, eng._dev(ptoks[None]),
+                           eng._dev(np.asarray([ttft_len], np.int32)),
+                           eng._dev(table[None]))
+        np.asarray(jax.device_get(lg.ravel()[:1]))
+        if i:  # drop the compile trial
+            ts.append(max(time.perf_counter() - t0 - rtt, 1e-5) * 1e3)
+    eng.state.flush(scratch_uid)
+    med = float(np.median(ts))
+    spread = (max(ts) - min(ts)) / med if med else 0.0
+    return med, round(spread, 3)
+
+
+def _decode_throughput_lane(eng, uids, b: int, decode_steps: int,
+                            trials: int, rtt: float, ctx_val: int):
+    """Median RTT-corrected decode tok/s of the fused multi-step
+    program at batch b (greedy; the sampled variant stays inline in
+    _serving_bench)."""
+    import time
+
+    import jax
+    import numpy as np
+
+    fn = eng.decode_multi_fn(b, decode_steps)
+    tokens = np.zeros((b,), np.int32)
+    tables = eng.state.block_table(uids[:b], eng.config.blocks_per_seq,
+                                   eng.pad_block)
+    ctx = np.full((b,), ctx_val, np.int32)
+    samples = []
+    for i in range(trials + 1):
+        t0 = time.perf_counter()
+        gen, logits, eng.cache, _ = fn(eng.params, eng.cache, tokens,
+                                       tables, ctx)
+        np.asarray(jax.device_get(gen[0, 0]))
+        if i:
+            samples.append(b * decode_steps
+                           / max(time.perf_counter() - t0 - rtt, 1e-5))
+    med = float(np.median(samples))
+    spread = (max(samples) - min(samples)) / med if med else 0.0
+    return med, round(spread, 3)
 
 
 def _serving_bench(mcfg, train_engine):
@@ -203,15 +294,7 @@ def _serving_bench(mcfg, train_engine):
                    for _ in uids]
         eng.put(uids, prompts)  # ONE prefill wave populates the cache
 
-        # measured tunnel round trip: trivial dispatch + 1-element fetch
-        triv = jax.jit(lambda x: x + 1)
-        np.asarray(jax.device_get(triv(jnp.zeros(8))))[:1]
-        rtts = []
-        for i in range(5):
-            t0 = time.perf_counter()
-            np.asarray(jax.device_get(triv(jnp.full(8, float(i)))))[:1]
-            rtts.append(time.perf_counter() - t0)
-        rtt = min(rtts)
+        rtt = _measure_rtt()
 
         def med_spread(samples):
             med = float(np.median(samples))
@@ -220,23 +303,8 @@ def _serving_bench(mcfg, train_engine):
 
         # p50 TTFT: the compiled 512-token prefill program, RTT-corrected
         ttft_len = 512
-        ptoks = np.zeros((ttft_len,), np.int32)
-        ptoks[:] = r.integers(0, mcfg.vocab_size, ttft_len)
-        eng.state.extend(max_batch, ttft_len)  # scratch uid
-        table = eng.state.block_table([max_batch], eng.config.blocks_per_seq,
-                                      eng.pad_block)[0]
-        pf = eng._prefill_batch_fn(1, ttft_len)
-        ts = []
-        for i in range(trials + 1):
-            t0 = time.perf_counter()
-            lg, eng.cache = pf(eng.params, eng.cache, eng._dev(ptoks[None]),
-                               eng._dev(np.asarray([ttft_len], np.int32)),
-                               eng._dev(table[None]))
-            np.asarray(jax.device_get(lg.ravel()[:1]))
-            if i:  # drop the compile trial
-                ts.append(max((time.perf_counter() - t0 - rtt), 1e-5) * 1e3)
-        eng.state.flush(max_batch)
-        p50_ttft, ttft_spread = med_spread(ts)
+        p50_ttft, ttft_spread = _ttft_lane(eng, ttft_len, trials, rtt,
+                                           scratch_uid=max_batch)
 
         # decode: fused multi-token program per batch width — one
         # dispatch per decode_steps tokens. decode_multi ADVANCES ctx
@@ -246,18 +314,17 @@ def _serving_bench(mcfg, train_engine):
         )
 
         def decode_lane(e, b, sampling=None):
-            if sampling is None:
-                fn = e.decode_multi_fn(b, decode_steps)
-            else:
-                fn = e.decode_multi_fn(b, decode_steps, sampling=sampling)
+            if sampling is None:  # greedy: the shared helper
+                return _decode_throughput_lane(e, uids, b, decode_steps,
+                                               trials, rtt,
+                                               ctx_val=prompt_len + 1)
+            fn = e.decode_multi_fn(b, decode_steps, sampling=sampling)
             tokens = np.zeros((b,), np.int32)
             tables = e.state.block_table(uids[:b], e.config.blocks_per_seq,
                                          e.pad_block)
             ctx = np.full((b,), prompt_len + 1, np.int32)
-            extra = ()
-            if sampling is not None:
-                extra = (e._row_keys(0, np.arange(b, dtype=np.uint32)),
-                         e._dev(ctx))
+            extra = (e._row_keys(0, np.arange(b, dtype=np.uint32)),
+                     e._dev(ctx))
             samples = []
             for i in range(trials + 1):
                 t0 = time.perf_counter()
@@ -308,6 +375,120 @@ def _serving_bench(mcfg, train_engine):
         import sys
 
         print(f"serving bench skipped: {type(e).__name__}: {e}", file=sys.stderr)
+        return None
+
+
+def _serving_7b_bench(on_tpu: bool):
+    """Serve REAL 7B geometry (VERDICT r4 item 2 — the serving north
+    star proxied by the 350M flagship until now): Llama-2-7B shape
+    (32 layers, d4096, 32 heads x d128, ff 11008, vocab 32000) in
+    per-channel int8 (~6.7 GiB codes — fits the 16 GiB chip with cache
+    headroom; bf16's 13.5 GiB + cache is too tight to be robust through
+    the tunnel), p50 TTFT on a 512-token prefill and decode tok/s at
+    batch 1/8/32. Weights build LAYER BY LAYER straight into int8 so
+    the bf16 tree never materializes. Disable with DS_BENCH_7B=0;
+    DS_BENCH_7B_TINY=1 shrinks geometry for a CPU plumbing check."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.inference import init_inference
+    from deepspeed_tpu.inference import model as M
+    from deepspeed_tpu.models import transformer as T
+
+    try:
+        if os.environ.get("DS_BENCH_7B", "1") == "0" or (
+                not on_tpu and os.environ.get("DS_BENCH_7B_TINY") != "1"):
+            return None
+        tiny = os.environ.get("DS_BENCH_7B_TINY") == "1"
+        if tiny:
+            mcfg = T.TransformerConfig(
+                vocab_size=512, n_layers=2, n_heads=4, d_model=256,
+                d_ff=688, max_seq=1024, variant="llama", use_flash=False)
+        else:
+            mcfg = T.TransformerConfig(
+                vocab_size=32000, n_layers=32, n_heads=32, d_model=4096,
+                d_ff=11008, max_seq=4096, variant="llama")
+        shapes = T._layer_shapes(mcfg)
+
+        def init_layer(key):
+            lp = {}
+            ks = jax.random.split(key, len(shapes))
+            for k, (name, (shape, _)) in zip(ks, sorted(shapes.items())):
+                if "ln" in name:
+                    lp[name] = jnp.ones(shape, jnp.bfloat16)
+                elif name.startswith("b"):
+                    lp[name] = jnp.zeros(shape, jnp.bfloat16)
+                else:
+                    lp[name] = (jax.random.normal(k, shape, jnp.bfloat16)
+                                * jnp.bfloat16(0.5 / float(
+                                    np.sqrt(shape[0]))))
+            lp = M.prepare_layer(lp, mcfg, fuse=True)
+            return M.quantize_layer(lp, mcfg)
+
+        jl = jax.jit(init_layer)
+        layers = [jl(jax.random.PRNGKey(l)) for l in range(mcfg.n_layers)]
+        key = jax.random.PRNGKey(99)
+        params = {
+            "embed": (jax.random.normal(
+                key, (mcfg.vocab_size, mcfg.d_model), jnp.bfloat16)
+                * jnp.bfloat16(0.02)),
+            "ln_f_scale": jnp.ones((mcfg.d_model,), jnp.bfloat16),
+            "layers": layers,
+        }
+        batches, decode_steps, trials = (1, 8, 32), 16, 5
+        max_batch = max(batches)
+        # KV pool sized to ACTUAL use (32 seqs x 1 live block + the
+        # 512-token TTFT scratch + pad): at 7B geometry each block is
+        # 2 MB/layer/tensor, so a generously-sized pool would eat the
+        # HBM the weights need (32 layers x 2 x blocks x 2 MB)
+        icfg = dict(max_seq_len=1024, kv_block_size=128,
+                    num_kv_blocks=max_batch + 8,
+                    min_prefill_bucket=128, max_batch_size=max_batch)
+        eng = init_inference(params, mcfg, dict(icfg))
+        r = np.random.default_rng(0)
+        uids = list(range(max_batch))
+        prompts = [np.asarray(r.integers(0, mcfg.vocab_size, 96))
+                   for _ in uids]
+        eng.put(uids, prompts)
+
+        rtt = _measure_rtt()
+
+        # p50 TTFT: compiled 512-token prefill, RTT-corrected (shared
+        # machinery with the flagship lane — _ttft_lane)
+        ttft_len = 512 if not tiny else 128
+        p50, _ = _ttft_lane(eng, ttft_len, trials, rtt,
+                            scratch_uid=max_batch)
+
+        # decode writes must stay inside each sequence's prefill block
+        assert 96 + 1 + decode_steps <= eng.config.kv_block_size, (
+            "decode writes would spill past the allocated block")
+        decode = {}
+        for b in batches:
+            med, _ = _decode_throughput_lane(eng, uids, b, decode_steps,
+                                             trials, rtt, ctx_val=97)
+            decode[str(b)] = round(med, 1)
+        for u in uids:
+            eng.flush(u)
+        codes_gib = sum(
+            w.nbytes for lp in layers for w in jax.tree.leaves(lp)
+        ) / 2**30
+        return {"serving_7b": {
+            "geometry": (f"{mcfg.n_layers}L x d{mcfg.d_model} "
+                         f"x {mcfg.n_heads}h"),
+            "quant": "int8_per_channel",
+            "weights_gib": round(codes_gib, 2),
+            "p50_ttft_ms": round(p50, 2),
+            "ttft_prompt_len": ttft_len,
+            "decode_tok_s": decode,
+        }}
+    except Exception as e:  # must never break the headline line
+        import sys as _s
+
+        print(f"7B serving bench skipped: {type(e).__name__}: {e}",
+              file=_s.stderr)
         return None
 
 

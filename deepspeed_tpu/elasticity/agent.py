@@ -327,6 +327,7 @@ def run_elastic(
     env_extra=None,
     generation_timeout_s: float = 0,
     shrink_on_failure: bool = True,
+    world_size_ok: Optional[Callable[[int], bool]] = None,
 ) -> int:
     """The DSElasticAgent journey as one call: launch the world, and on
     any rank's death OR missed heartbeat tear it down and relaunch at
@@ -334,8 +335,20 @@ def run_elastic(
     modeling a lost host — the reference restarts on whatever nodes the
     rendezvous still has, ref elastic_agent.py:121 _invoke_run). Workers
     resume from `resume_dir` (they receive it via DS_ELASTIC_RESUME_DIR
-    and load the last committed checkpoint). Returns the final rc."""
+    and load the last committed checkpoint). Returns the final rc.
+
+    world_size_ok: optional predicate over candidate world sizes — wire
+    the elastic batch arithmetic here (e.g.
+    `lambda w: w * devices in compute_elastic_config(...)[1]`) so the
+    supervisor skips sizes every worker would reject at initialize()
+    (ElasticityIncompatibleWorldSize) instead of burning a generation
+    discovering it, mirroring the reference's pre-launch check
+    (elasticity/elasticity.py compatibility gate)."""
     os.makedirs(heartbeat_dir, exist_ok=True)
+    if world_size_ok is not None and not world_size_ok(num_procs):
+        raise ValueError(
+            f"initial world size {num_procs} fails world_size_ok — the "
+            "launch would be rejected by every worker's elastic check")
     world = num_procs
     extra = dict(env_extra or {})
     extra[RESUME_DIR_ENV] = resume_dir
@@ -362,6 +375,16 @@ def run_elastic(
             return rc
         if shrink_on_failure and world > min_procs:
             world -= 1
+            while (world >= min_procs and world_size_ok is not None
+                   and not world_size_ok(world)):
+                print(f"[elastic-agent] skipping world={world} "
+                      "(elastic-incompatible)", file=sys.stderr)
+                world -= 1
+            if world < min_procs:
+                print("[elastic-agent] no elastic-compatible world size "
+                      f">= min_procs {min_procs} remains; giving up "
+                      f"(last reason: {reason})", file=sys.stderr)
+                return rc
         print(f"[elastic-agent] restarting at world={world} "
               f"(generation {generation + 1}, reason {reason})",
               file=sys.stderr)

@@ -17,13 +17,20 @@ Design notes (XLA-first):
   U, so a host oracle given the same logits and key reproduces the
   token bit-exactly (tested in tests/test_sampling.py).
 - top-p needs sorted cumulative mass; sorting 32k logits per step is
-  VPU-hostile, so the CANDIDATES come from lax.top_k (width
-  cand_width, default 256) while their masses come from the full
-  softmax (or, after top-k, the k survivors — the HF processor-chain
-  order). Exact whenever the nucleus fits in the candidate width; the
-  host oracle applies the same truncation. The reference's sampler
-  post-processes on full vocab — document the difference, don't hide
-  it.
+  VPU-hostile, so the CANDIDATES come from lax.top_k — at width
+  top_k when top-k is set (the HF chain order means top-p sees the
+  top-k-filtered distribution, so the pool never needs to exceed k),
+  else cand_width (default 256) — while their masses come from the
+  full softmax (or the k survivors). Exact whenever the nucleus fits
+  in the candidate width; the host oracle applies the same
+  truncation. The reference's sampler post-processes on full vocab —
+  document the difference, don't hide it.
+- the DRAW also runs at pool width (round 5): gumbel noise over the
+  [S, W] candidates + argmax mapped back through the top_k indices —
+  per-step PRNG cost W draws per row, not 32k (the r4 bench's 28%
+  sampled-decode tax was threefry over the full vocab every step).
+  Pure temperature sampling (no top-k/top-p) keeps the full-vocab
+  draw.
 - repetition penalty needs the seen-token set; a [S, vocab] presence
   bitmap rides the decode scan and is updated with max(presence,
   one_hot(token)) — no scatter (XLA scatter carries a fixed multi-ms

@@ -393,7 +393,7 @@ def _bwd_dkv_kernel(
 
 
 def _flash_bwd(q, k, v, slopes, o, lse, do, causal, block_q, block_k, H, KV,
-               window=0, alibi=False):
+               window=0, alibi=False, delta_adjust=None):
     BH, S, D = q.shape
     BKV = k.shape[0]
     G = H // KV
@@ -404,6 +404,11 @@ def _flash_bwd(q, k, v, slopes, o, lse, do, causal, block_q, block_k, H, KV,
     nq, nk = Sp // bq, Sk // bk
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [BH,S]
+    if delta_adjust is not None:
+        # lse cotangent (flash_attention_with_lse): d lse/d s = p, so the
+        # extra ds term is p * g_lse — algebraically identical to
+        # shrinking delta by g_lse (ds = p * (dp - (delta - g_lse)))
+        delta = delta - delta_adjust
     qp = _pad_to(q, Sp, 1)
     dop = _pad_to(do, Sp, 1)
     lsep = _pad_to(lse, Sp, 1).reshape(BH, 1, Sp)
@@ -520,6 +525,59 @@ def _flash_bwd_rule(causal, block_q, block_k, H, KV, window, alibi, res, do):
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, causal, block_q, block_k, H, KV):
+    return _flash_fwd(q, k, v, None, causal, block_q, block_k, H, KV)
+
+
+def _flash_lse_fwd_rule(q, k, v, causal, block_q, block_k, H, KV):
+    o, lse = _flash_fwd(q, k, v, None, causal, block_q, block_k, H, KV)
+    # named like _flash_fwd_rule's residuals so remat="save_attn*"
+    # policies keep ring-flash hop residuals too (without the names the
+    # backward would re-run the whole forward ring per layer)
+    from jax.ad_checkpoint import checkpoint_name
+
+    o = checkpoint_name(o, "flash_o")
+    lse = checkpoint_name(lse, "flash_lse")
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_lse_bwd_rule(causal, block_q, block_k, H, KV, res, cts):
+    q, k, v, o, lse = res
+    do, dlse = cts
+    return _flash_bwd(q, k, v, None, o, lse, do, causal, block_q, block_k,
+                      H, KV, delta_adjust=dlse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
+
+
+def flash_attention_with_lse(
+    q, k, v, causal: bool = True, block_q: int = 512, block_k: int = 1024,
+):
+    """flash_attention that ALSO returns the per-row logsumexp
+    ([B, H, S] f32) and is differentiable in both outputs — the partial
+    attention primitive ring attention's hops merge with
+    (o_c = Σ o_i · exp(lse_i - lse_c), lse_c = logaddexp(lse_i)).
+    The lse cotangent folds into the existing backward kernels as a
+    delta adjustment; no new kernel code."""
+    B, S, H, D = q.shape
+    KV = k.shape[2]
+    assert H % KV == 0, f"n_heads {H} not a multiple of kv_heads {KV}"
+    # the kernels tile K by q's padded length (self-attention shapes)
+    assert k.shape[1] == S, "flash_attention_with_lse needs Sq == Sk"
+    bq = min(block_q, S)
+    bk = min(block_k, S)
+
+    def to_bh(x):
+        h = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(B * h, x.shape[1], D)
+
+    o, lse = _flash_lse(to_bh(q), to_bh(k), to_bh(v), causal, bq, bk, H, KV)
+    return (o.reshape(B, H, S, D).transpose(0, 2, 1, 3),
+            lse.reshape(B, H, S))
 
 
 def flash_attention(

@@ -729,7 +729,8 @@ def decode_step(
         if fuse_write:
             att, ck, cv = _decode_attention(
                 q, ck_in, cv_in, tables, ctx_lens, use_kernel,
-                allowed_slots=allowed_slots, window=cfg.sliding_window,
+                allowed_slots=allowed_slots,
+                window=cfg.window_for_layer(li),
                 mesh=mesh, k_new=k, v_new=v, slots=flat_idx, alibi=alibi,
             )
         else:
@@ -739,8 +740,8 @@ def decode_step(
             att = _decode_attention(q, ck, cv, tables, ctx_lens, use_kernel,
                                     allowed=allowed,
                                     allowed_slots=allowed_slots,
-                                    window=cfg.sliding_window, mesh=mesh,
-                                    alibi=alibi)
+                                    window=cfg.window_for_layer(li),
+                                    mesh=mesh, alibi=alibi)
         new_k.append(ck)
         new_v.append(cv)
         out = _wmm("shd,hde->se", att, lp["wo"])
@@ -948,14 +949,14 @@ def prefill_batch(
                 att = _shard_map_kernel(
                     lambda q_, k_, v_, ab_: causal_attention(
                         q_, k_, v_, use_flash=use_kernel and cfg.use_flash,
-                        window=cfg.sliding_window, alibi=ab_),
+                        window=cfg.window_for_layer(li), alibi=ab_),
                     mesh, in_specs=(hs, hs, hs, P("model")), out_specs=hs,
                 )(q, k, v, alibi)
             else:
                 att = _shard_map_kernel(
                     partial(causal_attention,
                             use_flash=use_kernel and cfg.use_flash,
-                            window=cfg.sliding_window),
+                            window=cfg.window_for_layer(li)),
                     mesh, in_specs=(hs, hs, hs), out_specs=hs,
                 )(q, k, v)
         else:
@@ -963,7 +964,7 @@ def prefill_batch(
                 q, k, v,
                 # a raw pallas_call cannot consume TP-sharded operands
                 use_flash=use_kernel and cfg.use_flash and _tp_size(mesh) <= 1,
-                window=cfg.sliding_window, alibi=alibi)
+                window=cfg.window_for_layer(li), alibi=alibi)
         out = _wmm("bshd,hde->bse", att, lp["wo"])
         if "bo" in lp:
             out = out + lp["bo"].astype(x.dtype)

@@ -54,6 +54,11 @@ class TransformerConfig:
     # + n_experts). 0 disables. Applies to the ulysses impl; serving
     # masks the paged decode path to the same window.
     sliding_window: int = 0
+    # Per-layer window pattern cycling over layers (GPT-Neo class:
+    # attention_types [["global","local"], L/2] → (0, 256)). 0 entries
+    # are global. Overrides sliding_window; the pattern length must
+    # divide n_layers (the scan groups layers by one pattern period).
+    attention_window_pattern: Optional[Tuple[int, ...]] = None
     sparse_block: int = 64
     sparse_mode: str = "fixed"  # fixed | longformer | bigbird | dense | variable
     sparse_num_local_blocks: int = 4
@@ -195,6 +200,36 @@ class TransformerConfig:
             raise ValueError("rotary_pct applies to the rotary family")
         if self.lm_head_bias and self.tie_embeddings:
             raise ValueError("lm_head_bias requires an untied lm_head")
+        if self.attention_window_pattern is not None:
+            p = tuple(self.attention_window_pattern)
+            if self.attention_impl != "ulysses":
+                raise ValueError(
+                    "attention_window_pattern requires "
+                    "attention_impl='ulysses'")
+            if not p or any(w < 0 for w in p):
+                raise ValueError(
+                    f"bad attention_window_pattern {p} (non-empty, "
+                    "entries >= 0; 0 = global)")
+            if self.n_layers % len(p):
+                raise ValueError(
+                    f"attention_window_pattern length {len(p)} must "
+                    f"divide n_layers {self.n_layers}")
+            if self.pipeline_stages > 1 or self.random_ltd_layer_range:
+                raise NotImplementedError(
+                    "attention_window_pattern with pipeline/random-LTD "
+                    "layer partitioning")
+            # collapse to the MINIMAL period: HF imports arrive expanded
+            # to n_layers entries (attention_types repeats sum to
+            # num_layers), and the scan body unrolls len(pattern)
+            # sublayers — a full-length pattern would unroll EVERY layer
+            # (gpt-neo-2.7B: 32 bodies in one scan step). Cyclic
+            # equality is preserved: q divides len(p) and p[i]==p[i%q].
+            for q_len in range(1, len(p)):
+                if len(p) % q_len == 0 and all(
+                        p[i] == p[i % q_len] for i in range(len(p))):
+                    object.__setattr__(self, "attention_window_pattern",
+                                       p[:q_len])
+                    break
         if self.alibi and self.attention_impl != "ulysses":
             raise ValueError(
                 "alibi requires attention_impl='ulysses' (ring rotates KV "
@@ -252,6 +287,13 @@ class TransformerConfig:
         if self.mlp_bias is not None:
             return self.mlp_bias
         return self.variant == "gpt2"
+
+    def window_for_layer(self, i: int) -> int:
+        """Layer i's sliding window (0 = global attention)."""
+        if self.attention_window_pattern is not None:
+            return self.attention_window_pattern[
+                i % len(self.attention_window_pattern)]
+        return self.sliding_window
 
     @property
     def kv_heads(self) -> int:
@@ -599,9 +641,15 @@ def _dropout(x, rate: float, rng):
     return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
 
 
-def _attention_delta(h, lp, cfg: TransformerConfig, rng=None, positions=None):
+def _attention_delta(h, lp, cfg: TransformerConfig, rng=None, positions=None,
+                     window: Optional[int] = None):
     """Attention branch over the NORMED input h; returns the residual
-    DELTA (the layer body composes sequential vs parallel residuals)."""
+    DELTA (the layer body composes sequential vs parallel residuals).
+
+    window: per-layer sliding window override (attention_window_pattern
+    layers); None = cfg.sliding_window."""
+    if window is None:
+        window = cfg.sliding_window
     x = h
     q = jnp.einsum("bse,ehd->bshd", h, lp["wq"].astype(x.dtype))
     k = jnp.einsum("bse,ehd->bshd", h, lp["wk"].astype(x.dtype))
@@ -648,7 +696,7 @@ def _attention_delta(h, lp, cfg: TransformerConfig, rng=None, positions=None):
         if cfg.alibi:
             slopes = jnp.asarray(model_alibi_slopes(cfg))
         out = causal_attention(q, k, v, use_flash=cfg.use_flash,
-                               window=cfg.sliding_window,
+                               window=window,
                                block_q=cfg.flash_block_q,
                                block_k=cfg.flash_block_k,
                                alibi=slopes)  # [B,S,H,D]
@@ -780,7 +828,7 @@ def _wants_rng(cfg: TransformerConfig) -> bool:
 
 
 def _make_layer_body(cfg: TransformerConfig, use_rng: bool, positions=None,
-                     pld_theta=None):
+                     pld_theta=None, window: Optional[int] = None):
     """One transformer layer as a scan body (shared by the flat
     scan-over-layers path, the pipelined per-stage path, and the
     random-LTD subset segment — which passes the subset's original
@@ -812,7 +860,8 @@ def _make_layer_body(cfg: TransformerConfig, use_rng: bool, positions=None,
                 h1 = _act_quant(
                     _norm(h0, lp["ln1_scale"], lp.get("ln1_bias"), cfg), cfg)
             with jax.named_scope("attention"):
-                attn = _attention_delta(h1, lp, cfg, r1, positions=positions)
+                attn = _attention_delta(h1, lp, cfg, r1, positions=positions,
+                                        window=window)
             if cfg.parallel_residual:
                 # Falcon/Phi form: both branches read the SAME residual
                 # stream (shared_ln additionally shares the norm)
@@ -946,6 +995,40 @@ def forward_hidden(
         else:
             xs = lp
         return jax.lax.scan(body, x_in, xs)
+
+    if cfg.attention_window_pattern is not None:
+        # GPT-Neo-class per-layer windows: the window is STATIC in each
+        # compiled attention call, so the scan steps over PATTERN
+        # PERIODS — the body runs len(pattern) sublayers, each with its
+        # own window, and xs leaves carry a [n_periods, p, ...] leading
+        # shape (the length-divides check lives in __post_init__)
+        p = len(cfg.attention_window_pattern)
+        bodies = [
+            _make_layer_body(cfg, use_rng, pld_theta=pld_theta,
+                             window=cfg.window_for_layer(j))
+            for j in range(p)
+        ]
+
+        def period_body(carry, xs):
+            h, aux = carry, jnp.float32(0.0)
+            for j in range(p):
+                sub = jax.tree.map(lambda t: t[j], xs)
+                h, l_aux = bodies[j](h, sub)
+                aux = aux + l_aux
+            return h, aux
+
+        def seg(x_in, lo, hi, body):  # noqa: F811 — pattern grouping
+            assert lo == 0 and hi == cfg.n_layers
+            group = lambda t: t.reshape(t.shape[0] // p, p, *t.shape[1:])
+            lp = jax.tree.map(group, layers)
+            if pld_theta is not None:
+                xs = (lp, group(layer_rngs),
+                      group(jnp.arange(cfg.n_layers, dtype=jnp.float32)))
+            elif use_rng:
+                xs = (lp, group(layer_rngs))
+            else:
+                xs = lp
+            return jax.lax.scan(period_body, x_in, xs)
 
     if ltd_idx is not None and cfg.random_ltd_layer_range is not None:
         # Random-LTD: layers in [a, b) see only the kept tokens (at their

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.attention import _on_tpu
+
 
 def _merge_partials(out, lse, o_hop, lse_hop):
     """Fold one hop's NORMALIZED partial attention (o, logsumexp) into
@@ -244,12 +246,19 @@ _ring_flash_global.defvjp(lambda q, k, v, mesh, bq, bk:
 def ring_causal_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, mesh=None,
     use_flash: bool = False, block_q: int = 512, block_k: int = 1024,
+    force_kernel: bool = False,
 ) -> jax.Array:
     """SPMD entry: q/k/v [B, S, H|KV, D] sequence-sharded over 'seq';
     runs ring_attention under shard_map with every other axis auto.
     use_flash routes BOTH passes through the Pallas kernels: the
     forward's hop partials merge by logsumexp, and the backward is its
-    own ring (_ring_bwd) wired through a global-level custom_vjp."""
+    own ring (_ring_bwd) wired through a global-level custom_vjp.
+
+    The kernel route engages on TPU only (the same gate
+    causal_attention applies — off-TPU the interpreter would run every
+    hop orders of magnitude slower, and the custom_vjp route needs
+    jit); force_kernel=True overrides for the interpret-mode kernel
+    test lane."""
     if mesh is None:
         mesh = jax.sharding.get_abstract_mesh()
     if mesh is None or mesh.empty or mesh.shape.get("seq", 1) <= 1:
@@ -257,7 +266,7 @@ def ring_causal_attention(
         from ..ops.attention import causal_attention
 
         return causal_attention(q, k, v, use_flash=use_flash)
-    if use_flash:
+    if use_flash and (force_kernel or _on_tpu()):
         return _ring_flash_global(q, k, v, mesh, block_q, block_k)
     from jax.sharding import PartitionSpec as P
 

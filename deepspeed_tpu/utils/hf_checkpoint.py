@@ -20,10 +20,15 @@ inference/engine.py:331-499). Differences driven by the TPU design:
 
 Supported architectures: LlamaForCausalLM, MistralForCausalLM,
 MixtralForCausalLM, GPT2LMHeadModel, OPTForCausalLM,
-FalconForCausalLM (7B multi-query and 40B new-decoder forms),
-PhiForCausalLM, QWenLMHeadModel, Qwen2ForCausalLM — the reference's
-serving families (blogs/deepspeed-fastgen/README.md model table +
-inference/v2/model_implementations/{falcon,opt,phi,qwen,qwen_v2}/).
+FalconForCausalLM (7B multi-query, 40B new-decoder, and alibi rw
+forms), PhiForCausalLM, QWenLMHeadModel, Qwen2ForCausalLM — the
+reference's v2 serving families (blogs/deepspeed-fastgen/README.md
+model table + inference/v2/model_implementations/) — plus the v1
+container families BloomForCausalLM (ALiBi + embedding layernorm),
+GPTNeoXForCausalLM, GPTJForCausalLM (interleaved rotary), and
+GPTNeoForCausalLM (alternating global/local attention layers,
+unscaled attention folded into wq) — ref
+module_inject/containers/{bloom,gptneox,gptj,gptneo}.py.
 
 Weights load one tensor at a time via safetensors.safe_open (single-file
 or index.json-sharded checkpoints), so peak host memory is ~one stacked
@@ -139,6 +144,7 @@ SUPPORTED_ARCHITECTURES = sorted(_LLAMA_FAMILY | {
     "RWForCausalLM",  # falcon's pre-rename arch string
     "PhiForCausalLM", "QWenLMHeadModel",
     "BloomForCausalLM", "GPTNeoXForCausalLM", "GPTJForCausalLM",
+    "GPTNeoForCausalLM",
 })
 
 
@@ -371,6 +377,32 @@ def config_from_hf(hf: Dict[str, Any], **overrides) -> TransformerConfig:
             norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
             tie_embeddings=False,
             lm_head_bias=True,
+        )
+    elif arch == "GPTNeoForCausalLM":
+        # ref: module_inject/containers/gptneo.py — GPT-2 family with
+        # ALTERNATING global/local attention layers (attention_types +
+        # window_size → the per-layer window pattern), unbiased QKV,
+        # biased out/mlp projections, tied head
+        pattern = []
+        for types, repeat in hf["attention_types"]:
+            pattern.extend(list(types) * int(repeat))
+        win = int(hf.get("window_size", 256))
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            n_layers=hf["num_layers"],
+            n_heads=hf["num_heads"],
+            d_model=hf["hidden_size"],
+            d_ff=hf.get("intermediate_size") or 4 * hf["hidden_size"],
+            max_seq=hf.get("max_position_embeddings", 2048),
+            variant="gpt2",
+            qkv_bias=False,
+            attn_out_bias=True,
+            mlp_bias=True,
+            activation="gelu",  # gelu_new (tanh approximation)
+            attention_window_pattern=tuple(
+                0 if t == "global" else win for t in pattern),
+            norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
+            tie_embeddings=True,
         )
     elif arch == "GPT2LMHeadModel":
         kw = dict(
@@ -628,6 +660,34 @@ def _map_headmajor_layer(r: _CheckpointReader, i: int,
     }
 
 
+def _map_gptneo_layer(r: _CheckpointReader, i: int,
+                      cfg: TransformerConfig) -> Dict[str, np.ndarray]:
+    E, H, D = cfg.d_model, cfg.n_heads, cfg.head_dim
+    p = f"transformer.h.{i}."
+    a = p + "attn.attention."
+    # GPT-Neo attends WITHOUT the 1/sqrt(D) score scale (HF
+    # GPTNeoSelfAttention does a raw q·kᵀ). Folding sqrt(D) into wq
+    # makes our scaled attention compute exactly q·kᵀ — every path
+    # (train/flash/paged decode) stays untouched. q_proj has no bias,
+    # so the fold is complete.
+    return {
+        "ln1_scale": r.get(p + "ln_1.weight"),
+        "ln1_bias": r.get(p + "ln_1.bias"),
+        "ln2_scale": r.get(p + "ln_2.weight"),
+        "ln2_bias": r.get(p + "ln_2.bias"),
+        "wq": (r.get(a + "q_proj.weight").T.reshape(E, H, D)
+               * np.float32(np.sqrt(D))),
+        "wk": r.get(a + "k_proj.weight").T.reshape(E, H, D),
+        "wv": r.get(a + "v_proj.weight").T.reshape(E, H, D),
+        "wo": r.get(a + "out_proj.weight").T.reshape(H, D, E),
+        "bo": r.get(a + "out_proj.bias"),
+        "w_in": r.get(p + "mlp.c_fc.weight").T,
+        "b_in": r.get(p + "mlp.c_fc.bias"),
+        "w_out": r.get(p + "mlp.c_proj.weight").T,
+        "b_out": r.get(p + "mlp.c_proj.bias"),
+    }
+
+
 def _map_gptj_layer(r: _CheckpointReader, i: int,
                     cfg: TransformerConfig) -> Dict[str, np.ndarray]:
     E, H, D = cfg.d_model, cfg.n_heads, cfg.head_dim
@@ -770,6 +830,14 @@ def import_external(
             params["lm_head"] = cast(r.get("embed_out.weight").T)
         layer_fn = lambda i: _map_headmajor_layer(
             r, i, cfg, "gpt_neox.layers.", "attention.")
+    elif arch == "GPTNeoForCausalLM":
+        params = {
+            "embed": cast(r.get("transformer.wte.weight")),
+            "pos_embed": cast(r.get("transformer.wpe.weight")),
+            "ln_f_scale": cast(r.get("transformer.ln_f.weight")),
+            "ln_f_bias": cast(r.get("transformer.ln_f.bias")),
+        }
+        layer_fn = lambda i: _map_gptneo_layer(r, i, cfg)
     elif arch == "GPTJForCausalLM":
         params = {
             "embed": cast(r.get("transformer.wte.weight")),

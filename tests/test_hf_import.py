@@ -533,6 +533,54 @@ class TestFamilyZoo:
                                    rtol=1e-5, atol=1e-5)
 
 
+
+    def test_gpt_neo(self, rng, tmp_path):
+        """GPT-Neo: ALTERNATING global/local attention layers — the
+        per-layer window pattern (attention_window_pattern) must
+        reproduce HF's local attention exactly, train AND serve.
+        ref: module_inject/containers/gptneo.py."""
+        torch.manual_seed(31)
+        hf_cfg = transformers.GPTNeoConfig(
+            vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+            attention_types=[[["global", "local"], 1]], window_size=8,
+            max_position_embeddings=64, tie_word_embeddings=True)
+        m = transformers.GPTNeoForCausalLM(hf_cfg).eval()
+        path = _save(m, tmp_path)
+        # prompt LONGER than the window so the local mask actually cuts
+        cfg, params = import_external(path, use_flash=False)
+        assert cfg.attention_window_pattern == (0, 8)
+        toks = list(rng.integers(0, 120, 21))
+        ref = _torch_logits(m, toks)
+        with jax.default_matmul_precision("highest"):
+            got = np.asarray(T.forward(params, jnp.asarray([toks]), cfg)[0])
+        np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+        self._serve(path, rng, m)
+
+    def test_gpt_neo_decode_crosses_window(self, rng, tmp_path):
+        """Greedy decode past the local window: the paged decode path's
+        per-layer window masking must keep matching HF."""
+        torch.manual_seed(32)
+        hf_cfg = transformers.GPTNeoConfig(
+            vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+            attention_types=[[["global", "local"], 1]], window_size=8,
+            max_position_embeddings=64, tie_word_embeddings=True)
+        m = transformers.GPTNeoForCausalLM(hf_cfg).eval()
+        path = _save(m, tmp_path)
+        eng = init_inference_from_hf(
+            path, dict(max_seq_len=32, kv_block_size=8, num_kv_blocks=16,
+                       min_prefill_bucket=8, max_batch_size=4),
+            dtype=jnp.float32, use_flash=False)
+        toks = list(rng.integers(0, 120, 12))
+        lg = eng.put([0], [np.asarray(toks, np.int32)])
+        ctx = list(toks)
+        for _ in range(4):
+            tok = int(np.argmax(lg[0]))
+            ctx.append(tok)
+            ref = _torch_logits(m, ctx)[-1]
+            lg = eng.put([0], [np.asarray([tok], np.int32)])
+            np.testing.assert_allclose(lg[0], ref, rtol=2e-3, atol=2e-3)
+
+
 class TestImportDetails:
     def test_bf16_checkpoint_preserved(self, tmp_path):
         torch.manual_seed(8)

@@ -388,6 +388,7 @@ class TestRingFlashHops:
             with jax.default_matmul_precision("highest"):
                 got = jax.jit(lambda a, b, c: ring_causal_attention(
                     a, b, c, use_flash=True, block_q=64, block_k=64,
+                    force_kernel=True,
                 ))(qs, ksh, vs)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=3e-4, atol=3e-4)
@@ -414,7 +415,8 @@ class TestRingFlashHops:
                 # shard_map cannot execute the custom_vjp route)
                 gfl = jax.jit(jax.grad(lambda a, b, c: jnp.sum(
                     ring_causal_attention(a, b, c, use_flash=True,
-                                          block_q=64, block_k=64) * do),
+                                          block_q=64, block_k=64,
+                                          force_kernel=True) * do),
                     argnums=(0, 1, 2)))(qs, ksh, vs)
                 gdn = jax.jit(jax.grad(lambda a, b, c: jnp.sum(
                     ring_causal_attention(a, b, c) * do),
@@ -422,3 +424,55 @@ class TestRingFlashHops:
         for a, b in zip(gfl, gdn):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=3e-3, atol=3e-3)
+
+
+class TestWindowPattern:
+    """Per-layer attention windows (GPT-Neo class,
+    attention_window_pattern): the scan groups layers by pattern
+    period; training must run the distinct static windows per
+    sublayer."""
+
+    def test_pattern_forward_matches_manual(self):
+        cfg = T.TransformerConfig(
+            vocab_size=64, n_layers=4, n_heads=2, d_model=32, max_seq=64,
+            variant="gpt2", use_flash=False,
+            attention_window_pattern=(0, 8))
+        assert [cfg.window_for_layer(i) for i in range(4)] == [0, 8, 0, 8]
+        params = T.init(cfg, jax.random.PRNGKey(0))
+        toks = jnp.asarray(
+            np.random.default_rng(0).integers(0, 64, (2, 33)), jnp.int32)
+        out = T.forward(params, toks, cfg)
+        assert np.isfinite(np.asarray(out)).all()
+        # a uniform-window config must NOT equal the pattern (the local
+        # layers actually cut context)
+        cfg_g = T.TransformerConfig(
+            vocab_size=64, n_layers=4, n_heads=2, d_model=32, max_seq=64,
+            variant="gpt2", use_flash=False)
+        out_g = T.forward(params, toks, cfg_g)
+        assert not np.allclose(np.asarray(out), np.asarray(out_g))
+
+    def test_pattern_model_trains(self):
+        cfg = T.TransformerConfig(
+            vocab_size=64, n_layers=4, n_heads=2, d_model=32, max_seq=64,
+            variant="gpt2", use_flash=False,
+            attention_window_pattern=(0, 8))
+        engine = ds.initialize(
+            {"train_micro_batch_size_per_gpu": 2,
+             "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+             "steps_per_print": 10**9},
+            loss_fn=T.make_loss_fn(cfg),
+            param_init_fn=lambda k: T.init(cfg, k),
+            param_logical_specs=T.logical_specs(cfg))
+        r = np.random.default_rng(0)
+        batch = {"tokens": r.integers(
+            0, 64, (engine.config.train_batch_size, 33)).astype(np.int32)}
+        losses = [float(engine.train_batch(batch)["loss"]) for _ in range(6)]
+        assert losses[-1] < losses[0], losses
+
+    def test_pattern_validation(self):
+        with pytest.raises(ValueError, match="divide"):
+            T.TransformerConfig(n_layers=3,
+                                attention_window_pattern=(0, 8))
+        with pytest.raises(ValueError, match="ulysses"):
+            T.TransformerConfig(n_layers=4, attention_impl="ring",
+                                attention_window_pattern=(0, 8))

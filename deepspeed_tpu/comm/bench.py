@@ -18,10 +18,15 @@ benchmarks/communication/utils.py): for ring algorithms the wire moves
   all_to_all:                  busbw = algbw * (n-1)/n
   ppermute (pt2pt ring):       busbw = algbw
 
-Timing through the axon tunnel follows scripts/tpu_timing.py's measured
-fact: only a host readback synchronizes, so each trial dispatches the
-jitted op n times then reads one element back, subtracting the measured
-round trip. On a pod (multi-controller), run this module on every host
+Timing: each trial is one dispatch synchronized with
+`jax.block_until_ready` on the result, and the reported time is the
+MEDIAN over trials. The tunnel round trip is measured once and emitted
+as a separate `rtt_us` field per record (auditable) rather than
+subtracted from the timings — the old pipelined-dispatch-minus-one-rtt
+calibration under-corrected: a single tiny-add round trip does not
+model the readback of a multi-MB collective result, and the subtraction
+landed inside the per-trial average where one outlier skewed every
+number. On a pod (multi-controller), run this module on every host
 via the pod launcher:
 
   python -m deepspeed_tpu.launcher.pod --tpu my-slice --zone us-... \
@@ -135,20 +140,20 @@ def sweep(
             sharding = NamedSharding(mesh, P(axis))
             x = jax.device_put(
                 jnp.ones(shape, dtype), sharding)
-            y = fn(x)  # compile + warm
-            _readback(y)
-            t0 = time.perf_counter()
+            jax.block_until_ready(fn(x))  # compile + warm
+            times = []
             for _ in range(trials):
-                y = fn(x)
-            _readback(y)
-            dt = max((time.perf_counter() - t0 - rtt) / trials, 1e-9)
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(x))
+                times.append(time.perf_counter() - t0)
+            dt = max(float(np.median(times)), 1e-9)
             per_dev_bytes = (np.prod(shape) // n) * jnp.dtype(dtype).itemsize
             algbw = per_dev_bytes / dt / 1e9
             busbw = algbw * _busbw_factor(op, n)
             out.append({
                 "op": op, "bytes_per_device": int(per_dev_bytes),
-                "time_us": dt * 1e6, "algbw_GBps": algbw,
-                "busbw_GBps": busbw,
+                "time_us": dt * 1e6, "rtt_us": rtt * 1e6,
+                "algbw_GBps": algbw, "busbw_GBps": busbw,
                 "vs_ici_assumption": busbw / ici_assumption_gbps,
                 "devices": int(n),
             })

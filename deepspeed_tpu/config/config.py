@@ -213,6 +213,20 @@ class MonitorConfig(ConfigModel):
     wandb: Dict[str, Any] = Field(default_factory=dict)
 
 
+class PrefixCacheConfig(ConfigModel):
+    """Automatic prefix caching for the ragged inference engine
+    (inference/ragged.py): content-addressed reuse of full KV blocks
+    across sequences sharing a prompt prefix, vLLM-PagedAttention style.
+
+    pool_blocks caps the LRU pool of retired-but-cached blocks
+    (refcount 0, contents kept for future hits): -1 keeps every retired
+    cached block until allocation pressure evicts it; 0 disables
+    parking (blocks shared only while a live sequence holds them)."""
+
+    enabled: bool = True
+    pool_blocks: int = -1
+
+
 class CurriculumConfig(ConfigModel):
     """ref: runtime/data_pipeline/curriculum_scheduler.py config (the
     legacy 'curriculum_learning' block). Consumed by the engine: with

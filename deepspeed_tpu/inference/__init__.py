@@ -4,7 +4,12 @@ from .engine import (
     init_inference,
     init_inference_from_hf,
 )
-from .ragged import BlockedAllocator, SequenceDescriptor, StateManager
+from .ragged import (
+    BlockedAllocator,
+    PrefixMatch,
+    SequenceDescriptor,
+    StateManager,
+)
 
 __all__ = [
     "InferenceConfig",
@@ -12,6 +17,7 @@ __all__ = [
     "init_inference",
     "init_inference_from_hf",
     "BlockedAllocator",
+    "PrefixMatch",
     "SequenceDescriptor",
     "StateManager",
 ]

@@ -29,7 +29,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..config.config import ConfigModel
+from pydantic import Field
+
+from ..config.config import ConfigModel, PrefixCacheConfig
 from ..models import transformer as T
 from ..utils.logging import log_dist
 from . import model as M
@@ -52,6 +54,9 @@ class InferenceConfig(ConfigModel):
     num_kv_blocks: int = 512          # total paged-cache blocks
     min_prefill_bucket: int = 64
     tp_size: int = 1                  # tensor-parallel degree
+    # automatic prefix caching (config/config.py PrefixCacheConfig):
+    # hash-matched block reuse + COW tails in the ragged control plane
+    prefix_cache: PrefixCacheConfig = Field(default_factory=PrefixCacheConfig)
 
     @property
     def blocks_per_seq(self) -> int:
@@ -305,7 +310,10 @@ class InferenceEngine:
             num_blocks=self.config.num_kv_blocks,
             block_size=self.config.kv_block_size,
             max_tracked=self.config.max_tracked_sequences,
+            enable_prefix_cache=self.config.prefix_cache.enabled,
+            cache_pool_blocks=self.config.prefix_cache.pool_blocks,
         )
+        self._cow_fn = None  # compiled (cache, src, dst) -> cache page copy
         # one RESERVED scratch block past the allocator's range: fused
         # write+attend RMWs every decode row's newest block, so padding
         # rows need a target that can never alias a live sequence
@@ -663,8 +671,30 @@ class InferenceEngine:
             return jnp.asarray(x)
         return jax.device_put(jnp.asarray(x), NamedSharding(self.mesh, P()))
 
+    def _copy_block(self, src: int, dst: int) -> None:
+        """Host-issued cache-page copy (the COW half of prefix caching):
+        clone block src's K/V rows into block dst across every layer, in
+        ONE compiled program reused for all copies (src/dst are traced
+        scalars, so the first copy pays the only compile)."""
+        if self._cow_fn is None:
+            def cp(cache, s, d):
+                return M.PagedCache(
+                    k=[ck.at[d].set(ck[s]) for ck in cache.k],
+                    v=[cv.at[d].set(cv[s]) for cv in cache.v],
+                )
+
+            self._cow_fn = jax.jit(cp, donate_argnums=(0,))
+        self.cache = self._cow_fn(self.cache, jnp.int32(src),
+                                  jnp.int32(dst))
+
+    def prefix_cache_stats(self) -> Dict[str, float]:
+        """Per-engine prefix-cache counters: lookup hits/misses,
+        cached-token ratio, LRU evictions, COW copies (ragged.py
+        StateManager.cache_stats)."""
+        return self.state.cache_stats()
+
     # -- scheduling queries (ref: engine_v2.py query:158/can_schedule:184)
-    def query(self, uid: int) -> Dict[str, int]:
+    def query(self, uid: int) -> Dict[str, Any]:
         seq = self.state.get(uid)
         seen = seq.seen_tokens if seq else 0
         cached_cap = (len(seq.blocks) * self.state.block_size - seen) if seq else 0
@@ -675,6 +705,7 @@ class InferenceEngine:
                 cached_cap + self.state.free_blocks * self.state.block_size,
                 self.config.max_seq_len - seen,
             ),
+            "prefix_cache": self.state.cache_stats(),
         }
 
     def can_schedule(self, uids: Iterable[int], lengths: Iterable[int]) -> bool:
@@ -840,6 +871,30 @@ class InferenceEngine:
                     else:
                         rejected.append(uid)
                 prefills = admitted
+        if prefills and self.state.enable_prefix_cache:
+            # prefix-cache admission: a prompt whose leading full blocks
+            # match the content-addressed index SHARES those blocks and
+            # prefills only the suffix — routed through the chunked-
+            # continuation decode path (it already handles arbitrary
+            # start positions against the paged cache), bounded by the
+            # decode-row budget. Capacity was checked above WITHOUT
+            # cache credit, so a degraded match always still fits.
+            missed: List[Tuple[int, int, np.ndarray]] = []
+            for pos, uid, toks in prefills:
+                budget = self.config.max_batch_size - n_rows
+                _, match = self.state.extend(
+                    uid, len(toks), token_ids=toks, max_suffix_rows=budget)
+                if match.n_cached > 0:
+                    if match.cow is not None:
+                        # shared full-match tail: clone the page before
+                        # the recomputed last token writes into it
+                        self._copy_block(*match.cow)
+                    suffix = toks[match.n_cached:]
+                    decodes.append((pos, uid, suffix))
+                    n_rows += len(suffix)
+                else:
+                    missed.append((pos, uid, toks))
+            prefills = missed
         if prefills:
             # prompts run as compiled WAVES (a solo prompt is a bp=1
             # wave — one code path, one compile cache), bucketed in both
@@ -880,7 +935,7 @@ class InferenceEngine:
                     self._dev(n_real), self._dev(tables),
                 )
                 for row, (pos, uid, toks) in enumerate(wave):
-                    self.state.commit(uid, len(toks))
+                    self.state.commit(uid, len(toks), token_ids=toks)
                 if return_tokens:
                     sample_rows(
                         logits,
@@ -923,7 +978,7 @@ class InferenceEngine:
                 self._dev(tables), self._dev(ctx),
             )
             for (pos, uid, chunk), lr in zip(decodes, last_row):
-                self.state.commit(uid, len(chunk))
+                self.state.commit(uid, len(chunk), token_ids=chunk)
             if return_tokens:
                 sample_rows(
                     logits,
@@ -1002,8 +1057,8 @@ class InferenceEngine:
     def generate_speculative(
         self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 32,
         eos_token_id: Optional[int] = None, ngram: int = 3,
-        draft_len: int = 4,
-    ) -> List[List[int]]:
+        draft_len: int = 4, return_stats: bool = False,
+    ) -> Any:
         """Greedy generation with prompt-lookup self-speculation.
 
         Each step feeds [committed_next, draft_1..draft_k] through ONE
@@ -1017,7 +1072,15 @@ class InferenceEngine:
         (worst case accepts 1 token/step = standard decode).
         ref: the reference ecosystem's prompt-lookup/self-speculative
         decoding (MII generation path); arXiv 2304.04487-class
-        draft-and-verify with the sequence as its own draft model."""
+        draft-and-verify with the sequence as its own draft model.
+
+        return_stats=True additionally returns a dict of per-run
+        counters: steps, draft/accepted token totals, mean accepted
+        length, and draft_collapsed_steps — steps where the shared
+        verify-row budget (max_batch_size // n_live) forced per_seq=1
+        so k=0 and speculation degenerated to one-token decode. The
+        first such step also logs a warning, so a silently-serial
+        "speculative" run is visible to callers."""
         if len(prompts) > self.config.max_batch_size:
             raise ValueError(
                 f"{len(prompts)} prompts > max_batch_size "
@@ -1036,12 +1099,30 @@ class InferenceEngine:
             nxt = [int(np.argmax(l)) for l in logits]
             outs: List[List[int]] = [[] for _ in prompts]
             live = [max_new_tokens > 0] * len(prompts)
+            stats = {"steps": 0, "verified_chunks": 0, "draft_tokens": 0,
+                     "accepted_tokens": 0, "draft_collapsed_steps": 0,
+                     "mean_accepted": 0.0}
             while any(live):
                 lu, lc = [], []
                 # drafts share the verify batch: split the row budget
                 # across live sequences (each needs >= 1 committed row)
                 n_live = sum(live)
                 per_seq = max(1, self.config.max_batch_size // n_live)
+                if per_seq == 1 and draft_len > 0:
+                    # budget collapse: every row is a committed token,
+                    # k=0 — "speculative" decode degenerates to plain
+                    # one-token decode. Log once, count every step.
+                    if stats["draft_collapsed_steps"] == 0:
+                        log_dist(
+                            "generate_speculative: max_batch_size "
+                            f"{self.config.max_batch_size} // {n_live} "
+                            "live sequences leaves no draft rows "
+                            "(per_seq=1, k=0); speculation is running "
+                            "as plain decode — raise max_batch_size or "
+                            "lower concurrency",
+                            ranks=[0],
+                        )
+                    stats["draft_collapsed_steps"] += 1
                 for i, uid in enumerate(uids):
                     if not live[i]:
                         continue
@@ -1061,6 +1142,9 @@ class InferenceEngine:
                         [nxt[i]] + draft[:max(0, room - 1)], np.int32))
                 if not lu:
                     break
+                stats["steps"] += 1
+                stats["verified_chunks"] += len(lc)
+                stats["draft_tokens"] += sum(len(c) - 1 for c in lc)
                 all_logits = self._verify_chunks([uids[i] for i in lu], lc)
                 for i, chunk, lg in zip(lu, lc, all_logits):
                     # row j predicts the token AFTER chunk[:j+1]; accept
@@ -1070,7 +1154,10 @@ class InferenceEngine:
                            and int(np.argmax(lg[accepted - 1]))
                            == int(chunk[accepted])):
                         accepted += 1
-                    self.state.commit(uids[i], accepted)
+                    stats["accepted_tokens"] += accepted
+                    self.state.commit(uids[i], accepted,
+                                      token_ids=[int(t)
+                                                 for t in chunk[:accepted]])
                     new = [int(t) for t in chunk[:accepted]]
                     outs[i].extend(new)
                     hist[i].extend(new)
@@ -1085,6 +1172,11 @@ class InferenceEngine:
             for uid in uids:
                 if self.state.get(uid) is not None:
                     self.flush(uid)
+        if return_stats:
+            stats["mean_accepted"] = (
+                stats["accepted_tokens"] / stats["verified_chunks"]
+                if stats["verified_chunks"] else 0.0)
+            return outs, stats
         return outs
 
     # -- sampling (v1 generate inherits full HF sampling; here the same

@@ -26,6 +26,7 @@ next prefetch round would race that transfer.
 
 import os
 import shutil
+import threading
 import uuid
 from typing import Any, Dict, List, Optional
 
@@ -51,8 +52,14 @@ class NvmeLayerStore:
         self._manifest: List[Optional[List[tuple]]] = [None] * n_layers
         self._treedef = None
         self._spec_tree: List[Any] = [None] * n_layers
-        # layer -> list of (ticket, buf) for in-flight prefetch reads
+        # layer -> list of (ticket, buf) for in-flight prefetch reads.
+        # io_callback threads arrive UNORDERED (XLA may run several
+        # compiled programs' callbacks concurrently), so every
+        # check-then-insert on this dict is guarded by _lock — an
+        # unguarded double _submit would leak an unawaited aio ticket
+        # and race two reads into one buffer.
         self._inflight: Dict[int, List[tuple]] = {}
+        self._lock = threading.Lock()
         import atexit
         import functools
 
@@ -69,16 +76,21 @@ class NvmeLayerStore:
         space — the engine calls this when a params refresh replaces
         the store (a long-lived server cycling models must not leak a
         model copy per refresh)."""
-        if self._closed:
-            return
-        self._closed = True
-        for pairs in self._inflight.values():
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            drained = list(self._inflight.values())
+            self._inflight.clear()
+            aio = self.aio
+        # wait OUTSIDE the lock: a concurrent read_layer may hold its
+        # own popped tickets and must not deadlock against the drain
+        for pairs in drained:
             for t, _ in pairs:
                 try:
-                    self.aio.wait(t)
+                    aio.wait(t)
                 except Exception:
                     pass
-        self._inflight.clear()
         self.aio = None
         shutil.rmtree(self.dir, ignore_errors=True)
         import atexit
@@ -133,8 +145,11 @@ class NvmeLayerStore:
         return self._spec_tree[l]
 
     # -- serving reads --------------------------------------------------
-    def _submit(self, l: int) -> None:
-        if l in self._inflight:
+    def _submit_locked(self, l: int) -> None:
+        """Caller holds _lock. Idempotent per layer: the in-flight map
+        is the dedup, so two callback threads can never double-submit a
+        layer (which would leak the first submission's tickets)."""
+        if self._closed or l in self._inflight:
             return
         pairs = []
         for _, f, shape, dtype in self._manifest[l]:
@@ -142,18 +157,30 @@ class NvmeLayerStore:
             pairs.append((self.aio.async_pread(buf, f), buf))
         self._inflight[l] = pairs
 
+    def _submit(self, l: int) -> None:
+        with self._lock:
+            self._submit_locked(l)
+
     def read_layer(self, l: int) -> Any:
         """Blocking read of layer l (waits on its prefetch if in flight),
         then submits prefetch for the next read_ahead layers — called
         from the step's io_callback, so the wait overlaps the PREVIOUS
-        layer's device compute."""
-        self._submit(l)
-        pairs = self._inflight.pop(l)
+        layer's device compute. Thread-safe: unordered io_callback
+        threads take the lock only for in-flight-map mutation; aio waits
+        happen outside it."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("NvmeLayerStore is closed")
+            self._submit_locked(l)
+            pairs = self._inflight.pop(l)
+            aio = self.aio
         for t, _ in pairs:
-            self.aio.wait(t)
+            aio.wait(t)
         # decode walks layers cyclically (every step re-streams the
         # model): prefetch wraps around
-        for d in range(1, self.read_ahead + 1):
-            self._submit((l + d) % self.n_layers)
+        with self._lock:
+            if not self._closed:
+                for d in range(1, self.read_ahead + 1):
+                    self._submit_locked((l + d) % self.n_layers)
         return jax.tree_util.tree_unflatten(self._treedef,
                                             [b for _, b in pairs])

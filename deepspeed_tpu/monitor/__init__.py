@@ -1,1 +1,6 @@
-from .monitor import CsvMonitor, MonitorMaster, TensorBoardMonitor
+from .monitor import (
+    CsvMonitor,
+    MonitorMaster,
+    TensorBoardMonitor,
+    inference_cache_events,
+)

@@ -88,6 +88,20 @@ class WandbMonitor(Monitor):
             self._wandb.log({name: value}, step=step)
 
 
+def inference_cache_events(engine, step: int,
+                           prefix: str = "inference/prefix_cache") -> List[Event]:
+    """Turn an InferenceEngine's prefix-cache counters into monitor
+    events (one per counter, same contract as every other sink feed):
+
+        monitor.write_events(inference_cache_events(engine, step))
+
+    Emits lookup hits/misses, cached-token ratio, evictions, COW
+    copies, and pool occupancy under `prefix`/<name>."""
+    stats = engine.prefix_cache_stats()
+    return [(f"{prefix}/{name}", float(value), step)
+            for name, value in sorted(stats.items())]
+
+
 class MonitorMaster(Monitor):
     """Fan-out to all configured sinks (ref: monitor/monitor.py:29)."""
 

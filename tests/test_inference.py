@@ -1506,3 +1506,164 @@ class TestSpeculativeDecoding:
         got = b.generate_speculative(prompts, max_new_tokens=8,
                                      ngram=2, draft_len=3)
         assert got == want
+
+
+class TestPrefixCacheEngine:
+    """Automatic prefix caching end-to-end (the tentpole acceptance
+    contract): a second put() of a prompt sharing a >= 1-block prefix
+    prefills only the non-cached suffix — asserted via the hit/miss
+    counters — and produces logits IDENTICAL to a cache-off engine."""
+
+    def _pair(self, cfg, params, **ckw):
+        on = engine_for(cfg, params, **ckw)
+        off = engine_for(cfg, params,
+                         prefix_cache={"enabled": False}, **ckw)
+        assert on.state.enable_prefix_cache
+        assert not off.state.enable_prefix_cache
+        return on, off
+
+    def test_shared_prefix_skips_prefill_same_logits(self, rng):
+        cfg, params = small_model()
+        on, off = self._pair(cfg, params)
+        prefix = list(rng.integers(0, 128, 16))  # 2 full blocks
+        a = np.asarray(prefix + list(rng.integers(0, 128, 5)), np.int32)
+        b = np.asarray(prefix + list(rng.integers(0, 128, 3)), np.int32)
+        l_on = on.put([0], [a.copy()])
+        l_off = off.put([0], [a.copy()])
+        np.testing.assert_allclose(l_on, l_off, rtol=1e-5, atol=1e-5)
+        st = on.prefix_cache_stats()
+        assert st["lookup_hits"] == 0 and st["lookup_misses"] == 1
+        l_on = on.put([1], [b.copy()])
+        l_off = off.put([1], [b.copy()])
+        st = on.prefix_cache_stats()
+        # the hit covered the shared 2-block prefix; only the 3-token
+        # suffix ran a forward
+        assert st["lookup_hits"] == 1 and st["cached_tokens"] == 16
+        np.testing.assert_allclose(l_on, l_off, rtol=1e-5, atol=1e-5)
+        # shared blocks are physically the same pages
+        assert on.state.get(1).blocks[:2] == on.state.get(0).blocks[:2]
+        assert off.state.get(1).blocks[0] != off.state.get(0).blocks[0]
+
+    def test_identical_prompt_cows_and_decodes_divergent(self, rng):
+        """Exact-multiple identical prompt: the full chain matches, the
+        tail goes copy-on-write, and DIVERGENT continuations of the two
+        sequences match a cache-off engine step for step (the COW page
+        kept the owner's tail intact)."""
+        cfg, params = small_model()
+        on, off = self._pair(cfg, params)
+        p = list(rng.integers(0, 128, 16))  # exactly 2 blocks
+        arr = np.asarray(p, np.int32)
+        l0 = on.put([0], [arr.copy()])
+        l1 = on.put([1], [arr.copy()])
+        st = on.prefix_cache_stats()
+        assert st["cow_copies"] == 1 and st["cached_tokens"] == 15
+        np.testing.assert_allclose(l1, l0, rtol=1e-4, atol=1e-4)
+        r0 = off.put([0], [arr.copy()])
+        r1 = off.put([1], [arr.copy()])
+        np.testing.assert_allclose(l0, r0, rtol=1e-5, atol=1e-5)
+        # the COW'd sequence shares block 0 but owns a private tail
+        assert on.state.get(1).blocks[0] == on.state.get(0).blocks[0]
+        assert on.state.get(1).blocks[1] != on.state.get(0).blocks[1]
+        t0 = int(np.argmax(l0[0]))
+        t1 = (t0 + 7) % 128  # force divergence
+        toks = [np.asarray([t0]), np.asarray([t1])]
+        d = on.put([0, 1], [t.copy() for t in toks])
+        r = off.put([0, 1], [t.copy() for t in toks])
+        np.testing.assert_allclose(d, r, rtol=1e-4, atol=1e-4)
+        # another round: sequences keep diverging without cross-talk
+        n0, n1 = int(np.argmax(d[0])), int(np.argmax(d[1]))
+        toks = [np.asarray([n0]), np.asarray([n1])]
+        d2 = on.put([0, 1], [t.copy() for t in toks])
+        r2 = off.put([0, 1], [t.copy() for t in toks])
+        np.testing.assert_allclose(d2, r2, rtol=1e-4, atol=1e-4)
+
+    def test_flush_of_sharing_sequence_never_double_frees(self, rng):
+        cfg, params = small_model()
+        on, off = self._pair(cfg, params)
+        prefix = list(rng.integers(0, 128, 8))
+        a = np.asarray(prefix + [3, 4, 5], np.int32)
+        b = np.asarray(prefix + [6, 7], np.int32)
+        on.put([0], [a.copy()]); on.put([1], [b.copy()])
+        off.put([0], [a.copy()]); off.put([1], [b.copy()])
+        shared = on.state.get(0).blocks[0]
+        assert on.state.allocator.refcount(shared) == 2
+        on.flush(1); off.flush(1)
+        assert on.state.allocator.refcount(shared) == 1
+        # the survivor keeps decoding correctly on the shared page
+        l = on.put([0], [np.asarray([9], np.int32)])
+        r = off.put([0], [np.asarray([9], np.int32)])
+        np.testing.assert_allclose(l, r, rtol=1e-4, atol=1e-4)
+        on.flush(0)
+        assert on.state.free_blocks == on.config.num_kv_blocks
+        with pytest.raises(KeyError):
+            on.flush(0)
+
+    def test_lru_eviction_under_pressure_stays_correct(self, rng):
+        """A tiny pool: parked prefix blocks are evicted by fresh
+        allocations, counters record it, and logits stay exact."""
+        cfg, params = small_model()
+        eng = engine_for(cfg, params, num_kv_blocks=4, max_seq_len=32)
+        p1 = list(rng.integers(0, 128, 14))
+        eng.put([0], [np.asarray(p1, np.int32)])
+        eng.flush(0)  # 1 full block parks
+        assert eng.state.allocator.cached_blocks == 1
+        p2 = list(rng.integers(0, 128, 30))  # 4 blocks: evicts the pool
+        l = eng.put([1], [np.asarray(p2, np.int32)])
+        assert eng.state.allocator.evictions >= 1
+        ref = engine_for(cfg, params, num_kv_blocks=4, max_seq_len=32,
+                         prefix_cache={"enabled": False})
+        r = ref.put([1], [np.asarray(p2, np.int32)])
+        np.testing.assert_allclose(l, r, rtol=1e-4, atol=1e-4)
+        eng.flush(1)
+        # the evicted chain is gone: re-putting p1 misses
+        misses0 = eng.prefix_cache_stats()["lookup_misses"]
+        eng.put([2], [np.asarray(p1, np.int32)])
+        assert eng.prefix_cache_stats()["lookup_misses"] == misses0 + 1
+
+    def test_can_schedule_counts_parked_blocks(self, rng):
+        cfg, params = small_model()
+        eng = engine_for(cfg, params, num_kv_blocks=4, max_seq_len=32)
+        eng.put([0], [np.asarray(rng.integers(0, 128, 30), np.int32)])
+        assert not eng.can_schedule([1], [20])
+        eng.flush(0)  # 3 full blocks park + 1 frees
+        assert eng.state.allocator.free_blocks < 4
+        assert eng.query(1)["free_blocks"] == 4
+        assert eng.can_schedule([1], [30])  # parked pool is capacity
+        l = eng.put([1], [np.asarray(rng.integers(0, 128, 20), np.int32)])
+        assert l.shape[0] == 1
+
+    def test_generate_after_shared_prefill_matches_cache_off(self, rng):
+        """generate() rides put() for its prefill, so prompts sharing a
+        prefix with an earlier request reuse blocks mid-generation."""
+        cfg, params = small_model()
+        on, off = self._pair(cfg, params)
+        prefix = list(rng.integers(0, 128, 8))
+        on.put([0], [np.asarray(prefix + [1, 2], np.int32)])
+        off.put([0], [np.asarray(prefix + [1, 2], np.int32)])
+        prompts = [prefix + [9], prefix + [11, 12]]
+        got_on = on.generate(prompts, max_new_tokens=4)
+        got_off = off.generate(prompts, max_new_tokens=4)
+        assert got_on == got_off
+        assert on.prefix_cache_stats()["lookup_hits"] >= 2
+
+    def test_speculative_stats_report_draft_collapse(self, rng):
+        cfg, params = small_model()
+        eng = engine_for(cfg, params, max_batch_size=2)
+        base = list(rng.integers(0, 128, 4))
+        prompts = [(base * 4)[:14], (base * 4)[:12]]
+        # 2 live sequences / max_batch 2 -> per_seq=1, k=0 every step
+        outs, stats = eng.generate_speculative(
+            prompts, max_new_tokens=5, ngram=2, draft_len=4,
+            return_stats=True)
+        assert all(len(o) == 5 for o in outs)
+        assert stats["draft_collapsed_steps"] == stats["steps"] > 0
+        assert stats["draft_tokens"] == 0
+        assert stats["mean_accepted"] == 1.0
+        # plenty of room: no collapse, drafts actually fly
+        eng2 = engine_for(cfg, params)
+        outs2, stats2 = eng2.generate_speculative(
+            [prompts[0]], max_new_tokens=8, ngram=2, draft_len=4,
+            return_stats=True)
+        assert stats2["draft_collapsed_steps"] == 0
+        assert stats2["draft_tokens"] > 0
+        assert outs2[0] == eng2.generate([prompts[0]], max_new_tokens=8)[0]

@@ -1,0 +1,34 @@
+"""Static analysis for compiled TPU programs and the codebase itself.
+
+Two prongs (see docs/static_analysis.md):
+
+  sanitizer — ground-truth checks on compiled/lowered artifacts:
+              donation aliasing (S001), PartitionSpec survival (S002),
+              recompilation-hazard classification (S003). Run against a
+              live engine with `engine.sanitize(batch)`.
+  lint      — `ds-lint`, an AST pass with project rules R001-R004
+              (`python scripts/ds_lint.py --strict`).
+"""
+
+from .report import Finding, LintReport, SanitizerReport, merge_reports
+from .sanitizer import (
+    RecompileTracker,
+    abstract_signature,
+    check_donation,
+    check_sharding,
+)
+from .lint import lint_paths, lint_source, RULES
+
+__all__ = [
+    "Finding",
+    "LintReport",
+    "SanitizerReport",
+    "merge_reports",
+    "RecompileTracker",
+    "abstract_signature",
+    "check_donation",
+    "check_sharding",
+    "lint_paths",
+    "lint_source",
+    "RULES",
+]

@@ -1,0 +1,491 @@
+"""ds-lint: project-specific AST rules for TPU-hostile patterns.
+
+The generic linters cannot know that `float(loss)` inside a jitted body
+is a trace-time error-or-sync, that `jax.device_get` inside the decode
+loop serializes the pipeline, or that a dict on `self` mutated from an
+`io_callback` thread needs a lock (the exact `NvmeLayerStore._inflight`
+race PR 1 fixed). These rules do.
+
+Rules
+  R001  no `float()`/`int()`/`bool()`/`np.asarray`/`np.array` applied to
+        traced values inside jit-compiled bodies (forces a trace-time
+        concretization error or, via __array__, a silent host sync)
+  R002  no `jax.block_until_ready`/`jax.device_get` inside engine
+        step/decode hot paths (runtime/engine.py, inference/engine.py);
+        end-of-run syncs route through the named helper
+        `deepspeed_tpu.utils.sync.host_sync`, the single allowlisted
+        choke point
+  R003  a shared mutable dict/list on `self`, in a class that touches
+        `io_callback`/threads, mutated outside a `with <lock>:` block
+        (methods named `*_locked` are lock-held by convention)
+  R004  `jax.jit(..., donate_argnums=...)` with no nearby comment
+        explaining the aliasing story and no sanitizer check call
+
+Pragma: `# ds-lint: ok` suppresses every rule on that line (or the line
+below a standalone pragma comment); `# ds-lint: ok R002 <reason>`
+suppresses only the named rule(s). Intentional sites carry the reason in
+the pragma — the allowlist is greppable.
+"""
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Iterable, List, Optional, Sequence, Set, Tuple
+
+from .report import Finding, LintReport
+
+__all__ = ["lint_paths", "lint_source", "LintReport", "RULES"]
+
+RULES = {
+    "R001": "host conversion of traced value inside a jitted body",
+    "R002": "host sync inside an engine step/decode hot path",
+    "R003": "unlocked mutation of shared state in a threaded class",
+    "R004": "donate_argnums without an aliasing note",
+}
+
+_PRAGMA_RE = re.compile(
+    r"#\s*ds-lint:\s*ok\b(?P<rules>[^#\n]*)")
+
+# R002 scope: hot-path files and the function-name shapes of their
+# per-token / per-step loops. A name matches when it equals an entry or
+# starts with `entry` + one of the listed prefixes.
+_HOT_FILES = ("runtime/engine.py", "inference/engine.py",
+              "runtime/hybrid_engine.py")
+_HOT_FN_PREFIXES = (
+    "train_batch", "eval_batch", "_dispatch", "decode", "_decode",
+    "generate", "put", "step", "_sample", "prefill", "_prefill",
+)
+_SYNC_CALLS = ("block_until_ready", "device_get")
+_SYNC_ALLOWED_HELPERS = ("host_sync",)
+
+_HOST_CONVERSIONS = ("float", "int", "bool")
+_NP_CONVERSIONS = ("asarray", "array")
+# attribute reads that are static under tracing — a Name only reached
+# through these is not a traced-value use
+_STATIC_ATTRS = ("shape", "ndim", "dtype", "size", "sharding", "aval",
+                 "itemsize")
+
+_MUTATORS = ("append", "extend", "insert", "remove", "pop", "popitem",
+             "clear", "update", "setdefault", "add", "discard")
+_THREAD_MARKERS = ("io_callback", "pure_callback", "Thread",
+                   "ThreadPoolExecutor", "start_new_thread", "Timer")
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.experimental.io_callback' for an Attribute/Name chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """Does this expression evaluate to a jit transform?"""
+    d = _dotted(node)
+    if d.split(".")[-1] in ("jit", "pjit"):
+        return True
+    # functools.partial(jax.jit, ...)
+    if isinstance(node, ast.Call) and _dotted(node.func).split(".")[-1] == \
+            "partial" and node.args and _is_jit_expr(node.args[0]):
+        return True
+    return False
+
+
+@dataclasses.dataclass
+class _Ctx:
+    relpath: str
+    lines: List[str]
+    findings: List[Finding]
+
+    def emit(self, rule: str, node: ast.AST, message: str, fix_hint: str,
+             severity: str = "error") -> None:
+        self.findings.append(Finding(
+            rule=rule, path=self.relpath, line=getattr(node, "lineno", 0),
+            severity=severity, message=message, fix_hint=fix_hint))
+
+
+# ----------------------------------------------------------------------
+# jit-context discovery
+# ----------------------------------------------------------------------
+
+def _collect_jit_roots(tree: ast.Module) -> Tuple[List[ast.AST], Set[ast.AST]]:
+    """(jit-target function/lambda nodes, host-callback function nodes).
+
+    A function is a jit target when decorated with jit/pjit (directly or
+    through partial), or when its name / the lambda itself is passed to a
+    jit call anywhere in the module. Functions handed to *callback* APIs
+    are host code even when textually inside a jitted body.
+    """
+    jit_names: Set[str] = set()
+    roots: List[ast.AST] = []
+    callbacks: Set[ast.AST] = set()
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            callee = _dotted(node.func).split(".")[-1]
+            if _is_jit_expr(node.func):
+                for a in node.args[:1]:
+                    if isinstance(a, ast.Name):
+                        jit_names.add(a.id)
+                    elif isinstance(a, (ast.Lambda, ast.FunctionDef)):
+                        roots.append(a)
+            if "callback" in callee:
+                for a in list(node.args) + [kw.value for kw in node.keywords]:
+                    if isinstance(a, ast.Lambda):
+                        callbacks.add(a)
+                    elif isinstance(a, ast.Name):
+                        jit_names.discard(a.id)  # name used as callback
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_expr(dec):
+                    roots.append(node)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                node.name in jit_names and node not in roots:
+            roots.append(node)
+    return roots, callbacks
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [x.arg for x in
+             list(getattr(a, "posonlyargs", [])) + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _traced_names(expr: ast.AST, tainted: Set[str]) -> Set[str]:
+    """Tainted Names referenced by `expr` as VALUES (a name reached only
+    through .shape/.ndim/... or len() is static under tracing)."""
+    hits: Set[str] = set()
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            return  # x.shape, x.dtype ... — static metadata
+        if isinstance(node, ast.Call):
+            callee = _dotted(node.func).split(".")[-1]
+            if callee == "len":
+                return
+            for child in list(node.args) + [k.value for k in node.keywords]:
+                visit(child)
+            if not isinstance(node.func, ast.Name):
+                visit(node.func)
+            return
+        if isinstance(node, ast.Name) and node.id in tainted:
+            hits.add(node.id)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(expr)
+    return hits
+
+
+def _check_r001(ctx: _Ctx, root: ast.AST, callbacks: Set[ast.AST]) -> None:
+    """Host conversions of traced values inside one jit target."""
+    tainted: Set[str] = set(_param_names(root))
+    # nested defs/lambdas are traced too (their params are traced values),
+    # unless they are host callbacks
+    for node in ast.walk(root):
+        if isinstance(node, (ast.FunctionDef, ast.Lambda)) and \
+                node is not root and node not in callbacks:
+            tainted.update(_param_names(node))
+
+    # one forward taint pass over simple assignments
+    for node in ast.walk(root):
+        if isinstance(node, ast.Assign) and _traced_names(node.value, tainted):
+            for tgt in node.targets:
+                for n in ast.walk(tgt):
+                    if isinstance(n, ast.Name):
+                        tainted.add(n.id)
+
+    skip: Set[ast.AST] = set()
+    for cb in callbacks:
+        skip.update(ast.walk(cb))
+    for node in ast.walk(root):
+        if node in skip or not isinstance(node, ast.Call) or not node.args:
+            continue
+        callee = _dotted(node.func)
+        short = callee.split(".")[-1]
+        is_conv = (
+            (isinstance(node.func, ast.Name) and short in _HOST_CONVERSIONS)
+            or (short in _NP_CONVERSIONS
+                and callee.split(".")[0] in ("np", "numpy", "onp"))
+        )
+        if not is_conv:
+            continue
+        traced = _traced_names(node.args[0], tainted)
+        if traced:
+            ctx.emit(
+                "R001", node,
+                f"{callee}() applied to traced value(s) {sorted(traced)} "
+                "inside a jitted body — concretization error at trace time "
+                "or a hidden host sync",
+                "use jnp casts (x.astype / jnp.asarray) in-graph, or move "
+                "the conversion outside the compiled function",
+            )
+
+
+# ----------------------------------------------------------------------
+# R002: hot-path host syncs
+# ----------------------------------------------------------------------
+
+def _is_hot_fn(name: str) -> bool:
+    return any(name == p or name.startswith(p) for p in _HOT_FN_PREFIXES)
+
+
+def _check_r002(ctx: _Ctx, tree: ast.Module) -> None:
+    if not any(ctx.relpath.replace(os.sep, "/").endswith(h)
+               for h in _HOT_FILES):
+        return
+    for fn in ast.walk(tree):
+        if not isinstance(fn, ast.FunctionDef) or not _is_hot_fn(fn.name):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _dotted(node.func)
+            short = callee.split(".")[-1]
+            if short in _SYNC_ALLOWED_HELPERS:
+                continue
+            if short in _SYNC_CALLS:
+                ctx.emit(
+                    "R002", node,
+                    f"{callee}() inside hot path {fn.name}() — a device "
+                    "round trip per step serializes dispatch against "
+                    "execution",
+                    "keep metrics on device (train_batch_async pattern), "
+                    "route end-of-run syncs through utils.sync.host_sync, "
+                    "or annotate the intentional per-step sync with "
+                    "`# ds-lint: ok R002 <why>`",
+                )
+
+
+# ----------------------------------------------------------------------
+# R003: unlocked shared-state mutation
+# ----------------------------------------------------------------------
+
+def _shared_attrs(cls: ast.ClassDef) -> Set[str]:
+    """self.X initialized to a mutable container in __init__."""
+    out: Set[str] = set()
+    for fn in cls.body:
+        if not (isinstance(fn, ast.FunctionDef) and fn.name == "__init__"):
+            continue
+        for node in ast.walk(fn):
+            # plain and annotated assignment both count
+            # (`self._inflight: Dict[...] = {}` is an AnnAssign)
+            if isinstance(node, ast.Assign):
+                targets, v = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, v = [node.target], node.value
+            else:
+                continue
+            is_container = (
+                isinstance(v, (ast.Dict, ast.List, ast.Set))
+                or (isinstance(v, ast.Call)
+                    and _dotted(v.func).split(".")[-1] in
+                    ("dict", "list", "set", "defaultdict", "OrderedDict",
+                     "deque"))
+                or (isinstance(v, ast.BinOp) and isinstance(v.op, ast.Mult)
+                    and (isinstance(v.left, ast.List)
+                         or isinstance(v.right, ast.List)))
+            )
+            if not is_container:
+                continue
+            for tgt in targets:
+                if isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self":
+                    out.add(tgt.attr)
+    return out
+
+
+def _is_lock_expr(node: ast.AST) -> bool:
+    d = _dotted(node).lower()
+    return "lock" in d or "mutex" in d
+
+
+def _mutation_of(node: ast.AST, attrs: Set[str]) -> Optional[str]:
+    """Attr name when `node` mutates self.<attr> (subscript store/del,
+    augassign, or a mutating method call)."""
+    def self_attr(e: ast.AST) -> Optional[str]:
+        if isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name) \
+                and e.value.id == "self" and e.attr in attrs:
+            return e.attr
+        return None
+
+    if isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for t in targets:
+            if isinstance(t, ast.Subscript):
+                a = self_attr(t.value)
+                if a:
+                    return a
+    if isinstance(node, ast.Delete):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                a = self_attr(t.value)
+                if a:
+                    return a
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr in _MUTATORS:
+        return self_attr(node.func.value)
+    return None
+
+
+def _check_r003(ctx: _Ctx, tree: ast.Module) -> None:
+    module_threaded = any(
+        isinstance(n, (ast.Import, ast.ImportFrom)) and any(
+            "thread" in (a.name or "").lower() for a in n.names)
+        for n in ast.walk(tree))
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        markers = {
+            _dotted(n).split(".")[-1]
+            for n in ast.walk(cls)
+            if isinstance(n, (ast.Name, ast.Attribute))
+        }
+        threaded = bool(markers & set(_THREAD_MARKERS)) or (
+            module_threaded and any("lock" in m.lower() for m in markers))
+        if not threaded:
+            continue
+        shared = _shared_attrs(cls)
+        if not shared:
+            continue
+        for fn in (n for n in cls.body if isinstance(n, ast.FunctionDef)):
+            if fn.name == "__init__" or fn.name.endswith("_locked"):
+                continue  # init is pre-concurrency; *_locked = caller holds
+            locked_nodes: Set[int] = set()
+            for w in ast.walk(fn):
+                if isinstance(w, ast.With) and any(
+                        _is_lock_expr(item.context_expr)
+                        for item in w.items):
+                    locked_nodes.update(id(x) for x in ast.walk(w))
+            for node in ast.walk(fn):
+                if id(node) in locked_nodes:
+                    continue
+                attr = _mutation_of(node, shared)
+                if attr:
+                    ctx.emit(
+                        "R003", node,
+                        f"self.{attr} (shared mutable container in a "
+                        f"threaded class) mutated in {fn.name}() outside a "
+                        "`with <lock>:` block — io_callback threads arrive "
+                        "unordered (the NvmeLayerStore._inflight race class)",
+                        "guard the mutation with the class lock, rename the "
+                        "method *_locked if the caller holds it, or annotate "
+                        "single-threaded phases with "
+                        "`# ds-lint: ok R003 <why>`",
+                    )
+
+
+# ----------------------------------------------------------------------
+# R004: undocumented donation
+# ----------------------------------------------------------------------
+
+def _check_r004(ctx: _Ctx, tree: ast.Module) -> None:
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_jit_expr(node.func)):
+            continue
+        if not any(kw.arg in ("donate_argnums", "donate_argnames")
+                   for kw in node.keywords):
+            continue
+        lo = max(0, node.lineno - 4)
+        hi = min(len(ctx.lines), getattr(node, "end_lineno", node.lineno) + 1)
+        window = "\n".join(ctx.lines[lo:hi])
+        documented = any(
+            re.search(r"#.*(donat|alias)", ln, re.I)
+            for ln in ctx.lines[lo:hi])
+        checked = "check_donation" in window or "sanitize(" in window
+        if not (documented or checked):
+            ctx.emit(
+                "R004", node,
+                "jax.jit with donate_argnums but no comment explaining the "
+                "aliasing story and no sanitizer check — unaliased donation "
+                "silently copies the buffer",
+                "add a `# donated: ...` comment naming which outputs alias, "
+                "or verify with analysis.sanitizer.check_donation / "
+                "engine.sanitize()",
+                severity="warning",
+            )
+
+
+# ----------------------------------------------------------------------
+# driver
+# ----------------------------------------------------------------------
+
+def _split_suppressed(
+    findings: List[Finding], lines: List[str]
+) -> Tuple[List[Finding], List[Finding]]:
+    active, suppressed = [], []
+    for f in findings:
+        ok = False
+        for ln in (f.line, f.line - 1):  # same line, or pragma line above
+            if not (1 <= ln <= len(lines)):
+                continue
+            m = _PRAGMA_RE.search(lines[ln - 1])
+            if not m:
+                continue
+            named = re.findall(r"R\d{3}", m.group("rules"))
+            if not named or f.rule in named:
+                ok = True
+                break
+        (suppressed if ok else active).append(f)
+    return active, suppressed
+
+
+def lint_source(source: str, relpath: str) -> Tuple[List[Finding],
+                                                    List[Finding]]:
+    """Lint one file's source. Returns (findings, suppressed)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding(rule="R000", path=relpath, line=e.lineno or 0,
+                        severity="error", message=f"syntax error: {e.msg}",
+                        fix_hint="")], []
+    lines = source.splitlines()
+    ctx = _Ctx(relpath=relpath, lines=lines, findings=[])
+    roots, callbacks = _collect_jit_roots(tree)
+    for root in roots:
+        _check_r001(ctx, root, callbacks)
+    _check_r002(ctx, tree)
+    _check_r003(ctx, tree)
+    _check_r004(ctx, tree)
+    ctx.findings.sort(key=lambda f: (f.line, f.rule))
+    return _split_suppressed(ctx.findings, lines)
+
+
+def _iter_py(paths: Iterable[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def lint_paths(paths: Sequence[str],
+               base: Optional[str] = None) -> LintReport:
+    """Lint every .py under `paths`; report paths relative to `base`."""
+    report = LintReport()
+    for path in _iter_py(paths):
+        rel = os.path.relpath(path, base) if base else path
+        with open(path, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        findings, suppressed = lint_source(src, rel)
+        report.findings.extend(findings)
+        report.suppressed.extend(suppressed)
+        report.files_checked += 1
+    return report

@@ -1,0 +1,91 @@
+"""Structured findings shared by the graph sanitizer and ds-lint.
+
+Plain dataclasses, not log lines: tests and CI consume them directly
+(`SanitizerReport.ok` gates a pipeline; `LintReport.by_rule()` feeds the
+baseline count in COVERAGE.md). Rendering is a method, never the storage
+format.
+"""
+
+import dataclasses
+from collections import Counter
+from typing import Dict, List
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One static-analysis finding.
+
+    rule: "R001".."R004" for ds-lint, "S001".."S003" for the sanitizer
+    path: file path (lint) or program/parameter label (sanitizer)
+    line: 1-based source line (0 when the finding has no source anchor)
+    severity: "error" | "warning" | "info"
+    message: what is wrong
+    fix_hint: how to fix it (or how to annotate it as intentional)
+    """
+
+    rule: str
+    path: str
+    line: int
+    severity: str
+    message: str
+    fix_hint: str = ""
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        s = f"{loc}: [{self.rule}/{self.severity}] {self.message}"
+        if self.fix_hint:
+            s += f"\n    hint: {self.fix_hint}"
+        return s
+
+
+@dataclasses.dataclass
+class _Report:
+    findings: List[Finding] = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def by_rule(self) -> Dict[str, int]:
+        return dict(Counter(f.rule for f in self.findings))
+
+    def render(self) -> str:
+        if not self.findings:
+            return "no findings"
+        return "\n".join(f.render() for f in self.findings)
+
+
+@dataclasses.dataclass
+class SanitizerReport(_Report):
+    """Findings from the graph sanitizer over one compiled program."""
+
+    label: str = ""
+
+    def render(self) -> str:
+        head = f"sanitizer[{self.label or 'program'}]: "
+        if not self.findings:
+            return head + "clean"
+        return head + f"{len(self.findings)} finding(s)\n" + super().render()
+
+
+@dataclasses.dataclass
+class LintReport(_Report):
+    """ds-lint findings over a file set, plus the suppressed tail."""
+
+    suppressed: List[Finding] = dataclasses.field(default_factory=list)
+    files_checked: int = 0
+
+    def summary(self) -> str:
+        return (
+            f"ds-lint: {self.files_checked} files, "
+            f"{len(self.findings)} finding(s), "
+            f"{len(self.suppressed)} suppressed by pragma"
+        )
+
+
+def merge_reports(label: str, *reports: _Report) -> SanitizerReport:
+    """Fold several check results into one SanitizerReport."""
+    out = SanitizerReport(label=label)
+    for r in reports:
+        out.findings.extend(r.findings if isinstance(r, _Report) else r)
+    return out

@@ -18,9 +18,9 @@ benchmarks/communication/utils.py): for ring algorithms the wire moves
   all_to_all:                  busbw = algbw * (n-1)/n
   ppermute (pt2pt ring):       busbw = algbw
 
-Timing: each trial is one dispatch synchronized with
-`jax.block_until_ready` on the result, and the reported time is the
-MEDIAN over trials. The tunnel round trip is measured once and emitted
+Timing: each trial is one dispatch synchronized through
+`utils.sync.host_sync` (the named end-of-run choke point ds-lint R002
+allowlists), and the reported time is the MEDIAN over trials. The tunnel round trip is measured once and emitted
 as a separate `rtt_us` field per record (auditable) rather than
 subtracted from the timings — the old pipelined-dispatch-minus-one-rtt
 calibration under-corrected: a single tiny-add round trip does not
@@ -49,6 +49,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils.sync import host_readback, host_sync
 
 OPS = ("all_gather", "all_reduce", "reduce_scatter", "all_to_all",
        "ppermute")
@@ -100,17 +102,13 @@ def _payload_shape(op: str, size_bytes: int, n: int, dtype) -> tuple:
     return (n * rows, width)
 
 
-def _readback(x):
-    return np.asarray(jax.tree.leaves(x)[0].ravel()[:1])
-
-
 def _rtt() -> float:
     f = jax.jit(lambda x: x + 1)
-    _readback(f(jnp.zeros((8, 128))))
+    host_readback(f(jnp.zeros((8, 128))))
     ts = []
     for i in range(5):
         t0 = time.perf_counter()
-        _readback(f(jnp.full((8, 128), float(i))))
+        host_readback(f(jnp.full((8, 128), float(i))))
         ts.append(time.perf_counter() - t0)
     return min(ts)
 
@@ -140,11 +138,11 @@ def sweep(
             sharding = NamedSharding(mesh, P(axis))
             x = jax.device_put(
                 jnp.ones(shape, dtype), sharding)
-            jax.block_until_ready(fn(x))  # compile + warm
+            host_sync(fn(x))  # compile + warm
             times = []
             for _ in range(trials):
                 t0 = time.perf_counter()
-                jax.block_until_ready(fn(x))
+                host_sync(fn(x))  # per-trial boundary (the R002 choke point)
                 times.append(time.perf_counter() - t0)
             dt = max(float(np.median(times)), 1e-9)
             per_dev_bytes = (np.prod(shape) // n) * jnp.dtype(dtype).itemsize

@@ -88,8 +88,15 @@ def _leaf_sharding(pspec, leaf, mesh: Mesh, memory_kind: str = "device"):
     pairing is not worth the bookkeeping)."""
     from .quantization import ChannelQuantWeight, QuantizedWeight
 
-    mk = NamedSharding(mesh, pspec, memory_kind=memory_kind)
-    repl = NamedSharding(mesh, P(), memory_kind=memory_kind)
+    try:
+        mk = NamedSharding(mesh, pspec, memory_kind=memory_kind)
+        repl = NamedSharding(mesh, P(), memory_kind=memory_kind)
+    except ValueError:
+        # backend without distinct memory spaces (CPU, jax 0.4.x): the
+        # default memory already IS host memory, so the tier placement
+        # collapses to a plain sharding
+        mk = NamedSharding(mesh, pspec)
+        repl = NamedSharding(mesh, P())
     if isinstance(leaf, QuantizedWeight):
         return QuantizedWeight(q=mk, scale=repl, bits=leaf.bits,
                                dtype_name=leaf.dtype_name)
@@ -409,8 +416,13 @@ class InferenceEngine:
                 "per_channel int8 (streams codes, scales on output)"
             )
         nvme = self._offload["device"] == "nvme"
-        host = jax.sharding.SingleDeviceSharding(
-            jax.devices()[0], memory_kind="pinned_host")
+        try:
+            host = jax.sharding.SingleDeviceSharding(
+                jax.devices()[0], memory_kind="pinned_host")
+        except ValueError:
+            # backend without a pinned_host space (CPU, jax 0.4.x): the
+            # default memory already IS host memory
+            host = jax.sharding.SingleDeviceSharding(jax.devices()[0])
 
         from .quantization import ChannelQuantWeight
 
@@ -582,7 +594,10 @@ class InferenceEngine:
                     use_kernel, mesh=mesh, fetch_layer=fetch,
                 )
 
-            self._prefill_batch_fns[key] = jax.jit(step, donate_argnums=(1,))
+            # donated: the paged KV cache aliases the returned cache
+            # (same PagedCache layout in and out); compile caches below
+            # are only ever touched by the host dispatch thread
+            self._prefill_batch_fns[key] = jax.jit(step, donate_argnums=(1,))  # ds-lint: ok R003 host dispatch thread only
         return self._prefill_batch_fns[key]
 
     def _decode_fn(self, s: int, unique_rows: bool = False):
@@ -598,7 +613,8 @@ class InferenceEngine:
                     mesh=mesh, unique_rows=unique_rows, fetch_layer=fetch,
                 )
 
-            self._decode_fns[key] = jax.jit(step, donate_argnums=(1,))
+            # donated: the KV cache aliases the returned cache in-place
+            self._decode_fns[key] = jax.jit(step, donate_argnums=(1,))  # ds-lint: ok R003 host dispatch thread only
         return self._decode_fns[key]
 
     def decode_multi_fn(self, s: int, n_steps: int, sampling=None,
@@ -643,6 +659,7 @@ class InferenceEngine:
                         fetch_layer=fetch,
                     )
 
+            # donated: the KV cache aliases the carried cache output
             self._decode_multi_fns[key] = jax.jit(step, donate_argnums=(1,))
         return self._decode_multi_fns[key]
 
@@ -683,6 +700,8 @@ class InferenceEngine:
                     v=[cv.at[d].set(cv[s]) for cv in cache.v],
                 )
 
+            # donated: cache aliases the returned PagedCache (in-place
+            # page write, no second cache allocation)
             self._cow_fn = jax.jit(cp, donate_argnums=(0,))
         self.cache = self._cow_fn(self.cache, jnp.int32(src),
                                   jnp.int32(dst))

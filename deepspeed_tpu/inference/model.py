@@ -276,8 +276,12 @@ def cache_pspec(mesh: Optional[Mesh], kv_heads: int) -> P:
 
 
 def _shard_map_kernel(fn, mesh: Mesh, in_specs, out_specs):
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=False)
+    # fully-manual map (every mesh axis), via the version-portable shim
+    from ..platform.mesh import shard_map_partial
+
+    return shard_map_partial(fn, mesh, in_specs=in_specs,
+                             out_specs=out_specs,
+                             manual_axes=mesh.axis_names)
 
 
 class PagedCache(NamedTuple):

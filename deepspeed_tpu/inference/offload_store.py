@@ -123,8 +123,10 @@ class NvmeLayerStore:
             rows.append((i, f, arr.shape, arr.dtype))
         for t in tickets:
             self.aio.wait(t)
-        self._manifest[l] = rows
-        self._spec_tree[l] = jax.tree_util.tree_unflatten(
+        # staging is strictly single-threaded and precedes any serving
+        # read (finish_staging is the barrier) — no lock needed here
+        self._manifest[l] = rows  # ds-lint: ok R003 single-threaded staging phase
+        self._spec_tree[l] = jax.tree_util.tree_unflatten(  # ds-lint: ok R003 single-threaded staging phase
             treedef,
             [jax.ShapeDtypeStruct(r[2], r[3]) for r in rows],
         )

@@ -584,19 +584,28 @@ def _rope(q, k, cfg: TransformerConfig, offset: int = 0, positions=None):
     return rot(q), rot(k)
 
 
+def _ambient_mesh():
+    """Version-portable ambient mesh (platform.mesh.ambient_mesh)."""
+    from ..platform.mesh import ambient_mesh
+
+    return ambient_mesh()
+
+
 def _shard(x, *spec):
     """Sharding constraint against the ambient mesh (set by the engine via
-    jax.sharding.set_mesh). Outside any mesh context — e.g. a plain
+    platform.mesh.use_mesh). Outside any mesh context — e.g. a plain
     single-device forward — constraints are skipped explicitly; inside a
     mesh context a bad spec raises rather than silently degrading.
 
     Inside a partial-manual shard_map (the per-worker gradient path for
     1-bit/qgZ compression), axes the caller already mapped over are
     dropped from the spec — constraints may only name Auto axes there."""
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = _ambient_mesh()
     if mesh is None or mesh.empty:
         return x
-    manual = set(getattr(mesh, "manual_axes", ()) or ())
+    from ..platform.mesh import manual_axes_of
+
+    manual = set(manual_axes_of(mesh))
     if manual:
         def strip(entry):
             if entry is None:

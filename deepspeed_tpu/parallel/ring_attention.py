@@ -24,6 +24,7 @@ from functools import partial
 from typing import Tuple
 
 import jax
+from ..platform.mesh import ambient_mesh
 import jax.numpy as jnp
 import numpy as np
 
@@ -199,9 +200,10 @@ def _ring_bwd(q, k, v, out, lse, do, axis_name: str,
 
 
 def _ring_smap(impl, mesh, in_specs, out_specs):
-    return jax.shard_map(impl, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, axis_names={"seq"},
-                         check_vma=False)
+    from ..platform.mesh import shard_map_partial
+
+    return shard_map_partial(impl, mesh, in_specs=in_specs,
+                             out_specs=out_specs, manual_axes={"seq"})
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -260,7 +262,7 @@ def ring_causal_attention(
     jit); force_kernel=True overrides for the interpret-mode kernel
     test lane."""
     if mesh is None:
-        mesh = jax.sharding.get_abstract_mesh()
+        mesh = ambient_mesh()
     if mesh is None or mesh.empty or mesh.shape.get("seq", 1) <= 1:
         # no ring: plain causal attention (honoring the flash setting)
         from ..ops.attention import causal_attention
@@ -270,14 +272,15 @@ def ring_causal_attention(
         return _ring_flash_global(q, k, v, mesh, block_q, block_k)
     from jax.sharding import PartitionSpec as P
 
+    from ..platform.mesh import shard_map_partial
+
     spec = P(None, "seq", None, None)
-    fn = jax.shard_map(
+    fn = shard_map_partial(
         partial(ring_attention, axis_name="seq", use_flash=False,
                 block_q=block_q, block_k=block_k),
-        mesh=mesh,
+        mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        axis_names={"seq"},
-        check_vma=False,
+        manual_axes={"seq"},
     )
     return fn(q, k, v)

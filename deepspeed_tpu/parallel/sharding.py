@@ -14,6 +14,8 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..platform.mesh import shard_map_partial  # noqa: F401  (re-export)
+
 MeshAxes = Union[None, str, Tuple[str, ...]]
 
 # Default rules table. Megatron-style TP: attention heads and the MLP

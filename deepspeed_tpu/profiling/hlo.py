@@ -30,17 +30,26 @@ _COLLECTIVES = (
     "collective-broadcast",
 )
 
-# e.g. "  %x = bf16[4,128]{1,0} all-gather(...)" or tuple results
+# One dimension: static (`128`) or dynamic-bounded (`<=128`).
+_DIM = r"(?:<=)?\d+"
+# One array shape: `bf16[4,128]`, `f32[]`, `bf16[<=128,64]`.
+_ARRAY = rf"[a-z][a-z0-9]*\[(?:{_DIM}(?:,\s*{_DIM})*)?\]"
+# A result: a bare array (with optional layout suffix), a tuple, or a
+# tuple of tuples (async -start ops on multi-operand collectives emit
+# e.g. `((bf16[4], bf16[8]), (bf16[16], bf16[32]))`).
 _INSTR_RE = re.compile(
-    r"=\s*(?P<result>\([^)]*\)|[a-z0-9]+\[[0-9,]*\][^ ]*)\s+"
+    r"=\s*(?P<result>\((?:[^()]|\([^()]*\))*\)|" + _ARRAY + r"[^ ]*)\s+"
     r"(?P<op>" + "|".join(_COLLECTIVES) + r")\("
 )
-_SHAPE_RE = re.compile(r"(?P<dtype>[a-z0-9]+)\[(?P<dims>[0-9,]*)\]")
+_SHAPE_RE = re.compile(
+    rf"(?P<dtype>[a-z][a-z0-9]*)\[(?P<dims>(?:{_DIM}(?:,\s*{_DIM})*)?)\]"
+)
 
 
 def _shape_bytes(dtype: str, dims: str) -> int:
     n = 1
     for d in dims.split(","):
+        d = d.strip().replace("<=", "")  # dynamic dim: count its bound
         if d:
             n *= int(d)
     return n * _DTYPE_BYTES.get(dtype, 4)
@@ -68,6 +77,68 @@ def parse_hlo_collectives(hlo_text: str) -> List[Dict]:
         dtypes = sorted({s.group("dtype") for s in _SHAPE_RE.finditer(result)})
         out.append({"op": op, "bytes": nbytes, "dtypes": dtypes})
     return out
+
+
+# --- entry-parameter extraction (analysis/sanitizer.py consumer) -------
+#
+# Post-partitioning entry parameters carry the per-shard shape chosen by
+# the SPMD partitioner plus the final `sharding=` annotation and the
+# `op_name` metadata JAX stamps with the argument keypath — ground truth
+# for whether a declared PartitionSpec survived compilation.
+
+_PARAM_RE = re.compile(
+    rf"=\s*(?P<dtype>[a-z][a-z0-9]*)"
+    rf"\[(?P<dims>(?:{_DIM}(?:,\s*{_DIM})*)?)\]"
+    r"[^\n]*?parameter\((?P<idx>\d+)\)(?P<rest>[^\n]*)"
+)
+_SHARDING_ATTR_RE = re.compile(r"sharding=\{(?P<sharding>[^}]*)\}")
+_OP_NAME_RE = re.compile(r'op_name="(?P<name>(?:[^"\\]|\\.)*)"')
+
+
+def _entry_text(hlo_text: str) -> str:
+    """The ENTRY computation's body (parameters elsewhere belong to
+    fusions/called computations, not the program signature)."""
+    m = re.search(r"^ENTRY\b[^\n]*\{", hlo_text, re.M)
+    if m is None:
+        return hlo_text
+    end = hlo_text.find("\n}", m.end())
+    return hlo_text[m.end(): end if end != -1 else len(hlo_text)]
+
+
+def parse_entry_parameters(hlo_text: str) -> List[Dict]:
+    """Entry parameters of a compiled module: per-shard dtype/dims plus
+    the `sharding=` annotation and op_name keypath (when present).
+
+    Returns [{index, dtype, dims, sharding, op_name}], dims as a tuple of
+    ints (dynamic `<=N` bounds count as N)."""
+    out = []
+    for m in _PARAM_RE.finditer(_entry_text(hlo_text)):
+        rest = m.group("rest")
+        sh = _SHARDING_ATTR_RE.search(rest)
+        nm = _OP_NAME_RE.search(rest)
+        dims = tuple(
+            int(d.strip().replace("<=", ""))
+            for d in m.group("dims").split(",") if d.strip()
+        )
+        out.append({
+            "index": int(m.group("idx")),
+            "dtype": m.group("dtype"),
+            "dims": dims,
+            "sharding": sh.group("sharding") if sh else None,
+            "op_name": (nm.group("name").replace("\\'", "'")
+                        .replace('\\"', '"') if nm else None),
+        })
+    return out
+
+
+def entry_parameter_shardings(compiled) -> Dict[str, Dict]:
+    """op_name-keyed entry parameters of one compiled program (params
+    without op_name metadata are keyed by their index)."""
+    recs = parse_entry_parameters(compiled.as_text())
+    return {
+        (r["op_name"] if r["op_name"] is not None else f"#{r['index']}"): r
+        for r in recs
+    }
 
 
 def collective_volumes(compiled) -> Dict[str, Dict[str, float]]:

@@ -36,6 +36,7 @@ a leading microbatch dim.
 from typing import Any, Callable, Optional
 
 import jax
+from ..platform.mesh import ambient_mesh
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -49,8 +50,10 @@ def _constraint_auto_only(t, spec):
     spec — inside the per-worker gradient shard_map (1-bit/0-1/qgZ x
     pipeline), the data axes are already mapped over and constraints may
     only name Auto axes (same rule as models/transformer._shard)."""
-    mesh = jax.sharding.get_abstract_mesh()
-    manual = set(getattr(mesh, "manual_axes", ()) or ()) if mesh else set()
+    mesh = ambient_mesh()
+    from ..platform.mesh import manual_axes_of
+
+    manual = set(manual_axes_of(mesh)) if mesh else set()
     if manual:
         def strip(entry):
             if entry is None:
@@ -173,7 +176,7 @@ def pipeline_apply(
 
     # Outside a pipe>1 mesh (pure-function tests, pipe folded away) run as
     # a plain vmap with no sharding annotations.
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = ambient_mesh()
     has_pipe = (
         mesh is not None and not mesh.empty and mesh.shape.get("pipe", 1) > 1
     )
@@ -300,7 +303,7 @@ def pipeline_apply_circular(
     key_state = jnp.zeros((n_stage,) + mb_keys.shape[1:], mb_keys.dtype)
     stage_ids = jnp.arange(n_stage)
 
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = ambient_mesh()
     has_pipe = (
         mesh is not None and not mesh.empty and mesh.shape.get("pipe", 1) > 1
     )

@@ -1,0 +1,32 @@
+"""The one named host-sync choke point.
+
+Benchmarks and profiling scripts must synchronize with the device at
+end-of-run/per-trial boundaries; hot paths must not. ds-lint rule R002
+flags raw `jax.block_until_ready`/`jax.device_get` in the engine
+step/decode paths — deliberate measurement syncs route through
+`host_sync` instead, so every blocking point in the tree is greppable by
+one name and auditable in one place.
+"""
+
+from typing import Any
+
+import jax
+import numpy as np
+
+__all__ = ["host_sync", "host_readback"]
+
+
+def host_sync(tree: Any) -> Any:
+    """Block until every leaf of `tree` has materialized on device, then
+    return it. The allowlisted R002 helper: use at trial/run boundaries
+    (comm/bench.py, scripts/profile_*.py), never inside a step loop."""
+    return jax.block_until_ready(tree)  # ds-lint: ok R002 the choke point
+
+
+def host_readback(tree: Any) -> np.ndarray:
+    """One-element host readback of the first leaf — the sync that works
+    THROUGH the axon TPU tunnel, where block_until_ready does not
+    synchronize (measured; see scripts/tpu_timing.py). Same contract as
+    host_sync: end-of-run/per-trial boundaries only."""
+    leaf = jax.tree_util.tree_leaves(tree)[0]
+    return np.asarray(leaf.ravel()[:1])

@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""ds-lint CLI — project-specific static checks over deepspeed_tpu/.
+
+Usage:
+    python scripts/ds_lint.py                  # lint the package
+    python scripts/ds_lint.py --strict         # non-zero exit on findings
+    python scripts/ds_lint.py --json           # machine-readable output
+    python scripts/ds_lint.py path/to/file.py  # lint specific paths
+
+`--strict` is the tier-1 pre-test step (see .claude/skills/verify/
+SKILL.md): the tree must stay lint-clean; intentional sites carry a
+`# ds-lint: ok <rule> <reason>` pragma and are reported separately.
+Pure AST analysis — no jax import, safe anywhere.
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from deepspeed_tpu.analysis.lint import RULES, lint_paths  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(_REPO, "deepspeed_tpu")],
+                    help="files or directories (default: the package)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when any unsuppressed finding remains")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON object instead of text")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also list pragma-suppressed findings")
+    ap.add_argument("--rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    report = lint_paths(args.paths, base=_REPO)
+
+    if args.json:
+        print(json.dumps({
+            "findings": [dataclasses.asdict(f) for f in report.findings],
+            "suppressed": [dataclasses.asdict(f) for f in report.suppressed],
+            "files_checked": report.files_checked,
+            "by_rule": report.by_rule(),
+        }))
+    else:
+        for f in report.findings:
+            print(f.render())
+        if args.show_suppressed and report.suppressed:
+            print("-- suppressed by pragma --")
+            for f in report.suppressed:
+                print(f.render())
+        print(report.summary())
+
+    return 1 if (args.strict and report.findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

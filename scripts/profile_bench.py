@@ -14,6 +14,8 @@ import numpy as np
 
 from tpu_timing import timeit
 
+from deepspeed_tpu.utils.sync import host_sync
+
 
 def main():
     from deepspeed_tpu.models import transformer as T
@@ -34,7 +36,7 @@ def main():
         )
         params = jax.jit(lambda k: jax.tree.map(
             lambda x: x.astype(jnp.bfloat16), T.init(mcfg, k)))(jax.random.PRNGKey(0))
-        jax.block_until_ready(params)
+        host_sync(params)  # end-of-init boundary (named choke point)
         loss_fn = T.make_loss_fn(mcfg)
         fwd = jax.jit(lambda p, t: loss_fn(p, {"tokens": t}, None))
         grad = jax.jit(lambda p, t: jax.grad(

@@ -44,14 +44,14 @@ def main(batch=8, n_steps=24, quant=False):
     toks = jnp.asarray(rng.integers(0, mcfg.vocab_size, batch).astype(np.int32))
     ctx = jnp.full((batch,), 97, jnp.int32)
 
+    # donated: the KV cache aliases the carried cache output
     fn = jax.jit(
         lambda p, c, t, tb, cx: M.decode_multi(
             p, c, t, tb, cx, mcfg, n_steps=n_steps, use_kernel=on_tpu),
         donate_argnums=(1,),
     )
 
-    def readback(x):
-        return np.asarray(jax.tree.leaves(x)[0].ravel()[:1])
+    from deepspeed_tpu.utils.sync import host_readback as readback
 
     gen, logits, cache, _ = fn(params, cache, toks, tables, ctx)
     readback(logits)

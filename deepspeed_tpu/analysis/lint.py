@@ -51,13 +51,16 @@ _PRAGMA_RE = re.compile(
 # per-token / per-step loops. A name matches when it equals an entry or
 # starts with `entry` + one of the listed prefixes.
 _HOT_FILES = ("runtime/engine.py", "inference/engine.py",
-              "runtime/hybrid_engine.py")
+              "runtime/hybrid_engine.py", "inference/scheduler.py")
 _HOT_FN_PREFIXES = (
     "train_batch", "eval_batch", "_dispatch", "decode", "_decode",
     "generate", "put", "step", "_sample", "prefill", "_prefill",
+    "run", "_finalize", "_accept", "submit", "_admit",
 )
 _SYNC_CALLS = ("block_until_ready", "device_get")
-_SYNC_ALLOWED_HELPERS = ("host_sync",)
+# serving_readback: the scheduler loop's one named readback point
+# (utils/sync.py) — double-buffered, token-ids-only
+_SYNC_ALLOWED_HELPERS = ("host_sync", "serving_readback")
 
 _HOST_CONVERSIONS = ("float", "int", "bool")
 _NP_CONVERSIONS = ("asarray", "array")

@@ -2,6 +2,7 @@ from .config import (
     DeepSpeedTPUConfig,
     MeshConfig,
     OffloadConfig,
+    ServingSchedulerConfig,
     ZeroConfig,
     ZeroStage,
     parse_config,

@@ -227,6 +227,57 @@ class PrefixCacheConfig(ConfigModel):
     pool_blocks: int = -1
 
 
+class ServingSchedulerConfig(ConfigModel):
+    """Continuous-batching serving scheduler (inference/scheduler.py
+    ServingScheduler) — the request-level control plane over the paged
+    KV substrate.
+
+    max_num_batched_tokens: per-iteration token budget (Sarathi-Serve's
+    chunked-prefill knob): decode rows spend 1 token each, prefill
+    chunks fill the remainder — so a long prompt never stalls decode.
+    prefill_chunk: max prompt tokens one sequence feeds per iteration.
+    decode_chunk: steady-state fused decode depth — when every active
+    sequence is decoding (no prefill in flight), the scheduler
+    dispatches ONE compiled multi-step program covering decode_chunk
+    tokens (tokens stay device-resident between steps).
+    admission: 'fcfs' stops at the first waiting request that does not
+    fit the KV pool (strict arrival order); 'skip' keeps scanning the
+    queue for later requests that do fit (no head-of-line blocking on
+    capacity, mild reordering).
+    prefill_mode: 'chunked' feeds prompts through the decode path in
+    prefill_chunk pieces piggybacked on decode iterations (serving
+    default); 'wave' prefills whole prompts through the compiled
+    cross-prompt prefill waves (the generate() parity path).
+    warmup: AOT-precompile the (bucket width x chunk) decode/sample
+    grid at scheduler construction so steady-state serving triggers
+    zero recompiles (engine.warmup)."""
+
+    max_num_batched_tokens: int = 256
+    prefill_chunk: int = 32
+    decode_chunk: int = 1
+    admission: str = "fcfs"
+    prefill_mode: str = "chunked"
+    warmup: bool = True
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.admission not in ("fcfs", "skip"):
+            raise ValueError(
+                f"unknown admission policy '{self.admission}' "
+                "(expected fcfs|skip)")
+        if self.prefill_mode not in ("chunked", "wave"):
+            raise ValueError(
+                f"unknown prefill_mode '{self.prefill_mode}' "
+                "(expected chunked|wave)")
+        if self.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        if self.decode_chunk < 1:
+            raise ValueError("decode_chunk must be >= 1")
+        if self.max_num_batched_tokens < 1:
+            raise ValueError("max_num_batched_tokens must be >= 1")
+        return self
+
+
 class CurriculumConfig(ConfigModel):
     """ref: runtime/data_pipeline/curriculum_scheduler.py config (the
     legacy 'curriculum_learning' block). Consumed by the engine: with

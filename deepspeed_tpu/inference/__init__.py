@@ -10,6 +10,7 @@ from .ragged import (
     SequenceDescriptor,
     StateManager,
 )
+from .scheduler import Request, ServingScheduler, ServingSchedulerConfig
 
 __all__ = [
     "InferenceConfig",
@@ -20,4 +21,7 @@ __all__ = [
     "PrefixMatch",
     "SequenceDescriptor",
     "StateManager",
+    "Request",
+    "ServingScheduler",
+    "ServingSchedulerConfig",
 ]

@@ -333,6 +333,14 @@ class InferenceEngine:
         self._prefill_batch_fns: Dict[Tuple[int, int], Any] = {}
         # keyed (batch_width, unique_rows)
         self._decode_fns: Dict[Tuple[int, bool], Any] = {}
+        # always-on S003 tracker (analysis/sanitizer.py): the serving
+        # scheduler and warmup() record each dispatch's operand
+        # signature per compiled-program name — a finding after warmup
+        # means steady-state serving is recompiling (weak-type drift /
+        # shape churn), the exact hazard AOT warmup exists to kill
+        from ..analysis.sanitizer import RecompileTracker
+
+        self.recompile_tracker = RecompileTracker()
         kv_bytes = sum(x.nbytes for x in self.cache.k + self.cache.v)
         log_dist(
             f"inference engine: {self.config.num_kv_blocks} KV blocks x "
@@ -567,8 +575,15 @@ class InferenceEngine:
 
             return fetch
 
-        dev_s = jax.sharding.SingleDeviceSharding(
-            jax.devices()[0], memory_kind="device")
+        try:
+            dev_s = jax.sharding.SingleDeviceSharding(
+                jax.devices()[0], memory_kind="device")
+        except ValueError:
+            # backend without distinct memory spaces (CPU, jax 0.4.x):
+            # the default memory IS the only tier, so the in-jit fetch
+            # collapses to a plain placement (same fallback as
+            # _leaf_sharding / _refresh_offload)
+            dev_s = jax.sharding.SingleDeviceSharding(jax.devices()[0])
 
         def fetch(lp, dep=None, idx=None):
             lp = barrier(lp, dep)
@@ -1020,6 +1035,104 @@ class InferenceEngine:
         """Free a sequence's KV blocks (ref: engine_v2.py flush:242)."""
         self.state.flush(uid)
 
+    # -- AOT warmup: precompile the serving shape-bucket grid ------------
+    def warmup(
+        self,
+        sampling: Optional[Dict[str, Any]] = None,
+        widths: Optional[Sequence[int]] = None,
+        chunked: bool = True,
+        decode_chunks: Sequence[int] = (),
+        presence: bool = False,
+    ) -> Dict[str, Any]:
+        """Precompile the (bucket width x chunk) decode/sample grid so
+        steady-state serving triggers ZERO recompiles (S003): every
+        program a ServingScheduler can dispatch at these widths is
+        compiled here, by EXECUTING it once over inert padding rows —
+        ctx 0 rows drop their KV writes (XLA path) or write the
+        reserved pad_block scratch (fused kernel), so the live cache is
+        untouched and the jit call cache (not just an AOT artifact) is
+        populated on every jax version.
+
+        widths: decode-row buckets (default: powers of two from 8 up to
+        bucket(max_batch_size)). chunked=True additionally compiles the
+        shared-table variant mixed prefill chunks need. decode_chunks:
+        fused multi-step depths (model.decode_multi) to warm per width.
+        sampling/presence select the sampling epilogue variant.
+
+        Logs a one-line compile-time summary and returns
+        {programs, seconds, widths, chunks}."""
+        import time as _time
+
+        from .sampling import SamplingConfig
+
+        scfg = SamplingConfig(**(sampling or {}))
+        if widths is None:
+            widths, w = [], 8
+            top = _bucket(self.config.max_batch_size, 8)
+            while w <= top:
+                widths.append(w)
+                w *= 2
+        widths = [int(w) for w in widths]
+        t0 = _time.perf_counter()
+        n = 0
+        rt = self.recompile_tracker
+        use_sampler = not (scfg.greedy and not scfg.needs_presence)
+        with_pres = bool(presence and scfg.needs_presence)
+        V = self.cfg.vocab_size
+        for w in widths:
+            toks = np.zeros((w,), np.int32)
+            ctx = np.zeros((w,), np.int32)
+            tables = np.full((w, self.config.blocks_per_seq),
+                             self.pad_block, np.int32)
+            steps = np.zeros((w,), np.int32)
+            keys = self._row_keys(0, np.zeros((w,), np.uint32))
+            logits = None
+            for uniq in ((True, False) if chunked else (True,)):
+                rt.record(f"serving_decode[w{w},u{int(uniq)}]",
+                          (toks, tables, ctx))
+                logits, self.cache = self._decode_fn(w, uniq)(
+                    self.params, self.cache, self._dev(toks),
+                    self._dev(tables), self._dev(ctx))
+                n += 1
+            if with_pres:
+                pres = np.zeros((w, V), np.uint8)
+                rt.record(f"serving_sample[w{w}]", (steps, pres))
+                self._sample_fn(scfg, True)(
+                    logits, keys, self._dev(steps), self._dev(pres))
+            else:
+                rt.record(f"serving_sample[w{w}]", (steps,))
+                self._sample_fn(scfg, False)(logits, keys,
+                                             self._dev(steps))
+            n += 1
+            for C in decode_chunks:
+                C = int(C)
+                if C < 1:
+                    continue
+                rt.record(f"serving_fused[w{w},c{C}]",
+                          (toks, tables, ctx, steps))
+                fn = self.decode_multi_fn(
+                    w, C, sampling=scfg if use_sampler else None,
+                    with_presence=with_pres)
+                args = [self.params, self.cache, self._dev(toks),
+                        self._dev(tables), self._dev(ctx)]
+                if use_sampler:
+                    args.append(keys)
+                    args.append(self._dev(steps))
+                    if with_pres:
+                        args.append(self._dev(np.zeros((w, V), np.uint8)))
+                _, _, self.cache, _ = fn(*args)
+                n += 1
+        dt = _time.perf_counter() - t0
+        log_dist(
+            f"serving warmup: {n} compiled programs (decode widths "
+            f"{widths}{' +chunked' if chunked else ''}, fused depths "
+            f"{[int(c) for c in decode_chunks]}, "
+            f"sampling={'on' if use_sampler else 'greedy'}) in {dt:.1f}s",
+            ranks=[0],
+        )
+        return {"programs": n, "seconds": dt, "widths": widths,
+                "chunks": [int(c) for c in decode_chunks]}
+
     # -- speculative (multi-token-per-stream) decoding -------------------
     def _verify_chunks(
         self, uids: Sequence[int], chunks: Sequence[np.ndarray],
@@ -1099,99 +1212,33 @@ class InferenceEngine:
         verify-row budget (max_batch_size // n_live) forced per_seq=1
         so k=0 and speculation degenerated to one-token decode. The
         first such step also logs a warning, so a silently-serial
-        "speculative" run is visible to callers."""
+        "speculative" run is visible to callers.
+
+        Since the serving-scheduler PR the request lifecycle (admission,
+        immediate EOS retirement + flush, preemption under KV pressure)
+        runs through inference/scheduler.py ServingScheduler in
+        speculative mode; verification still dispatches through
+        self._verify_chunks. Exactness is unchanged."""
+        from .scheduler import ServingScheduler, ServingSchedulerConfig
+
         if len(prompts) > self.config.max_batch_size:
             raise ValueError(
                 f"{len(prompts)} prompts > max_batch_size "
                 f"{self.config.max_batch_size} (every live sequence "
                 "needs at least one verify row per step)")
-        taken = set(self.state.tracked_uids)
-        uids, cand = [], 0
-        while len(uids) < len(prompts):
-            if cand not in taken:
-                uids.append(cand)
-            cand += 1
-        try:
-            logits = self.put(uids,
-                              [np.asarray(p, np.int32) for p in prompts])
-            hist = [list(map(int, p)) for p in prompts]
-            nxt = [int(np.argmax(l)) for l in logits]
-            outs: List[List[int]] = [[] for _ in prompts]
-            live = [max_new_tokens > 0] * len(prompts)
-            stats = {"steps": 0, "verified_chunks": 0, "draft_tokens": 0,
-                     "accepted_tokens": 0, "draft_collapsed_steps": 0,
-                     "mean_accepted": 0.0}
-            while any(live):
-                lu, lc = [], []
-                # drafts share the verify batch: split the row budget
-                # across live sequences (each needs >= 1 committed row)
-                n_live = sum(live)
-                per_seq = max(1, self.config.max_batch_size // n_live)
-                if per_seq == 1 and draft_len > 0:
-                    # budget collapse: every row is a committed token,
-                    # k=0 — "speculative" decode degenerates to plain
-                    # one-token decode. Log once, count every step.
-                    if stats["draft_collapsed_steps"] == 0:
-                        log_dist(
-                            "generate_speculative: max_batch_size "
-                            f"{self.config.max_batch_size} // {n_live} "
-                            "live sequences leaves no draft rows "
-                            "(per_seq=1, k=0); speculation is running "
-                            "as plain decode — raise max_batch_size or "
-                            "lower concurrency",
-                            ranks=[0],
-                        )
-                    stats["draft_collapsed_steps"] += 1
-                for i, uid in enumerate(uids):
-                    if not live[i]:
-                        continue
-                    budget = max_new_tokens - len(outs[i])
-                    k = min(draft_len, budget - 1, per_seq - 1)
-                    draft = self._ngram_draft(hist[i] + [nxt[i]], ngram, k)
-                    # a full context drops the sequence (same contract
-                    # as generate(): stop rather than overflow the
-                    # block table)
-                    room = self.config.max_seq_len \
-                        - self.state.get(uid).seen_tokens
-                    if room < 1:
-                        live[i] = False
-                        continue
-                    lu.append(i)
-                    lc.append(np.asarray(
-                        [nxt[i]] + draft[:max(0, room - 1)], np.int32))
-                if not lu:
-                    break
-                stats["steps"] += 1
-                stats["verified_chunks"] += len(lc)
-                stats["draft_tokens"] += sum(len(c) - 1 for c in lc)
-                all_logits = self._verify_chunks([uids[i] for i in lu], lc)
-                for i, chunk, lg in zip(lu, lc, all_logits):
-                    # row j predicts the token AFTER chunk[:j+1]; accept
-                    # drafts while they match the greedy argmax chain
-                    accepted = 1
-                    while (accepted < len(chunk)
-                           and int(np.argmax(lg[accepted - 1]))
-                           == int(chunk[accepted])):
-                        accepted += 1
-                    stats["accepted_tokens"] += accepted
-                    self.state.commit(uids[i], accepted,
-                                      token_ids=[int(t)
-                                                 for t in chunk[:accepted]])
-                    new = [int(t) for t in chunk[:accepted]]
-                    outs[i].extend(new)
-                    hist[i].extend(new)
-                    nxt[i] = int(np.argmax(lg[accepted - 1]))
-                    if eos_token_id is not None and eos_token_id in new:
-                        outs[i] = outs[i][: outs[i].index(eos_token_id) + 1]
-                        live[i] = False
-                    elif len(outs[i]) >= max_new_tokens:
-                        outs[i] = outs[i][:max_new_tokens]
-                        live[i] = False
-        finally:
-            for uid in uids:
-                if self.state.get(uid) is not None:
-                    self.flush(uid)
+        sched = ServingScheduler(
+            self,
+            ServingSchedulerConfig(prefill_mode="wave", warmup=False),
+            seed=0,
+            speculative={"ngram": int(ngram),
+                         "draft_len": int(draft_len)})
+        rids = [sched.submit(list(p), max_new_tokens, eos_token_id,
+                             stream=i)
+                for i, p in enumerate(prompts)]
+        sched.run()
+        outs = [sched.finished[r].output for r in rids]
         if return_stats:
+            stats = dict(sched.spec_stats)
             stats["mean_accepted"] = (
                 stats["accepted_tokens"] / stats["verified_chunks"]
                 if stats["verified_chunks"] else 0.0)
@@ -1273,136 +1320,41 @@ class InferenceEngine:
         nucleus fits, which at serving temperatures it does.
 
         uids are allocated disjoint from in-flight sequences so calling
-        generate() never hijacks another caller's context."""
-        from .sampling import SamplingConfig, presence_from_prompts
+        generate() never hijacks another caller's context.
 
-        scfg = SamplingConfig(
-            do_sample=do_sample, temperature=temperature, top_k=top_k,
-            top_p=top_p, repetition_penalty=repetition_penalty)
+        Since the serving-scheduler PR this is a thin wrapper over
+        inference/scheduler.py ServingScheduler (prefill_mode='wave',
+        decode_chunk=chunk): one control plane serves batch generation
+        and online serving. Observable upgrades over the old loop: a
+        sequence hitting EOS/length is FLUSHED at the iteration it
+        finishes (its KV blocks rejoin the pool mid-batch instead of
+        stranding until the last sequence drains), more prompts than
+        max_batch_size queue instead of raising, and KV-block pressure
+        preempts the youngest sequence for recompute instead of
+        raising RuntimeError. Tokens are unchanged: draws are keyed by
+        (seed, stream=slot, position), independent of scheduling."""
+        from .scheduler import ServingScheduler, ServingSchedulerConfig
+
         seed_val = (int(np.random.default_rng().integers(2**31))
                     if seed is None else int(seed))
-        taken = set(self.state.tracked_uids)
-        uids, cand = [], 0
-        while len(uids) < len(prompts):
-            if cand not in taken:
-                uids.append(cand)
-            cand += 1
-        slot_of = {u: i for i, u in enumerate(uids)}
-        outs: List[List[int]] = [[] for _ in prompts]
-        V = self.cfg.vocab_size
-        pres = (presence_from_prompts(prompts, V, len(prompts))
-                if scfg.needs_presence else None)
-        skw = dict(do_sample=do_sample, temperature=temperature,
-                   top_k=top_k, top_p=top_p,
-                   repetition_penalty=repetition_penalty)
-
-        # prefill + first token (sampled on device). Streams key by SLOT
-        # index, not uid: uid allocation depends on what else is in
-        # flight, and a fixed seed must reproduce regardless (r4 review
-        # finding).
-        first = self.put(uids, [np.asarray(p, np.int32) for p in prompts],
-                         return_tokens=True, sampling=skw, seed=seed_val,
-                         presence=pres,
-                         sampling_streams=list(range(len(uids))))
-        pending = {u: int(first[slot_of[u]]) for u in uids}
-        live = list(uids)
-
-        def accept(u: int, tok: int) -> bool:
-            """Append tok; False once the sequence is finished."""
-            sl = slot_of[u]
-            outs[sl].append(tok)
-            if pres is not None:
-                pres[sl, tok] = 1
-            return not (
-                (eos_token_id is not None and tok == eos_token_id)
-                or len(outs[sl]) >= max_new_tokens
-            )
-
-        while live:
-            live = [u for u in live if accept(u, pending[u])]
-            live = [u for u in live
-                    if self.state.get(u).seen_tokens + 1
-                    < self.config.max_seq_len]
-            if not live:
-                break
-            # chunk size: bounded by every live sequence's remaining
-            # budget (output count and context capacity) so one compiled
-            # program serves the whole batch
-            C = min(
-                chunk,
-                min(max_new_tokens - len(outs[slot_of[u]]) for u in live),
-                min(self.config.max_seq_len - 1
-                    - self.state.get(u).seen_tokens for u in live),
-            )
-            if C <= 0:
-                break
-            if len(live) > self.config.max_batch_size:
-                raise RuntimeError(
-                    f"{len(live)} sequences > max_batch_size "
-                    f"{self.config.max_batch_size}"
-                )
-            if not self.can_schedule(live, [C + 1] * len(live)):
-                raise RuntimeError(
-                    "insufficient KV blocks to continue generation; "
-                    "raise num_kv_blocks or lower max_new_tokens"
-                )
-            width = _bucket(len(live), 8)
-            toks = np.zeros((width,), np.int32)
-            ctx = np.zeros((width,), np.int32)
-            steps = np.zeros((width,), np.int32)
-            row_streams = np.zeros((width,), np.uint32)
-            tables = np.full((width, self.config.blocks_per_seq),
-                             self.pad_block, np.int32)
-            pres_rows = (np.zeros((width, V), np.uint8)
-                         if pres is not None else None)
-            for r, u in enumerate(live):
-                base = self.state.get(u).seen_tokens
-                self.state.extend(u, C)
-                toks[r] = pending[u]
-                ctx[r] = base + 1
-                steps[r] = base + 1  # first in-chunk draw's position
-                row_streams[r] = slot_of[u]
-                if pres_rows is not None:
-                    pres_rows[r] = pres[slot_of[u]]
-            tables[: len(live)] = self.state.block_table(
-                live, self.config.blocks_per_seq, self.pad_block)
-            use_sampler = not (scfg.greedy and not scfg.needs_presence)
-            fn = self.decode_multi_fn(
-                width, C,
-                sampling=scfg if use_sampler else None,
-                with_presence=pres_rows is not None and use_sampler,
-            )
-            args = [self.params, self.cache, self._dev(toks),
-                    self._dev(tables), self._dev(ctx)]
-            if use_sampler:
-                args.append(self._row_keys(seed_val, row_streams))
-                args.append(self._dev(steps))
-                if pres_rows is not None:
-                    args.append(self._dev(pres_rows))
-            gen, _, self.cache, _ = fn(*args)
-            gen = np.asarray(gen)  # [C, width] — the only host transfer
-            for r, u in enumerate(live):
-                self.state.commit(u, C)
-            cont = []
-            for r, u in enumerate(live):
-                ok = True
-                for j in range(C - 1):
-                    if ok:
-                        ok = accept(u, int(gen[j, r]))
-                pending[u] = int(gen[C - 1, r])
-                if ok:
-                    cont.append(u)
-                # a sequence that finished mid-chunk wrote a few extra
-                # tokens into its own blocks — freed at flush below.
-                # Capacity is NOT re-filtered here: the loop top accepts
-                # each pending token first, then filters — dropping a
-                # capped sequence before that accept would eat its final
-                # sampled token (r4 review finding)
-            live = cont
-        for u in uids:
-            if self.state.get(u) is not None:
-                self.flush(u)
-        return outs
+        sched = ServingScheduler(
+            self,
+            ServingSchedulerConfig(
+                decode_chunk=max(1, int(chunk)),
+                prefill_mode="wave",
+                max_num_batched_tokens=max(
+                    self.config.max_batch_size,
+                    ServingSchedulerConfig().max_num_batched_tokens),
+                warmup=False),
+            sampling=dict(do_sample=do_sample, temperature=temperature,
+                          top_k=top_k, top_p=top_p,
+                          repetition_penalty=repetition_penalty),
+            seed=seed_val)
+        rids = [sched.submit(list(p), max_new_tokens, eos_token_id,
+                             stream=i)
+                for i, p in enumerate(prompts)]
+        sched.run()
+        return [sched.finished[r].output for r in rids]
 
 
 def init_inference(

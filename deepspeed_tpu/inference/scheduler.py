@@ -1,0 +1,879 @@
+"""Continuous-batching serving scheduler: the request-level control
+plane over the paged KV substrate.
+
+`InferenceEngine.generate()` is run-to-completion: a fixed prompt set
+prefills together and decodes until the LAST sequence finishes — new
+requests cannot join mid-flight and finished sequences hold their KV
+blocks until the batch drains. `ServingScheduler` replaces that with
+Orca-style iteration-level scheduling (ref: Orca OSDI'22 continuous
+batching; vLLM's scheduler; DeepSpeed-FastGen's DynamicSplitFuse /
+Sarathi-Serve's chunked-prefill piggybacking), built for XLA's
+static-shape world:
+
+- **admission** pops waiting requests whenever the KV pool fits their
+  (prefix-cache-credited) prompt — a prompt whose leading blocks hash-
+  match the prefix index admits at suffix cost only.
+- **chunked prefill interleaves with decode**: a newly admitted prompt
+  feeds through the decode path in `prefill_chunk` pieces, sharing ONE
+  compiled program with the running sequences' decode rows (the ragged
+  "virtual rows" put() already uses for continuations), bounded by the
+  per-iteration `max_num_batched_tokens` budget — a long prompt never
+  stalls another request's inter-token latency.
+- **immediate retirement**: a sequence hitting EOS/length is flushed at
+  the iteration it finishes; its blocks go straight back to the
+  allocator (or park in the prefix-cache LRU) instead of idling until
+  the batch drains.
+- **preemption over failure**: under KV-block pressure the YOUNGEST
+  sequence is preempted — flushed and re-queued for recompute — rather
+  than raising RuntimeError like strict put()/generate(). Recompute is
+  exact: sampling streams are keyed by (seed, stream, position), so a
+  recomputed sequence re-draws identical tokens; with the prefix cache
+  on, its own registered blocks usually make the re-prefill nearly
+  free.
+
+Performance comes from two pipelining layers:
+
+- **AOT-warmed shape buckets**: `engine.warmup()` precompiles the
+  (bucket width x chunk) decode/sample grid at init, so steady-state
+  serving triggers zero S003 recompiles (tracked by the engine's
+  always-on RecompileTracker; asserted in tests/test_scheduler.py).
+- **async double-buffered dispatch**: a dispatch is issued (JAX async),
+  then ALL host bookkeeping for the next iteration — commits, block
+  tables, token buffers, sampling streams — happens while the device
+  runs. In the steady pure-decode state the sampled-token array stays
+  DEVICE-RESIDENT: it feeds the next dispatch directly, and the host
+  readback of step N (token ids only, via utils.sync.serving_readback)
+  lands after step N+1 is already in flight. With `decode_chunk > 1`
+  the steady state additionally fuses decode_chunk steps into one
+  compiled program (model.decode_multi), amortizing dispatch entirely.
+
+`generate()` and `generate_speculative()` are thin wrappers over this
+scheduler (prefill_mode='wave', warmup off) — one control plane serves
+batch generation, speculative decoding, and online serving.
+"""
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..config.config import ServingSchedulerConfig
+from ..utils.logging import log_dist
+from ..utils.sync import serving_readback
+from .engine import InferenceEngine, _bucket
+
+__all__ = ["Request", "ServingScheduler", "ServingSchedulerConfig",
+           "SchedulerConfig"]
+
+# module-local alias: `scheduler.SchedulerConfig` reads naturally here,
+# while the pydantic model lives in config/config.py under a distinct
+# name (config.SchedulerConfig is the LR-schedule block, reference
+# schema — the two must not collide)
+SchedulerConfig = ServingSchedulerConfig
+
+WAITING, PREFILL, RUNNING, FINISHED = ("waiting", "prefill", "running",
+                                       "finished")
+
+
+@dataclasses.dataclass
+class Request:
+    """One serving request through its whole lifecycle."""
+
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    eos_token_id: Optional[int]
+    stream: int                      # sampling stream id (defaults to rid)
+    arrival: float                   # perf_counter() at submit
+    state: str = WAITING
+    uid: Optional[int] = None        # engine uid while admitted
+    fed: int = 0                     # base tokens already in the KV cache
+    output: List[int] = dataclasses.field(default_factory=list)
+    pending: Optional[int] = None    # sampled, not-yet-fed token
+    presence: Optional[np.ndarray] = None  # [V] uint8, rep-penalty only
+    first_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+    finish_reason: Optional[str] = None    # eos | length | capacity
+    preemptions: int = 0
+    n_cached: int = 0                # prefix-cache-served prompt tokens
+
+    @property
+    def base(self) -> List[int]:
+        """The token stream that must be in the cache before the next
+        draw: prompt + accepted output (recompute target after a
+        preemption — positions are absolute, so re-drawn tokens are
+        identical)."""
+        return self.prompt + self.output
+
+    @property
+    def done(self) -> bool:
+        return self.state == FINISHED
+
+
+class _Part:
+    """One dispatched compiled program of an iteration (a step may hold
+    several: prefill wave(s) + the mixed decode program)."""
+
+    def __init__(self, kind: str, sample_rows, tok_dev, n_steps: int = 1):
+        self.kind = kind              # wave | mixed | fused
+        self.sample_rows = sample_rows  # [(req, row_index)]
+        self.tok_dev = tok_dev        # [bucket] or [n_steps, bucket] int32
+        self.n_steps = n_steps
+
+
+class _Step:
+    def __init__(self, parts: List[_Part], n_tokens: int):
+        self.parts = parts
+        self.n_tokens = n_tokens      # batched tokens this iteration
+
+
+class ServingScheduler:
+    """Iteration-level scheduler driving one InferenceEngine.
+
+    sampling: SamplingConfig kwargs shared by every request (compiled
+    into the decode/sample programs; greedy when omitted); seed + each
+    request's stream id + token position define every draw, so outputs
+    are reproducible and independent of batch composition, preemption,
+    and arrival order. speculative={'ngram': n, 'draft_len': k} switches
+    running sequences to prompt-lookup self-speculation (greedy only;
+    the generate_speculative() control plane)."""
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        config: Union[ServingSchedulerConfig, Dict[str, Any], None] = None,
+        sampling: Optional[Dict[str, Any]] = None,
+        seed: int = 0,
+        speculative: Optional[Dict[str, int]] = None,
+    ):
+        from .sampling import SamplingConfig
+
+        self.engine = engine
+        if isinstance(config, dict):
+            config = ServingSchedulerConfig(**config)
+        self.cfg = config or ServingSchedulerConfig()
+        self.scfg = SamplingConfig(**(sampling or {}))
+        self.seed = int(seed)
+        self._spec = dict(speculative) if speculative else None
+        if self._spec and not self.scfg.greedy:
+            raise ValueError("speculative decoding is greedy-only")
+        self.waiting: "deque[Request]" = deque()
+        self.active: List[Request] = []   # admission order; PREFILL/RUNNING
+        self.finished: Dict[int, Request] = {}
+        self._next_rid = 0
+        self.counters: Dict[str, int] = {
+            "steps": 0, "admitted": 0, "finished": 0, "preemptions": 0,
+            "batched_tokens": 0, "fused_steps": 0, "chained_steps": 0,
+            "wave_prefills": 0,
+        }
+        self.spec_stats: Dict[str, float] = {
+            "steps": 0, "verified_chunks": 0, "draft_tokens": 0,
+            "accepted_tokens": 0, "draft_collapsed_steps": 0,
+            "mean_accepted": 0.0,
+        }
+        self._ttft: List[float] = []
+        self._tpot: List[float] = []
+        if self.cfg.warmup:
+            use_pres = self.scfg.needs_presence
+            chunks = ((self.cfg.decode_chunk,)
+                      if self.cfg.decode_chunk > 1 and not self._spec
+                      else ())
+            engine.warmup(sampling=sampling, decode_chunks=chunks,
+                          presence=use_pres)
+
+    # -- request intake --------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None,
+               stream: Optional[int] = None) -> int:
+        """Queue one request; returns its request id. The stream id
+        (default: the rid) keys the request's PRNG stream — generate()
+        passes 0..n-1 so a fixed seed reproduces its exact batch."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) > self.engine.config.max_seq_len:
+            raise ValueError(
+                f"prompt of {len(prompt)} > max_seq_len "
+                f"{self.engine.config.max_seq_len}")
+        rid = self._next_rid
+        self._next_rid += 1
+        req = Request(rid=rid, prompt=prompt,
+                      max_new_tokens=int(max_new_tokens),
+                      eos_token_id=eos_token_id,
+                      stream=int(stream) if stream is not None else rid,
+                      arrival=time.perf_counter())
+        if self.scfg.needs_presence:
+            pres = np.zeros((self.engine.cfg.vocab_size,), np.uint8)
+            toks = np.asarray(prompt, np.int64)
+            pres[toks[(toks >= 0) & (toks < pres.size)]] = 1
+            req.presence = pres
+        self.waiting.append(req)
+        return rid
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.active)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    # -- uid / capacity management ---------------------------------------
+    def _alloc_uid(self) -> int:
+        taken = set(self.engine.state.tracked_uids)
+        cand = 0
+        while cand in taken:
+            cand += 1
+        return cand
+
+    def _preempt(self, victim: Request) -> None:
+        """Flush the victim's KV blocks and re-queue it for recompute
+        (front of the queue: it has the oldest claim among preempted)."""
+        self.engine.state.flush(victim.uid)
+        victim.uid = None
+        victim.fed = 0
+        victim.pending = None
+        victim.state = WAITING
+        victim.preemptions += 1
+        self.counters["preemptions"] += 1
+        self.active.remove(victim)
+        self.waiting.appendleft(victim)
+
+    def _reserve(self, req: Request, n: int) -> bool:
+        """Reserve KV room for n more tokens of req, preempting the
+        youngest OTHER active sequence under pressure. Returns False
+        when req itself was preempted or finished (its row must be
+        dropped from this iteration)."""
+        while True:
+            try:
+                self.engine.state.extend(req.uid, n)
+                return True
+            except RuntimeError:
+                victim = self.active[-1]
+                if victim is req:
+                    if len(self.active) == 1:
+                        # alone and still does not fit: genuine capacity
+                        # exhaustion, not contention — finish truncated
+                        # instead of raising (the generate() behavior
+                        # this scheduler replaces)
+                        self._finish(req, "capacity")
+                        return False
+                    self._preempt(req)
+                    return False
+                self._preempt(victim)
+
+    def _finish(self, req: Request, reason: str) -> None:
+        """Retire NOW: blocks go back to the allocator at the iteration
+        the sequence finishes, not when the batch drains."""
+        if req.uid is not None and self.engine.state.get(req.uid) is not None:
+            self.engine.flush(req.uid)
+        req.uid = None
+        req.state = FINISHED
+        req.finish_reason = reason
+        req.finish_t = time.perf_counter()
+        if req in self.active:
+            self.active.remove(req)
+        self.finished[req.rid] = req
+        self.counters["finished"] += 1
+        if req.first_token_t is not None:
+            self._ttft.append(req.first_token_t - req.arrival)
+            if len(req.output) > 1:
+                self._tpot.append((req.finish_t - req.first_token_t)
+                                  / (len(req.output) - 1))
+
+    # -- admission -------------------------------------------------------
+    def _admit(self) -> None:
+        """Admit waiting requests while a slot and (prefix-cache-
+        credited) KV room exist. fcfs stops at the first misfit; skip
+        scans past it."""
+        eng = self.engine
+        scanned: List[Request] = []
+        while self.waiting:
+            if len(self.active) >= eng.config.max_batch_size:
+                break
+            req = self.waiting.popleft()
+            base = req.base
+            if len(base) > eng.config.max_seq_len:
+                # recompute target overfills the context window —
+                # nothing further can be drawn
+                self._finish(req, "length")
+                continue
+            uid = self._alloc_uid()
+            try:
+                _, match = eng.state.extend(uid, len(base), token_ids=base)
+            except RuntimeError:
+                if not self.active:
+                    # alone against an empty pool and still no fit: the
+                    # prompt needs more blocks than the cache holds —
+                    # permanent, not contention
+                    self._finish(req, "capacity")
+                    continue
+                if self.cfg.admission == "fcfs":
+                    self.waiting.appendleft(req)
+                    break
+                scanned.append(req)
+                continue
+            if match.cow is not None:
+                # shared full-match tail: clone the page before the
+                # recomputed last token writes into it
+                eng._copy_block(*match.cow)
+            req.uid = uid
+            req.fed = eng.state.get(uid).seen_tokens  # = match.n_cached
+            req.n_cached += match.n_cached
+            req.state = PREFILL
+            self.active.append(req)
+            self.counters["admitted"] += 1
+        for req in reversed(scanned):  # preserve arrival order
+            self.waiting.appendleft(req)
+
+    # -- dispatch construction -------------------------------------------
+    def _sample_part(self, logits_dev, sample_rows, bucket: int) -> Any:
+        """Device-side sampling epilogue over one dispatch's [bucket, V]
+        logits (mirrors put().sample_rows: one compiled program per
+        bucket width). Returns the device token array — NOT read back
+        here; the caller decides when the readback lands."""
+        eng, scfg = self.engine, self.scfg
+        streams = np.zeros((bucket,), np.uint32)
+        steps = np.zeros((bucket,), np.int32)
+        for req, row in sample_rows:
+            streams[row] = req.stream
+            # draw counter = the sampled token's POSITION = seen_tokens
+            # after this dispatch's commit (put()/generate() contract)
+            steps[row] = eng.state.get(req.uid).seen_tokens
+        keys = eng._row_keys(self.seed, streams)
+        if scfg.needs_presence:
+            V = self.engine.cfg.vocab_size
+            pres = np.zeros((bucket, V), np.uint8)
+            for req, row in sample_rows:
+                pres[row] = req.presence
+            eng.recompile_tracker.record(
+                f"serving_sample[w{bucket}]", (steps, pres))
+            return eng._sample_fn(scfg, True)(
+                logits_dev, keys, eng._dev(steps), eng._dev(pres))
+        eng.recompile_tracker.record(f"serving_sample[w{bucket}]", (steps,))
+        return eng._sample_fn(scfg, False)(logits_dev, keys,
+                                           eng._dev(steps))
+
+    def _dispatch_wave(self, reqs: List[Request]) -> List[_Part]:
+        """Whole-prompt prefill waves (put()'s grouped compiled waves):
+        blocks were reserved at admission; each wave is one program over
+        a (batch-bucket, token-bucket) and samples its last-token rows
+        on device."""
+        eng = self.engine
+        reqs = sorted(reqs, key=lambda r: len(r.base))
+        groups: Dict[int, List[Request]] = {}
+        for r in reqs:
+            groups.setdefault(
+                _bucket(len(r.base), eng.config.min_prefill_bucket), []
+            ).append(r)
+        cap = 1 << (eng.config.max_batch_size.bit_length() - 1)
+        waves = [g[w0:w0 + cap] for _, g in sorted(groups.items())
+                 for w0 in range(0, len(g), cap)]
+        parts: List[_Part] = []
+        for wave in waves:
+            tp = _bucket(max(len(r.base) for r in wave),
+                         eng.config.min_prefill_bucket)
+            bp = _bucket(len(wave), 1)
+            toks_b = np.zeros((bp, tp), np.int32)
+            n_real = np.zeros((bp,), np.int32)
+            tables = np.zeros((bp, eng.config.blocks_per_seq), np.int32)
+            for row, r in enumerate(wave):
+                base = r.base
+                toks_b[row, :len(base)] = base
+                n_real[row] = len(base)
+                tables[row] = eng.state.block_table(
+                    [r.uid], eng.config.blocks_per_seq)[0]
+            eng.recompile_tracker.record(
+                f"serving_prefill[b{bp},t{tp}]", (toks_b, n_real, tables))
+            logits, eng.cache = eng._prefill_batch_fn(bp, tp)(
+                eng.params, eng.cache, eng._dev(toks_b),
+                eng._dev(n_real), eng._dev(tables))
+            sample_rows = []
+            for row, r in enumerate(wave):
+                eng.state.commit(r.uid, len(r.base), token_ids=r.base)
+                r.fed = len(r.base)
+                r.state = RUNNING  # pending arrives at finalize
+                sample_rows.append((r, row))
+            tok_dev = self._sample_part(logits, sample_rows, bp)
+            parts.append(_Part("wave", sample_rows, tok_dev))
+            self.counters["wave_prefills"] += len(wave)
+            self.counters["batched_tokens"] += int(n_real.sum())
+        return parts
+
+    def _dispatch_mixed(self, rows) -> Optional[_Part]:
+        """One compiled decode program over the iteration's ragged rows:
+        1-token decode rows + multi-token prefill chunk rows (the
+        Sarathi piggyback). rows: [(req, chunk, sample)]."""
+        eng = self.engine
+        n_rows = sum(len(c) for _, c, _ in rows)
+        if n_rows == 0:
+            return None
+        sp = _bucket(n_rows, 8)
+        toks = np.zeros((sp,), np.int32)
+        ctx = np.zeros((sp,), np.int32)  # pad rows: ctx 0 = inert
+        tables = np.full((sp, eng.config.blocks_per_seq),
+                         eng.pad_block, np.int32)
+        sample_rows: List[Tuple[Request, int]] = []
+        row = 0
+        for req, chunk, sample in rows:
+            seq = eng.state.get(req.uid)
+            base_seen = seq.seen_tokens
+            table = eng.state.block_table(
+                [req.uid], eng.config.blocks_per_seq, eng.pad_block)[0]
+            for j, tok in enumerate(chunk):
+                toks[row] = int(tok)
+                ctx[row] = base_seen + j + 1
+                tables[row] = table
+                row += 1
+            if sample:
+                sample_rows.append((req, row - 1))
+        unique = all(len(c) == 1 for _, c, _ in rows)
+        eng.recompile_tracker.record(
+            f"serving_decode[w{sp},u{int(unique)}]", (toks, tables, ctx))
+        logits, eng.cache = eng._decode_fn(sp, unique)(
+            eng.params, eng.cache, eng._dev(toks), eng._dev(tables),
+            eng._dev(ctx))
+        # host bookkeeping overlaps the in-flight device program
+        for req, chunk, sample in rows:
+            eng.state.commit(req.uid, len(chunk),
+                             token_ids=[int(t) for t in chunk])
+            if req.state == PREFILL:
+                req.fed += len(chunk)
+                if req.fed == len(req.base):
+                    req.state = RUNNING
+        # mid-prompt chunks produce no token: skip the sample epilogue
+        tok_dev = (self._sample_part(logits, sample_rows, sp)
+                   if sample_rows else None)
+        self.counters["batched_tokens"] += n_rows
+        return _Part("mixed", sample_rows, tok_dev)
+
+    def _dispatch_fused(self, running: List[Request], C: int) -> _Part:
+        """Steady-state fused decode: C steps per compiled program
+        (model.decode_multi) — sampled tokens never leave the device
+        between the C steps; one [C, width] readback per chunk."""
+        eng, scfg = self.engine, self.scfg
+        width = _bucket(len(running), 8)
+        toks = np.zeros((width,), np.int32)
+        ctx = np.zeros((width,), np.int32)
+        steps = np.zeros((width,), np.int32)
+        streams = np.zeros((width,), np.uint32)
+        tables = np.full((width, eng.config.blocks_per_seq),
+                         eng.pad_block, np.int32)
+        V = eng.cfg.vocab_size
+        use_sampler = not (scfg.greedy and not scfg.needs_presence)
+        pres_rows = (np.zeros((width, V), np.uint8)
+                     if scfg.needs_presence and use_sampler else None)
+        sample_rows = []
+        for r, req in enumerate(running):
+            seq = eng.state.get(req.uid)
+            base = seq.seen_tokens
+            eng.state.extend(req.uid, C)  # capacity pre-checked by caller
+            toks[r] = req.pending
+            ctx[r] = base + 1
+            steps[r] = base + 1  # first in-chunk draw's position
+            streams[r] = req.stream
+            if pres_rows is not None:
+                pres_rows[r] = req.presence
+            sample_rows.append((req, r))
+        tables[:len(running)] = eng.state.block_table(
+            [r.uid for r in running], eng.config.blocks_per_seq,
+            eng.pad_block)
+        eng.recompile_tracker.record(
+            f"serving_fused[w{width},c{C}]", (toks, tables, ctx, steps))
+        fn = eng.decode_multi_fn(
+            width, C, sampling=scfg if use_sampler else None,
+            with_presence=pres_rows is not None)
+        args = [eng.params, eng.cache, eng._dev(toks), eng._dev(tables),
+                eng._dev(ctx)]
+        if use_sampler:
+            args.append(eng._row_keys(self.seed, streams))
+            args.append(eng._dev(steps))
+            if pres_rows is not None:
+                args.append(eng._dev(pres_rows))
+        gen, _, eng.cache, _ = fn(*args)
+        for req in running:
+            eng.state.commit(req.uid, C)
+        self.counters["batched_tokens"] += len(running) * C
+        self.counters["fused_steps"] += 1
+        return _Part("fused", sample_rows, gen, n_steps=C)
+
+    # -- the scheduling iteration ----------------------------------------
+    def _fused_depth(self, running: List[Request]) -> int:
+        """How many fused steps the steady state supports (0 = use the
+        mixed single-step program)."""
+        if self.cfg.decode_chunk < 2 or self._spec or not running:
+            return 0
+        if any(r.state != RUNNING for r in self.active):
+            return 0  # prefill in flight: keep chunks interleaving
+        eng = self.engine
+        C = min(
+            self.cfg.decode_chunk,
+            min(r.max_new_tokens - len(r.output) for r in running),
+            min(eng.config.max_seq_len - 1
+                - eng.state.get(r.uid).seen_tokens for r in running),
+        )
+        if C < 2:
+            return 0
+        if not eng.can_schedule([r.uid for r in running],
+                                [C + 1] * len(running)):
+            return 0  # pressure: step singly, preempting as needed
+        return C
+
+    def _dispatch(self) -> Optional[_Step]:
+        """Build and launch one iteration; returns None when idle.
+        Host-side state (commits, next tables) is updated after the
+        async launch, overlapping the device program."""
+        self._admit()
+        if not self.active:
+            return None
+        self.counters["steps"] += 1
+        if self._spec:
+            return self._dispatch_spec()
+        running = [r for r in self.active if r.state == RUNNING]
+        prefill = [r for r in self.active if r.state == PREFILL]
+        C = self._fused_depth(running)
+        if C:
+            return _Step([self._dispatch_fused(running, C)],
+                         len(running) * C)
+        parts: List[_Part] = []
+        if prefill and self.cfg.prefill_mode == "wave":
+            wave = [r for r in prefill if r.fed == 0]
+            if wave:
+                parts.extend(self._dispatch_wave(wave))
+                prefill = [r for r in prefill if r.state == PREFILL]
+        budget = self.cfg.max_num_batched_tokens
+        row_budget = self.engine.config.max_batch_size
+        rows: List[Tuple[Request, List[int], bool]] = []
+        for req in list(running):  # oldest first; preemption takes youngest
+            if budget < 1 or row_budget < 1:
+                break
+            if req.state != RUNNING:
+                continue  # preempted/finished while reserving earlier rows
+            if not self._reserve(req, 1):
+                continue
+            rows.append((req, [req.pending], True))
+            budget -= 1
+            row_budget -= 1
+        for req in prefill:
+            if budget < 1 or row_budget < 1:
+                break
+            if req.state != PREFILL:
+                continue  # preempted while reserving decode rows
+            remaining = req.base[req.fed:]
+            c = min(self.cfg.prefill_chunk, budget, row_budget,
+                    len(remaining))
+            if c < 1:
+                continue
+            chunk = remaining[:c]
+            rows.append((req, chunk, req.fed + c == len(req.base)))
+            budget -= c
+            row_budget -= c
+        part = self._dispatch_mixed(rows)
+        if part is not None:
+            parts.append(part)
+        if not parts:
+            return None
+        return _Step(parts, sum(len(c) for _, c, _ in rows))
+
+    # -- finalize: readback + accept + retire ----------------------------
+    def _accept(self, req: Request, tok: int, now: float) -> None:
+        """Mirror generate()'s accept: append, then finish on EOS /
+        output budget / context capacity — retiring immediately."""
+        if req.first_token_t is None:
+            req.first_token_t = now
+        req.output.append(tok)
+        if req.presence is not None and 0 <= tok < req.presence.size:
+            req.presence[tok] = 1
+        if req.eos_token_id is not None and tok == req.eos_token_id:
+            self._finish(req, "eos")
+            return
+        if len(req.output) >= req.max_new_tokens:
+            self._finish(req, "length")
+            return
+        seq = self.engine.state.get(req.uid)
+        if seq.seen_tokens + 1 >= self.engine.config.max_seq_len:
+            self._finish(req, "length")
+            return
+        req.pending = tok
+        req.state = RUNNING
+
+    def _finalize(self, step: _Step) -> None:
+        for part in step.parts:
+            if part.tok_dev is None:
+                continue  # mid-prompt prefill chunks: nothing sampled
+            toks = serving_readback(part.tok_dev)
+            now = time.perf_counter()
+            if part.kind == "fused":
+                # gen [C, width]: distribute each row's chunk in order,
+                # stopping at the first finish (generate()'s mid-chunk
+                # EOS contract — later tokens in the row are discarded)
+                for req, r in part.sample_rows:
+                    if req.done:
+                        continue
+                    for j in range(part.n_steps):
+                        self._accept(req, int(toks[j, r]), now)
+                        if req.done:
+                            break
+            else:
+                for req, row in part.sample_rows:
+                    if req.done:
+                        continue  # chained lookahead of a retired row
+                    self._accept(req, int(toks[row]), now)
+
+    # -- speculative iteration (generate_speculative control plane) ------
+    def _dispatch_spec(self) -> Optional[_Step]:
+        """Prompt-lookup self-speculation under scheduler lifecycle:
+        prefill via waves, then each iteration verifies
+        [pending + drafts] chunks through engine._verify_chunks and
+        accepts the greedy-consistent prefix. Synchronous per step (the
+        verification IS a host decision), so no _Part machinery."""
+        eng = self.engine
+        prefill = [r for r in self.active if r.state == PREFILL]
+        if prefill:
+            # whole prompts through compiled waves; prefix-cache-hit
+            # suffixes (fed > 0) through chunked decode rows
+            parts: List[_Part] = []
+            wave = [r for r in prefill if r.fed == 0]
+            if wave:
+                parts.extend(self._dispatch_wave(wave))
+            rows = []
+            row_budget = eng.config.max_batch_size
+            for req in prefill:
+                if req.state != PREFILL or row_budget < 1:
+                    continue
+                remaining = req.base[req.fed:]
+                c = min(len(remaining), row_budget)
+                rows.append((req, remaining[:c],
+                             req.fed + c == len(req.base)))
+                row_budget -= c
+            part = self._dispatch_mixed(rows)
+            if part is not None:
+                parts.append(part)
+            return _Step(parts, sum(len(r.base) for r in prefill))
+        running = [r for r in self.active if r.state == RUNNING]
+        if not running:
+            return None
+        ngram = int(self._spec.get("ngram", 3))
+        draft_len = int(self._spec.get("draft_len", 4))
+        n_live = len(running)
+        per_seq = max(1, eng.config.max_batch_size // n_live)
+        st = self.spec_stats
+        if per_seq == 1 and draft_len > 0:
+            if st["draft_collapsed_steps"] == 0:
+                log_dist(
+                    "speculative serving: max_batch_size "
+                    f"{eng.config.max_batch_size} // {n_live} live "
+                    "sequences leaves no draft rows (per_seq=1, k=0); "
+                    "speculation is running as plain decode — raise "
+                    "max_batch_size or lower concurrency",
+                    ranks=[0],
+                )
+            st["draft_collapsed_steps"] += 1
+        chunks: List[Tuple[Request, np.ndarray]] = []
+        for req in list(running):
+            if req.state != RUNNING:
+                continue  # preempted while reserving earlier chunks
+            # output includes the pending (undrafted) token, so the
+            # draft budget is max_new - len(output) further tokens
+            budget = req.max_new_tokens - len(req.output)
+            k = min(draft_len, budget, per_seq - 1)
+            # history INCLUDING the pending token drafts the continuation
+            draft = eng._ngram_draft(req.base, ngram, k)
+            room = eng.config.max_seq_len \
+                - eng.state.get(req.uid).seen_tokens
+            if room < 1:
+                self._finish(req, "length")
+                continue
+            chunk = np.asarray([req.pending] + draft[:max(0, room - 1)],
+                               np.int32)
+            if not self._reserve(req, len(chunk)):
+                continue
+            chunks.append((req, chunk))
+        if not chunks:
+            return None
+        st["steps"] += 1
+        st["verified_chunks"] += len(chunks)
+        st["draft_tokens"] += sum(len(c) - 1 for _, c in chunks)
+        all_logits = eng._verify_chunks([r.uid for r, _ in chunks],
+                                        [c for _, c in chunks])
+        now = time.perf_counter()
+        for (req, chunk), lg in zip(chunks, all_logits):
+            accepted = 1
+            while (accepted < len(chunk)
+                   and int(np.argmax(lg[accepted - 1]))
+                   == int(chunk[accepted])):
+                accepted += 1
+            st["accepted_tokens"] += accepted
+            eng.state.commit(req.uid, accepted,
+                             token_ids=[int(t) for t in chunk[:accepted]])
+            # chunk[0] == pending == output[-1]: the newly ACCEPTED
+            # tokens are chunk[1:accepted] plus the next committed draw
+            for t in [int(t) for t in chunk[1:accepted]] \
+                    + [int(np.argmax(lg[accepted - 1]))]:
+                self._accept(req, t, now)
+                if req.done:
+                    break
+        self.counters["batched_tokens"] += sum(len(c) for _, c in chunks)
+        return _Step([], 0)  # already finalized (host verification)
+
+    # -- public driving --------------------------------------------------
+    def step(self) -> bool:
+        """One scheduling iteration (dispatch + finalize). Returns False
+        when there was nothing to do."""
+        st = self._dispatch()
+        if st is None:
+            return False
+        self._finalize(st)
+        return True
+
+    def _can_chain(self, step: _Step) -> bool:
+        """May the NEXT iteration consume this step's device-resident
+        sampled tokens directly (no host round trip between them)?
+        Steady pure-decode only: one mixed part whose rows all keep
+        decoding with >= 2 tokens of budget, no queue/prefill activity,
+        no presence coupling (the bitmap update needs the host token),
+        and a single-device engine (a committed device array would
+        re-specialize the mesh program)."""
+        if self._spec or self.scfg.needs_presence:
+            return False
+        if self.engine.mesh is not None:
+            return False
+        if self.waiting or len(step.parts) != 1:
+            return False
+        part = step.parts[0]
+        if part.kind != "mixed":
+            return False
+        if len(part.sample_rows) != len(self.active):
+            return False
+        # the token array feeds the next dispatch POSITIONALLY: row i of
+        # the chained step reads tok_dev[i], so the previous step must
+        # have sampled row i at index i (pure decode steps do; the step
+        # that finished a prefill chunk samples at the chunk-end row)
+        if any(row != i for i, (_, row) in enumerate(part.sample_rows)):
+            return False
+        if part.tok_dev.shape[0] != _bucket(max(len(part.sample_rows), 1), 8):
+            return False
+        eng = self.engine
+        for req, _ in part.sample_rows:
+            if req.state != RUNNING or req.eos_token_id is not None:
+                return False
+            if len(req.output) + 2 > req.max_new_tokens:
+                return False
+            seq = eng.state.get(req.uid)
+            if seq is None or seq.seen_tokens + 2 >= eng.config.max_seq_len:
+                return False
+        return True
+
+    def _dispatch_chained(self, prev: _Step) -> Optional[_Step]:
+        """Launch the next pure-decode iteration feeding prev's sampled
+        tokens DEVICE-RESIDENT (the [bucket] array is the next token
+        input; prev's host readback lands after this launch). Commits
+        carry no token ids (the host has not seen them yet). Returns
+        None when a row's block reservation forced a composition change
+        (caller falls back to finalize-then-dispatch)."""
+        eng = self.engine
+        part = prev.parts[0]
+        rows = [req for req, _ in part.sample_rows]
+        sp = part.tok_dev.shape[0]
+        for req in rows:
+            try:
+                eng.state.extend(req.uid, 1)
+            except RuntimeError:
+                return None  # pressure: resolve via the normal path
+        ctx = np.zeros((sp,), np.int32)
+        tables = np.full((sp, eng.config.blocks_per_seq),
+                         eng.pad_block, np.int32)
+        sample_rows = []
+        for r, req in enumerate(rows):
+            seq = eng.state.get(req.uid)
+            ctx[r] = seq.seen_tokens + 1
+            tables[r] = eng.state.block_table(
+                [req.uid], eng.config.blocks_per_seq, eng.pad_block)[0]
+            sample_rows.append((req, r))
+        eng.recompile_tracker.record(
+            f"serving_decode[w{sp},u1]",
+            (np.zeros((sp,), np.int32), tables, ctx))
+        logits, eng.cache = eng._decode_fn(sp, True)(
+            eng.params, eng.cache, part.tok_dev, eng._dev(tables),
+            eng._dev(ctx))
+        for req in rows:
+            eng.state.commit(req.uid, 1)  # token device-resident: no ids
+        tok_dev = self._sample_part(logits, sample_rows, sp)
+        self.counters["steps"] += 1
+        self.counters["batched_tokens"] += len(rows)
+        self.counters["chained_steps"] += 1
+        return _Step([_Part("mixed", sample_rows, tok_dev)], len(rows))
+
+    def run(self, tick=None) -> None:
+        """Drive until idle. tick(scheduler), when given, runs once per
+        iteration before admission — the arrival-injection hook the
+        serving simulator uses. The loop is double-buffered: in the
+        steady pure-decode state iteration N+1 is dispatched on N's
+        device-resident tokens BEFORE N's readback."""
+        prev: Optional[_Step] = None
+        stalls = 0
+        while True:
+            if tick is not None:
+                tick(self)
+            if prev is not None and not self.waiting \
+                    and self._can_chain(prev):
+                nxt = self._dispatch_chained(prev)
+                self._finalize(prev)  # readback overlaps nxt's compute
+                prev = nxt
+                continue
+            if prev is not None:
+                self._finalize(prev)
+                prev = None
+            st = self._dispatch()
+            if st is None:
+                if not self.has_work:
+                    break
+                # every active sequence was preempted/finished this
+                # iteration: the next _admit makes progress (freed
+                # blocks) or capacity-finishes — a third idle pass
+                # with work pending is a scheduler bug, not pressure
+                stalls += 1
+                if stalls > 2:
+                    raise RuntimeError(
+                        "serving scheduler stalled with work pending "
+                        f"({len(self.waiting)} waiting)")
+                continue
+            stalls = 0
+            if st.parts:
+                prev = st
+        if prev is not None:
+            self._finalize(prev)
+
+    # -- observability ---------------------------------------------------
+    def metrics(self) -> Dict[str, float]:
+        """Flat float counters for the monitor sinks
+        (monitor.serving_events): TTFT/TPOT percentiles (ms, host wall
+        time over finished requests), queue depth, preemptions, and the
+        engine recompile count."""
+        def pct(xs, q):
+            return float(np.percentile(np.asarray(xs), q) * 1e3) if xs \
+                else 0.0
+
+        m: Dict[str, float] = {
+            "queue_depth": float(len(self.waiting)),
+            "active": float(len(self.active)),
+            "ttft_p50_ms": pct(self._ttft, 50),
+            "ttft_p95_ms": pct(self._ttft, 95),
+            "tpot_p50_ms": pct(self._tpot, 50),
+            "tpot_p95_ms": pct(self._tpot, 95),
+            "recompiles": float(len(self.engine.recompile_tracker.findings)),
+        }
+        for k, v in self.counters.items():
+            m[k] = float(v)
+        if self.counters["steps"]:
+            m["batched_tokens_per_step"] = (
+                self.counters["batched_tokens"] / self.counters["steps"])
+        if self._spec:
+            vc = self.spec_stats["verified_chunks"]
+            self.spec_stats["mean_accepted"] = (
+                self.spec_stats["accepted_tokens"] / vc if vc else 0.0)
+            for k, v in self.spec_stats.items():
+                m[f"spec_{k}"] = float(v)
+        return m

@@ -3,4 +3,5 @@ from .monitor import (
     MonitorMaster,
     TensorBoardMonitor,
     inference_cache_events,
+    serving_events,
 )

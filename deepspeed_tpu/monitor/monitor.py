@@ -102,6 +102,22 @@ def inference_cache_events(engine, step: int,
             for name, value in sorted(stats.items())]
 
 
+def serving_events(scheduler, step: int,
+                   prefix: str = "inference/serving") -> List[Event]:
+    """Turn a ServingScheduler's counters into monitor events (same
+    contract as inference_cache_events):
+
+        monitor.write_events(serving_events(scheduler, step))
+
+    Emits host-timed TTFT/TPOT percentiles (ms), queue depth, active
+    sequences, admitted/finished/preempted request counts, batched
+    tokens per iteration, and the engine's recompile-finding count
+    under `prefix`/<name> (inference/scheduler.py metrics())."""
+    metrics = scheduler.metrics()
+    return [(f"{prefix}/{name}", float(value), step)
+            for name, value in sorted(metrics.items())]
+
+
 class MonitorMaster(Monitor):
     """Fan-out to all configured sinks (ref: monitor/monitor.py:29)."""
 

@@ -13,7 +13,7 @@ from typing import Any
 import jax
 import numpy as np
 
-__all__ = ["host_sync", "host_readback"]
+__all__ = ["host_sync", "host_readback", "serving_readback"]
 
 
 def host_sync(tree: Any) -> Any:
@@ -21,6 +21,17 @@ def host_sync(tree: Any) -> Any:
     return it. The allowlisted R002 helper: use at trial/run boundaries
     (comm/bench.py, scripts/profile_*.py), never inside a step loop."""
     return jax.block_until_ready(tree)  # ds-lint: ok R002 the choke point
+
+
+def serving_readback(x: Any) -> np.ndarray:
+    """The serving scheduler's ONE per-iteration host readback: sampled
+    token ids ([bucket] or [chunk, bucket] int32) of an in-flight
+    dispatch (inference/scheduler.py). R002-allowlisted because the
+    loop is double-buffered: the readback of step N is issued AFTER
+    step N+1's dispatch whenever composition allows, so the device
+    pipeline never idles on it — and what crosses the link is token
+    ids, never [batch, vocab] logits."""
+    return np.asarray(jax.device_get(x))  # ds-lint: ok R002 the serving choke point
 
 
 def host_readback(tree: Any) -> np.ndarray:

@@ -25,6 +25,13 @@ from deepspeed_tpu.ops.pallas.paged_attention import (
 # interpreter-/compile-heavy: excluded from the fast lane (-m 'not slow')
 pytestmark = pytest.mark.slow
 
+# offload parking tier: pinned_host where the backend has distinct
+# memory spaces; backends without them (CPU, jax 0.4.x) fall back to
+# the default host memory (platform-compat fallback since the static-
+# analysis PR) — a wrongly DEVICE-resident weight still fails either
+# way (TPU device memory reports 'device')
+_HOST_TIERS = ("pinned_host", "unpinned_host")
+
 
 class TestBlockedAllocator:
     def test_allocate_free_roundtrip(self):
@@ -665,7 +672,7 @@ class TestZeroInferenceOffload:
         _, plain, off = self._pair(rng)
         for lp in off.params["layers"]:
             for w in jax.tree.leaves(lp):
-                assert w.sharding.memory_kind == "pinned_host"
+                assert w.sharding.memory_kind in _HOST_TIERS
         assert off.params["embed"].sharding.memory_kind != "pinned_host"
 
     def test_matches_resident_engine(self, rng):
@@ -696,7 +703,7 @@ class TestZeroInferenceOffload:
 
         lp0 = off8.params["layers"][0]
         assert isinstance(lp0["w_qkv"], ChannelQuantWeight)
-        assert lp0["w_qkv"].q.sharding.memory_kind == "pinned_host"
+        assert lp0["w_qkv"].q.sharding.memory_kind in _HOST_TIERS
         prompts = [list(rng.integers(0, 128, 6))]
         out = off8.generate(prompts, max_new_tokens=5)
         assert len(out[0]) == 5
@@ -1427,7 +1434,7 @@ class TestTPOffloadServing:
             offload={"device": "cpu"})
         lp0 = off.params["layers"][0]
         assert "wq" in lp0  # TP keeps projections unfused
-        assert lp0["wq"].sharding.memory_kind == "pinned_host"
+        assert lp0["wq"].sharding.memory_kind in _HOST_TIERS
         # head-dim sharded over 'model'
         assert "model" in str(lp0["wq"].sharding.spec)
         prompts = [np.asarray(rng.integers(0, 128, 9), np.int32)]

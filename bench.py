@@ -235,6 +235,12 @@ def _serving_sim():
             "new_signatures_after_warmup": int(new_sigs),
             "prefix_cache_hits": int(
                 eng.prefix_cache_stats()["lookup_hits"]),
+            # warmup-time static footprint per decode bucket (analysis/
+            # costmodel via engine.warmup) — the S004 admission inputs
+            "hbm_per_bucket_mb": {
+                str(w): round(fp["peak_hbm_bytes"] / 2**20, 2)
+                for w, fp in sorted(eng.warmup_footprints.items())},
+            "budget_findings": len(sched.budget_report.findings),
         },
         "static": {
             "goodput_rps": round(goodput_static, 2),

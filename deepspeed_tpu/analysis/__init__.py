@@ -1,12 +1,17 @@
 """Static analysis for compiled TPU programs and the codebase itself.
 
-Two prongs (see docs/static_analysis.md):
+Three prongs (see docs/static_analysis.md):
 
   sanitizer — ground-truth checks on compiled/lowered artifacts:
               donation aliasing (S001), PartitionSpec survival (S002),
               recompilation-hazard classification (S003). Run against a
               live engine with `engine.sanitize(batch)`.
-  lint      — `ds-lint`, an AST pass with project rules R001-R004
+  costmodel — compile-time cost predictions over the same artifacts:
+              per-device HBM budget (S004), collective-volume blowups
+              and baseline regressions (S005), roofline balance (S006).
+              Baselines persist to MEMBUDGET.json
+              (`python scripts/ds_budget.py --capture / --check`).
+  lint      — `ds-lint`, an AST pass with project rules R001-R005
               (`python scripts/ds_lint.py --strict`).
 """
 
@@ -16,6 +21,18 @@ from .sanitizer import (
     abstract_signature,
     check_donation,
     check_sharding,
+)
+from .costmodel import (
+    ICI_GBPS,
+    CostReport,
+    build_cost_report,
+    check_against_baseline,
+    check_collective_volume,
+    check_hbm_budget,
+    check_roofline,
+    load_baseline,
+    roofline,
+    save_baseline,
 )
 from .lint import lint_paths, lint_source, RULES
 
@@ -28,6 +45,16 @@ __all__ = [
     "abstract_signature",
     "check_donation",
     "check_sharding",
+    "ICI_GBPS",
+    "CostReport",
+    "build_cost_report",
+    "check_against_baseline",
+    "check_collective_volume",
+    "check_hbm_budget",
+    "check_roofline",
+    "load_baseline",
+    "roofline",
+    "save_baseline",
     "lint_paths",
     "lint_source",
     "RULES",

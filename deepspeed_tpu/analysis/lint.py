@@ -20,6 +20,12 @@ Rules
         (methods named `*_locked` are lock-held by convention)
   R004  `jax.jit(..., donate_argnums=...)` with no nearby comment
         explaining the aliasing story and no sanitizer check call
+  R005  `jnp.array`/`jnp.asarray`/`jnp.full` of a bare Python
+        scalar/list WITHOUT an explicit dtype inside a jit-root body —
+        the constant is weakly typed, so its dtype follows the
+        promotion context instead of being pinned; the same expression
+        hoisted to the call boundary is the exact python-scalar-
+        promotion recompile class S003 catches dynamically
 
 Pragma: `# ds-lint: ok` suppresses every rule on that line (or the line
 below a standalone pragma comment); `# ds-lint: ok R002 <reason>`
@@ -42,6 +48,8 @@ RULES = {
     "R002": "host sync inside an engine step/decode hot path",
     "R003": "unlocked mutation of shared state in a threaded class",
     "R004": "donate_argnums without an aliasing note",
+    "R005": "weak-typed literal constant (jnp.array of a python "
+            "scalar/list, no dtype) inside a jitted body",
 }
 
 _PRAGMA_RE = re.compile(
@@ -235,6 +243,62 @@ def _check_r001(ctx: _Ctx, root: ast.AST, callbacks: Set[ast.AST]) -> None:
                 "use jnp casts (x.astype / jnp.asarray) in-graph, or move "
                 "the conversion outside the compiled function",
             )
+
+
+# ----------------------------------------------------------------------
+# R005: weak-typed literal constants in jit bodies
+# ----------------------------------------------------------------------
+
+# jnp constructors whose FIRST (or for full, second) argument is a value
+# that becomes a weakly-typed constant when given as a python literal
+_WEAK_CONST_FNS = ("array", "asarray", "full")
+_JNP_PREFIXES = ("jnp", "jax.numpy")
+
+
+def _is_py_literal(node: ast.AST) -> bool:
+    """A bare python scalar literal (or list/tuple of them), including
+    negated forms like -1.0."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (bool, int, float)) and not \
+            isinstance(node.value, str)
+    if isinstance(node, ast.UnaryOp) and isinstance(
+            node.op, (ast.USub, ast.UAdd)):
+        return _is_py_literal(node.operand)
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return bool(node.elts) and all(_is_py_literal(e) for e in node.elts)
+    return False
+
+
+def _check_r005(ctx: _Ctx, root: ast.AST, callbacks: Set[ast.AST]) -> None:
+    skip: Set[ast.AST] = set()
+    for cb in callbacks:
+        skip.update(ast.walk(cb))
+    for node in ast.walk(root):
+        if node in skip or not isinstance(node, ast.Call):
+            continue
+        callee = _dotted(node.func)
+        parts = callee.rsplit(".", 1)
+        if len(parts) != 2 or parts[1] not in _WEAK_CONST_FNS or \
+                parts[0] not in _JNP_PREFIXES:
+            continue
+        # jnp.full(shape, value): the VALUE is the weak-type carrier
+        vpos = 1 if parts[1] == "full" else 0
+        if len(node.args) <= vpos or not _is_py_literal(node.args[vpos]):
+            continue
+        if any(kw.arg == "dtype" for kw in node.keywords):
+            continue
+        ctx.emit(
+            "R005", node,
+            f"{callee}() of a bare Python literal without an explicit "
+            "dtype inside a jitted body — the constant is weakly typed, "
+            "its dtype follows the promotion context (x64 flags, "
+            "neighboring operands), and the hoisted form of this "
+            "expression is the S003 python-scalar-promotion recompile "
+            "class",
+            "pin the dtype (jnp.array(v, dtype=...)) or fold the "
+            "literal into an existing typed expression",
+            severity="warning",
+        )
 
 
 # ----------------------------------------------------------------------
@@ -460,6 +524,7 @@ def lint_source(source: str, relpath: str) -> Tuple[List[Finding],
     roots, callbacks = _collect_jit_roots(tree)
     for root in roots:
         _check_r001(ctx, root, callbacks)
+        _check_r005(ctx, root, callbacks)
     _check_r002(ctx, tree)
     _check_r003(ctx, tree)
     _check_r004(ctx, tree)

@@ -15,7 +15,8 @@ from typing import Dict, List
 class Finding:
     """One static-analysis finding.
 
-    rule: "R001".."R004" for ds-lint, "S001".."S003" for the sanitizer
+    rule: "R001".."R005" for ds-lint, "S001".."S006" for the
+          sanitizer/cost model
     path: file path (lint) or program/parameter label (sanitizer)
     line: 1-based source line (0 when the finding has no source anchor)
     severity: "error" | "warning" | "info"
@@ -57,15 +58,23 @@ class _Report:
 
 @dataclasses.dataclass
 class SanitizerReport(_Report):
-    """Findings from the graph sanitizer over one compiled program."""
+    """Findings from the graph sanitizer over one compiled program.
+
+    `cost` carries the program's static CostReport (analysis/costmodel)
+    when the producing check built one — engine.sanitize() attaches it
+    so callers read footprint/comm numbers from the same object that
+    gates CI."""
 
     label: str = ""
+    cost: object = None  # Optional[costmodel.CostReport]
 
     def render(self) -> str:
         head = f"sanitizer[{self.label or 'program'}]: "
-        if not self.findings:
-            return head + "clean"
-        return head + f"{len(self.findings)} finding(s)\n" + super().render()
+        body = ("clean" if not self.findings
+                else f"{len(self.findings)} finding(s)\n" + super().render())
+        if self.cost is not None:
+            body += "\n" + self.cost.render()
+        return head + body
 
 
 @dataclasses.dataclass

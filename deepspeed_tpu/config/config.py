@@ -250,7 +250,11 @@ class ServingSchedulerConfig(ConfigModel):
     cross-prompt prefill waves (the generate() parity path).
     warmup: AOT-precompile the (bucket width x chunk) decode/sample
     grid at scheduler construction so steady-state serving triggers
-    zero recompiles (engine.warmup)."""
+    zero recompiles (engine.warmup).
+    hbm_budget_gb: per-device HBM budget the warmup-measured bucket
+    footprints are validated against at admit-config time (analysis/
+    costmodel S004); 0 = auto from the running chip
+    (platform/accelerator.py hbm_per_device)."""
 
     max_num_batched_tokens: int = 256
     prefill_chunk: int = 32
@@ -258,6 +262,7 @@ class ServingSchedulerConfig(ConfigModel):
     admission: str = "fcfs"
     prefill_mode: str = "chunked"
     warmup: bool = True
+    hbm_budget_gb: float = 0.0
 
     @model_validator(mode="after")
     def _check(self):
@@ -275,6 +280,8 @@ class ServingSchedulerConfig(ConfigModel):
             raise ValueError("decode_chunk must be >= 1")
         if self.max_num_batched_tokens < 1:
             raise ValueError("max_num_batched_tokens must be >= 1")
+        if self.hbm_budget_gb < 0:
+            raise ValueError("hbm_budget_gb must be >= 0 (0 = auto)")
         return self
 
 

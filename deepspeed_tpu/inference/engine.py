@@ -341,6 +341,9 @@ class InferenceEngine:
         from ..analysis.sanitizer import RecompileTracker
 
         self.recompile_tracker = RecompileTracker()
+        # per-bucket static footprints captured by warmup(footprint=True)
+        # ({width: {peak_hbm_bytes, ...}} — analysis/costmodel.py)
+        self.warmup_footprints: Dict[int, Dict[str, float]] = {}
         kv_bytes = sum(x.nbytes for x in self.cache.k + self.cache.v)
         log_dist(
             f"inference engine: {self.config.num_kv_blocks} KV blocks x "
@@ -1043,6 +1046,7 @@ class InferenceEngine:
         chunked: bool = True,
         decode_chunks: Sequence[int] = (),
         presence: bool = False,
+        footprint: bool = True,
     ) -> Dict[str, Any]:
         """Precompile the (bucket width x chunk) decode/sample grid so
         steady-state serving triggers ZERO recompiles (S003): every
@@ -1058,11 +1062,19 @@ class InferenceEngine:
         shared-table variant mixed prefill chunks need. decode_chunks:
         fused multi-step depths (model.decode_multi) to warm per width.
         sampling/presence select the sampling epilogue variant.
+        footprint=True additionally AOT-compiles the per-width decode
+        program once more for its static cost report (the jit call
+        cache and the AOT artifact are separate compilations), filling
+        `self.warmup_footprints[width]` — the per-bucket HBM numbers
+        the serving scheduler validates its admission config against
+        and feeds to the monitor.
 
         Logs a one-line compile-time summary and returns
-        {programs, seconds, widths, chunks}."""
+        {programs, seconds, widths, chunks, hbm_per_bucket}."""
         import time as _time
+        import warnings as _warnings
 
+        from ..analysis.costmodel import build_cost_report
         from .sampling import SamplingConfig
 
         scfg = SamplingConfig(**(sampling or {}))
@@ -1122,16 +1134,38 @@ class InferenceEngine:
                         args.append(self._dev(np.zeros((w, V), np.uint8)))
                 _, _, self.cache, _ = fn(*args)
                 n += 1
+            if footprint:
+                # the donated-cache warning is S001 business, not ours
+                with _warnings.catch_warnings():
+                    _warnings.simplefilter("ignore")
+                    compiled = self._decode_fn(w, True).lower(
+                        self.params, self.cache, self._dev(toks),
+                        self._dev(tables), self._dev(ctx)).compile()
+                rep = build_cost_report(compiled,
+                                        label=f"serving_decode[w{w}]")
+                if rep is not None:
+                    self.warmup_footprints[w] = {  # ds-lint: ok R003 warmup runs on the host dispatch thread only
+                        "peak_hbm_bytes": float(rep.peak_hbm_bytes),
+                        "arg_bytes": float(rep.arg_bytes),
+                        "temp_bytes": float(rep.temp_bytes),
+                        "comm_bytes": float(rep.comm_bytes),
+                    }
         dt = _time.perf_counter() - t0
+        fp = self.warmup_footprints
+        fp_note = (f", peak {max(f['peak_hbm_bytes'] for f in fp.values()) / 2**20:.0f} MiB"
+                   if fp else "")
         log_dist(
             f"serving warmup: {n} compiled programs (decode widths "
             f"{widths}{' +chunked' if chunked else ''}, fused depths "
             f"{[int(c) for c in decode_chunks]}, "
-            f"sampling={'on' if use_sampler else 'greedy'}) in {dt:.1f}s",
+            f"sampling={'on' if use_sampler else 'greedy'}) in {dt:.1f}s"
+            f"{fp_note}",
             ranks=[0],
         )
         return {"programs": n, "seconds": dt, "widths": widths,
-                "chunks": [int(c) for c in decode_chunks]}
+                "chunks": [int(c) for c in decode_chunks],
+                "hbm_per_bucket": {
+                    w: f["peak_hbm_bytes"] for w, f in sorted(fp.items())}}
 
     # -- speculative (multi-token-per-stream) decoding -------------------
     def _verify_chunks(

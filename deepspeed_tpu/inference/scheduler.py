@@ -182,6 +182,64 @@ class ServingScheduler:
                       else ())
             engine.warmup(sampling=sampling, decode_chunks=chunks,
                           presence=use_pres)
+        # admit-config budget validation: the warmed per-bucket
+        # footprints vs the per-device HBM budget (analysis/costmodel
+        # S004) — logged once here, surfaced via metrics()/monitor
+        self.budget_report = self._validate_budget()
+
+    # -- admit-config budget validation ----------------------------------
+    def _validate_budget(self):
+        """S004 at admit-config time: the widest warmed decode bucket's
+        static footprint (params + paged KV cache + scratch, from
+        engine.warmup's cost reports) must fit the per-device HBM
+        budget, and `max_num_batched_tokens` must not overcommit the KV
+        pool's token capacity in a single iteration. Findings are
+        logged, not raised — serving proceeds, CI reads the report."""
+        from ..analysis.report import Finding, SanitizerReport
+
+        eng = self.engine
+        rep = SanitizerReport(label="serving/admit_budget")
+        fps = getattr(eng, "warmup_footprints", {})
+        if fps:
+            if self.cfg.hbm_budget_gb > 0:
+                budget = int(self.cfg.hbm_budget_gb * 1e9)
+            else:
+                from ..platform.accelerator import get_accelerator
+
+                budget = get_accelerator().hbm_per_device()
+            peak = max(f["peak_hbm_bytes"] for f in fps.values())
+            if peak > budget:
+                gib = 1 / 2**30
+                rep.findings.append(Finding(
+                    rule="S004", path="serving/warmup", line=0,
+                    severity="error",
+                    message=(
+                        f"widest warmed decode bucket needs "
+                        f"{peak * gib:.2f} GiB but the per-device budget "
+                        f"is {budget * gib:.2f} GiB — steady-state "
+                        "serving OOMs before the first request"),
+                    fix_hint=(
+                        "shrink num_kv_blocks/max_batch_size, quantize "
+                        "or TP-shard the weights, or raise "
+                        "hbm_budget_gb if the budget is wrong"),
+                ))
+        pool_tokens = eng.config.num_kv_blocks * eng.config.kv_block_size
+        if self.cfg.max_num_batched_tokens > pool_tokens:
+            rep.findings.append(Finding(
+                rule="S004", path="serving/admission", line=0,
+                severity="warning",
+                message=(
+                    f"max_num_batched_tokens "
+                    f"{self.cfg.max_num_batched_tokens} exceeds the KV "
+                    f"pool's {pool_tokens}-token capacity — one "
+                    "iteration can overcommit the allocator and thrash "
+                    "preemption"),
+                fix_hint=("lower max_num_batched_tokens or grow "
+                          "num_kv_blocks"),
+            ))
+        for f in rep.findings:
+            log_dist(f"serving budget check: {f.message}", ranks=[0])
+        return rep
 
     # -- request intake --------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
@@ -864,7 +922,17 @@ class ServingScheduler:
             "tpot_p50_ms": pct(self._tpot, 50),
             "tpot_p95_ms": pct(self._tpot, 95),
             "recompiles": float(len(self.engine.recompile_tracker.findings)),
+            "budget_findings": float(
+                len(getattr(self, "budget_report").findings)
+                if getattr(self, "budget_report", None) else 0),
         }
+        # warmup-measured static footprint per decode bucket (costmodel)
+        fps = getattr(self.engine, "warmup_footprints", {})
+        if fps:
+            m["hbm_peak_mb"] = max(
+                f["peak_hbm_bytes"] for f in fps.values()) / 2**20
+            for w, f in sorted(fps.items()):
+                m[f"hbm_w{w}_mb"] = f["peak_hbm_bytes"] / 2**20
         for k, v in self.counters.items():
             m[k] = float(v)
         if self.counters["steps"]:

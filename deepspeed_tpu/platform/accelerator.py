@@ -123,6 +123,48 @@ class Accelerator:
             return 1e11  # nominal; only used so MFU math never divides by zero
         return 197e12
 
+    def hbm_per_device(self, index: int = 0) -> int:
+        """Per-device HBM capacity in bytes — the budget the static cost
+        model (analysis/costmodel.py S004) checks peak program footprint
+        against. Known chip kinds come from the table; otherwise the
+        backend's reported bytes_limit; otherwise a 16 GiB default so the
+        CPU fake-mesh path stays deterministic."""
+        kind = self.device_name(index).lower()
+        table = {
+            # chip kind substring -> HBM bytes per chip
+            "v5 lite": 16 * 10**9,
+            "v5litepod": 16 * 10**9,
+            "v5e": 16 * 10**9,
+            "v5p": 95 * 10**9,
+            "v4": 32 * 10**9,
+            "v3": 32 * 10**9,
+            "v2": 16 * 10**9,
+            "v6": 32 * 10**9,
+        }
+        for key, val in table.items():
+            if key in kind:
+                return val
+        limit = self.total_memory(index)
+        return int(limit) if limit > 0 else 16 * 2**30
+
+    def hbm_bandwidth(self, index: int = 0) -> float:
+        """Per-chip HBM bandwidth in bytes/s (roofline memory leg)."""
+        kind = self.device_name(index).lower()
+        table = {
+            "v5 lite": 819e9,
+            "v5litepod": 819e9,
+            "v5e": 819e9,
+            "v5p": 2765e9,
+            "v4": 1228e9,
+            "v3": 900e9,
+            "v2": 700e9,
+            "v6": 1640e9,
+        }
+        for key, val in table.items():
+            if key in kind:
+                return val
+        return 100e9  # nominal host-memory class; keeps ratios finite
+
     def random_seed(self, seed: int):
         return jax.random.PRNGKey(seed)
 

@@ -12,13 +12,15 @@ bookkeeping (fixes VERDICT r1 W6: the facade logger observed nothing).
 
 import re
 from collections import defaultdict
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 _DTYPE_BYTES = {
     "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
     "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
     "s32": 4, "u32": 4, "f32": 4,
     "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+    # token/opaque types carry no payload (sequencing values only)
+    "token": 0, "opaque": 0,
 }
 
 _COLLECTIVES = (
@@ -39,11 +41,15 @@ _ARRAY = rf"[a-z][a-z0-9]*\[(?:{_DIM}(?:,\s*{_DIM})*)?\]"
 # e.g. `((bf16[4], bf16[8]), (bf16[16], bf16[32]))`).
 _INSTR_RE = re.compile(
     r"=\s*(?P<result>\((?:[^()]|\([^()]*\))*\)|" + _ARRAY + r"[^ ]*)\s+"
-    r"(?P<op>" + "|".join(_COLLECTIVES) + r")\("
+    r"(?P<op>" + "|".join(_COLLECTIVES) + r")\((?P<tail>[^\n]*)"
 )
 _SHAPE_RE = re.compile(
     rf"(?P<dtype>[a-z][a-z0-9]*)\[(?P<dims>(?:{_DIM}(?:,\s*{_DIM})*)?)\]"
 )
+# `replica_groups={{0,1},{2,3}}` (explicit) — first group's member count
+_GROUPS_EXPLICIT_RE = re.compile(r"replica_groups=\{\{(?P<first>[\d,]+)\}")
+# `replica_groups=[4,2]<=[8]` (iota form): 4 groups of 2
+_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(?P<n>\d+),(?P<size>\d+)\]")
 
 
 def _shape_bytes(dtype: str, dims: str) -> int:
@@ -55,13 +61,30 @@ def _shape_bytes(dtype: str, dims: str) -> int:
     return n * _DTYPE_BYTES.get(dtype, 4)
 
 
+def _group_size(tail: str) -> int:
+    """Replica-group size of one collective instruction's attribute
+    tail (0 = not stated / flat world group `{}`)."""
+    m = _GROUPS_EXPLICIT_RE.search(tail)
+    if m is not None:
+        return len([x for x in m.group("first").split(",") if x.strip()])
+    m = _GROUPS_IOTA_RE.search(tail)
+    if m is not None:
+        return int(m.group("size"))
+    return 0
+
+
 def parse_hlo_collectives(hlo_text: str) -> List[Dict]:
     """Every collective instruction in the HLO with its payload bytes.
 
     Async `-start` ops return a tuple carrying the input operand alongside
     the output (e.g. `(bf16[4,128], bf16[16,128]) all-gather-start`); the
     payload is the OUTPUT — the largest member — so tuples from -start
-    forms take max, plain (possibly multi-result all-to-all) forms sum."""
+    forms take max, plain (possibly multi-result all-to-all) forms sum.
+
+    Each record additionally carries the operand payload (`operand_bytes`,
+    summed over the shapes inside the call parens) and the replica-group
+    size (`group_size`, 0 when unstated/flat) — the inputs the costmodel's
+    per-link volume math needs."""
     out = []
     for m in _INSTR_RE.finditer(hlo_text):
         is_start = m.group("op").endswith("-start")
@@ -75,7 +98,15 @@ def parse_hlo_collectives(hlo_text: str) -> List[Dict]:
             continue
         nbytes = max(sizes) if is_start else sum(sizes)
         dtypes = sorted({s.group("dtype") for s in _SHAPE_RE.finditer(result)})
-        out.append({"op": op, "bytes": nbytes, "dtypes": dtypes})
+        tail = m.group("tail")
+        operands = tail.split(")", 1)[0]
+        operand_bytes = sum(
+            _shape_bytes(s.group("dtype"), s.group("dims"))
+            for s in _SHAPE_RE.finditer(operands)
+        )
+        out.append({"op": op, "bytes": nbytes, "dtypes": dtypes,
+                    "operand_bytes": operand_bytes,
+                    "group_size": _group_size(tail)})
     return out
 
 
@@ -87,9 +118,15 @@ def parse_hlo_collectives(hlo_text: str) -> List[Dict]:
 # for whether a declared PartitionSpec survived compilation.
 
 _PARAM_RE = re.compile(
-    rf"=\s*(?P<dtype>[a-z][a-z0-9]*)"
-    rf"\[(?P<dims>(?:{_DIM}(?:,\s*{_DIM})*)?)\]"
+    r"=\s*(?P<result>"
+    r"\((?:[^()\n]|\([^()\n]*\))*\)"          # tuple-nested param
+    rf"|(?:[a-z][a-z0-9]*)(?:\[(?:{_DIM}(?:,\s*{_DIM})*)?\])?"  # array/token
+    r")(?:\{[^}]*\})?"                         # optional layout suffix
     r"[^\n]*?parameter\((?P<idx>\d+)\)(?P<rest>[^\n]*)"
+)
+# an array (or bare token/opaque) result — the non-tuple param form
+_RESULT_SHAPE_RE = re.compile(
+    rf"^(?P<dtype>[a-z][a-z0-9]*)(?:\[(?P<dims>(?:{_DIM}(?:,\s*{_DIM})*)?)\])?$"
 )
 _SHARDING_ATTR_RE = re.compile(r"sharding=\{(?P<sharding>[^}]*)\}")
 _OP_NAME_RE = re.compile(r'op_name="(?P<name>(?:[^"\\]|\\.)*)"')
@@ -109,21 +146,37 @@ def parse_entry_parameters(hlo_text: str) -> List[Dict]:
     """Entry parameters of a compiled module: per-shard dtype/dims plus
     the `sharding=` annotation and op_name keypath (when present).
 
-    Returns [{index, dtype, dims, sharding, op_name}], dims as a tuple of
-    ints (dynamic `<=N` bounds count as N)."""
+    Returns [{index, dtype, dims, sharding, op_name, nbytes}], dims as a
+    tuple of ints (dynamic `<=N` bounds count as N). Newer XLA emits
+    entry params this parser must not trip on: token-typed params
+    (`token[]` — dtype "token", zero bytes) and tuple-nested params
+    (`(f32[2,4], s32[])` — dtype "tuple", dims (), nbytes summed over
+    the element shapes)."""
     out = []
     for m in _PARAM_RE.finditer(_entry_text(hlo_text)):
         rest = m.group("rest")
         sh = _SHARDING_ATTR_RE.search(rest)
         nm = _OP_NAME_RE.search(rest)
-        dims = tuple(
-            int(d.strip().replace("<=", ""))
-            for d in m.group("dims").split(",") if d.strip()
-        )
+        result = m.group("result").strip()
+        am = _RESULT_SHAPE_RE.match(result)
+        if am is not None:
+            dtype = am.group("dtype")
+            dims = tuple(
+                int(d.strip().replace("<=", ""))
+                for d in (am.group("dims") or "").split(",") if d.strip()
+            )
+            nbytes = _shape_bytes(dtype, am.group("dims") or "")
+        else:  # tuple-nested: sum the element payloads
+            dtype, dims = "tuple", ()
+            nbytes = sum(
+                _shape_bytes(s.group("dtype"), s.group("dims"))
+                for s in _SHAPE_RE.finditer(result)
+            )
         out.append({
             "index": int(m.group("idx")),
-            "dtype": m.group("dtype"),
+            "dtype": dtype,
             "dims": dims,
+            "nbytes": nbytes,
             "sharding": sh.group("sharding") if sh else None,
             "op_name": (nm.group("name").replace("\\'", "'")
                         .replace('\\"', '"') if nm else None),
@@ -138,6 +191,51 @@ def entry_parameter_shardings(compiled) -> Dict[str, Dict]:
     return {
         (r["op_name"] if r["op_name"] is not None else f"#{r['index']}"): r
         for r in recs
+    }
+
+
+def compiled_memory_stats(compiled) -> Optional[Dict[str, int]]:
+    """Byte totals from `compiled.memory_analysis()`, or None when the
+    backend leaves it unimplemented (jaxlib raises, returns None, or the
+    attribute is missing entirely on some CPU builds) — callers degrade
+    to entry-parameter accounting instead of crashing."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+
+    def get(name: str) -> int:
+        try:
+            return int(getattr(ma, name, 0) or 0)
+        except (TypeError, ValueError):
+            return 0
+
+    return {
+        "argument_bytes": get("argument_size_in_bytes"),
+        "output_bytes": get("output_size_in_bytes"),
+        "temp_bytes": get("temp_size_in_bytes"),
+        "alias_bytes": get("alias_size_in_bytes"),
+        "generated_code_bytes": get("generated_code_size_in_bytes"),
+    }
+
+
+def compiled_cost_stats(compiled) -> Optional[Dict[str, float]]:
+    """{flops, bytes_accessed} from `compiled.cost_analysis()`, or None
+    when unimplemented. Normalizes the jax-version drift: older releases
+    return a one-element list of dicts, newer ones a plain dict."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    return {
+        "flops": float(ca.get("flops", 0.0) or 0.0),
+        "bytes_accessed": float(ca.get("bytes accessed", 0.0) or 0.0),
     }
 
 

@@ -1175,15 +1175,60 @@ class DeepSpeedTPUEngine:
         return jax.jit(grad_fn)
 
     # ------------------------------------------------------------------
-    # static verification (analysis/sanitizer.py)
+    # static verification (analysis/sanitizer.py + analysis/costmodel.py)
     # ------------------------------------------------------------------
-    def sanitize(self, batch):
+    def _cost_checks(self, compiled, label, hbm_budget_bytes=None,
+                     target_devices=None):
+        """(CostReport | None, [SanitizerReport]) for one compiled step:
+        S004 per-device HBM budget (projectable to a larger mesh), S005
+        collective volume vs the live sharded state, S006 roofline (a
+        train step must never compile comm-bound)."""
+        from ..analysis.costmodel import (
+            build_cost_report,
+            check_collective_volume,
+            check_hbm_budget,
+            check_roofline,
+        )
+        from ..platform.accelerator import get_accelerator
+
+        cost = build_cost_report(compiled, label=label)
+        if cost is None:
+            return None, []
+        tree = self.state.master if self._use_master else self.state.params
+        live = (int(sum(x.nbytes for x in jax.tree.leaves(tree)))
+                if tree is not None else 0)
+        # each gas microstep legitimately re-gathers the sharded params
+        # (fwd + bwd under zero-3), so the accidental-replication bar
+        # scales with the accumulation depth
+        gas = self.config.gradient_accumulation_steps or 1
+        acc = get_accelerator()
+        checks = [
+            check_hbm_budget(cost, budget_bytes=hbm_budget_bytes,
+                             target_devices=target_devices, label=label),
+            check_collective_volume(cost, live_sharded_bytes=live or None,
+                                    k=2.0 * gas + 2.0, label=label),
+            check_roofline(cost, peak_flops=acc.peak_flops(),
+                           hbm_bandwidth=acc.hbm_bandwidth(),
+                           expect="compute", comm_only=True, label=label),
+        ]
+        return cost, checks
+
+    def sanitize(self, batch, hbm_budget_bytes=None, target_devices=None):
         """Statically verify this engine's compiled step against an
         example host batch: (a) every donated TrainState buffer aliases
         an output (S001), (b) the derived ZeRO/TP param specs survive
         SPMD partitioning (S002), (c) recompile hazards observed so far
-        (S003). Compile-time only — no step executes, no state mutates.
-        Returns analysis.SanitizerReport; `report.ok` gates CI."""
+        (S003), (d) the step's static cost model — peak HBM vs the
+        per-device budget (S004), collective volume vs the live sharded
+        state (S005), roofline balance (S006). Compile-time only — no
+        step executes, no state mutates. Returns
+        analysis.SanitizerReport with `.cost` attached; `report.ok`
+        gates CI.
+
+        hbm_budget_bytes: per-device budget (default: the running
+        chip's HBM from platform/accelerator.py). target_devices:
+        project the footprint to a mesh of this size — catches the
+        replicated-residency term that OOMs at scale."""
         import warnings
 
         from ..analysis.report import merge_reports
@@ -1195,6 +1240,7 @@ class DeepSpeedTPUEngine:
             # the fused-step donation story doesn't apply; the customer
             # is the host update's in-place donation (runtime/offload.py)
             reports = [self._recompile_tracker.report()]
+            cost = None
             if not self._offload_nvme:
                 # probe args pinned to the host device, exactly like
                 # _dispatch_offload_step stages them
@@ -1213,7 +1259,22 @@ class DeepSpeedTPUEngine:
                     argnames=("master", "opt"),
                     label="host_update",
                 ))
-            return merge_reports("offload_step", *reports)
+                # the device half of the offloaded step carries the HBM
+                # footprint story (grads + params resident together)
+                if self._grad_step_fn is None:
+                    self._grad_step_fn = self._build_grad_step()
+                with warnings.catch_warnings(), use_mesh(self.mesh):
+                    warnings.simplefilter("ignore")
+                    compiled_g = self._grad_step_fn.lower(
+                        self._materialized_params(), self.state.step, batch
+                    ).compile()
+                cost, cost_checks = self._cost_checks(
+                    compiled_g, "grad_step", hbm_budget_bytes,
+                    target_devices)
+                reports.extend(cost_checks)
+            rep = merge_reports("offload_step", *reports)
+            rep.cost = cost
+            return rep
         if self._train_step_fn is None:
             self._train_step_fn = self._build_train_step()
         fn = self._train_step_fn
@@ -1242,8 +1303,13 @@ class DeepSpeedTPUEngine:
                 compiled, self.param_specs, self.state.params, self.mesh,
                 argname="state.params", label="train_step",
             )
-        return merge_reports(
-            "train_step", don, shard, self._recompile_tracker.report())
+        cost, cost_checks = self._cost_checks(
+            compiled, "train_step", hbm_budget_bytes, target_devices)
+        rep = merge_reports(
+            "train_step", don, shard, self._recompile_tracker.report(),
+            *cost_checks)
+        rep.cost = cost
+        return rep
 
     def _zo_live_params(self):
         """0/1 Adam phase 2: TrainState.params are the last-SYNCED

@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""ds-budget CLI — compile-time memory/comm budget gate (MEMBUDGET.json).
+
+Usage:
+    python scripts/ds_budget.py --capture          # write the baseline
+    python scripts/ds_budget.py --check            # exit 1 on regression
+    python scripts/ds_budget.py --check --strict   # warnings also fail
+
+The tier-1 pre-test companion to `ds_lint.py --strict` (see
+.claude/skills/verify/SKILL.md): a PR that inflates a canonical
+program's peak HBM footprint beyond the baseline tolerance, pushes it
+past the per-device budget (S004), or regresses its per-step collective
+volume (S005) fails here before pytest ever runs. Canonical programs —
+compiled on the virtual 8-device CPU mesh, no step executed:
+
+  train_step        the zero-3 + TP fused training step
+                    (engine.sanitize's compiled artifact)
+  serving_decode_w8 the width-8 paged-KV decode program
+                    (the serving warmup footprint unit)
+
+Everything is compile-time static analysis: byte counts come from
+compiled.memory_analysis() and the HLO text, so the gate runs anywhere
+(CI, laptops) without an accelerator.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+# the virtual 8-device CPU mesh must exist BEFORE jax initializes
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+DEFAULT_PATH = os.path.join(_REPO, "MEMBUDGET.json")
+
+
+def build_reports():
+    """{name: CostReport} for the canonical programs + the live sharded
+    param bytes of the train engine (the S005 denominator)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.analysis.costmodel import build_cost_report
+    from deepspeed_tpu.models import transformer as T
+
+    mcfg = T.TransformerConfig(
+        vocab_size=128, n_layers=2, n_heads=4, d_model=64, max_seq=32,
+        variant="llama", use_flash=False)
+    engine = ds.initialize(
+        {"train_micro_batch_size_per_gpu": 1,
+         "gradient_accumulation_steps": 2,
+         "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+         "zero_optimization": {"stage": 3, "param_persistence_threshold": 64},
+         "bf16": {"enabled": True},
+         "mesh": {"data": 4, "model": 2},
+         "steps_per_print": 10**9},
+        loss_fn=T.make_loss_fn(mcfg),
+        param_init_fn=lambda k: T.init(mcfg, k),
+        param_logical_specs=T.logical_specs(mcfg))
+    batch = {"tokens": np.zeros(
+        (engine.config.train_batch_size, 33), np.int32)}
+    san = engine.sanitize(batch)
+    tree = engine.state.master if engine._use_master else engine.state.params
+    live = int(sum(x.nbytes for x in jax.tree.leaves(tree)))
+
+    from deepspeed_tpu.inference import init_inference
+    import jax.numpy as jnp
+    import warnings
+
+    params = T.init(mcfg, jax.random.PRNGKey(0))
+    eng = init_inference(
+        params, mcfg,
+        dict(max_seq_len=32, kv_block_size=8, num_kv_blocks=32,
+             min_prefill_bucket=8, max_batch_size=8),
+        dtype=jnp.float32)
+    toks = np.zeros((8,), np.int32)
+    ctx = np.zeros((8,), np.int32)
+    tables = np.full((8, eng.config.blocks_per_seq), eng.pad_block, np.int32)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        compiled = eng._decode_fn(8, True).lower(
+            eng.params, eng.cache, eng._dev(toks), eng._dev(tables),
+            eng._dev(ctx)).compile()
+    decode_cost = build_cost_report(compiled, label="serving_decode[w8]")
+
+    reports = {}
+    if san.cost is not None:
+        reports["train_step"] = san.cost
+    if decode_cost is not None:
+        reports["serving_decode_w8"] = decode_cost
+    return reports, live
+
+
+def capture(path: str) -> int:
+    import jax
+
+    from deepspeed_tpu.analysis.costmodel import save_baseline
+    from deepspeed_tpu.platform.accelerator import get_accelerator
+
+    reports, live = build_reports()
+    if not reports:
+        print(json.dumps({"error": "no cost artifacts available on this "
+                                   "backend; baseline not written"}))
+        return 1
+    doc = save_baseline(
+        path, reports,
+        budgets={
+            "hbm_per_device_bytes": get_accelerator().hbm_per_device(),
+            "hbm_regression_tolerance": 0.10,
+            "collective_k": 6.0,  # 2*gas+2 of the canonical train engine
+            "live_sharded_bytes": live,
+        },
+        meta={"platform": jax.default_backend(),
+              "device_count": jax.device_count(),
+              "jax_version": jax.__version__},
+    )
+    print(json.dumps({
+        "captured": path,
+        "programs": {n: p["peak_hbm_bytes"]
+                     for n, p in doc["programs"].items()},
+    }))
+    return 0
+
+
+def check(path: str, strict: bool) -> int:
+    from deepspeed_tpu.analysis.costmodel import (
+        check_against_baseline,
+        check_collective_volume,
+        check_hbm_budget,
+        load_baseline,
+    )
+
+    base = load_baseline(path)
+    if base is None:
+        print(json.dumps({
+            "error": f"no baseline at {path}; run --capture first"}))
+        return 1
+    budgets = base.get("budgets", {})
+    tol = float(budgets.get("hbm_regression_tolerance", 0.10))
+    k = float(budgets.get("collective_k", 6.0))
+    live = int(budgets.get("live_sharded_bytes", 0))
+    hbm_budget = int(budgets.get("hbm_per_device_bytes", 0)) or None
+
+    reports, _ = build_reports()
+    findings = []
+    summary = {}
+    for name, rep in reports.items():
+        entry = base.get("programs", {}).get(name)
+        if entry is None:
+            findings.append({
+                "rule": "S004", "severity": "warning", "program": name,
+                "message": f"no baseline entry for {name}; re-capture"})
+            continue
+        checks = [
+            check_against_baseline(rep, entry, tolerance=tol, label=name),
+            check_hbm_budget(rep, budget_bytes=hbm_budget, label=name),
+            check_collective_volume(
+                rep, live_sharded_bytes=(live or None) if
+                name == "train_step" else None,
+                k=k, baseline=entry, tolerance=tol, label=name),
+        ]
+        for c in checks:
+            findings.extend(
+                {"rule": f.rule, "severity": f.severity, "program": name,
+                 "message": f.message}
+                for f in c.findings)
+        summary[name] = {
+            "peak_hbm_bytes": rep.peak_hbm_bytes,
+            "baseline_peak_hbm_bytes": entry.get("peak_hbm_bytes"),
+            "comm_bytes": rep.comm_bytes,
+            "baseline_comm_bytes": entry.get("comm_bytes"),
+        }
+    for name in base.get("programs", {}):
+        if name not in reports:
+            findings.append({
+                "rule": "S004", "severity": "warning", "program": name,
+                "message": f"baseline program {name} was not rebuilt "
+                           "(backend without cost artifacts?)"})
+    errors = [f for f in findings if f["severity"] == "error"]
+    failed = bool(errors) or (strict and bool(findings))
+    print(json.dumps({"ok": not failed, "findings": findings,
+                      "programs": summary}))
+    return 1 if failed else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--capture", action="store_true",
+                    help="compile the canonical programs and write the "
+                         "baseline")
+    ap.add_argument("--check", action="store_true",
+                    help="recompile and compare against the baseline; "
+                         "exit 1 on any error-severity finding")
+    ap.add_argument("--strict", action="store_true",
+                    help="with --check: warnings also fail")
+    ap.add_argument("--baseline", default=DEFAULT_PATH,
+                    help=f"baseline path (default {DEFAULT_PATH})")
+    args = ap.parse_args(argv)
+    if args.capture == args.check:
+        ap.error("pass exactly one of --capture / --check")
+    if args.capture:
+        return capture(args.baseline)
+    return check(args.baseline, strict=args.strict)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

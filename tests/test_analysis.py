@@ -544,6 +544,136 @@ class TestLintR004:
         assert not found
 
 
+class TestLintR005:
+    def test_weak_literal_array_fires(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            scale = jnp.array(0.5)
+            return x * scale
+        """
+        found, _ = _findings(src)
+        assert [f.rule for f in found] == ["R005"]
+        assert found[0].severity == "warning"
+
+    def test_list_literal_and_full_fire(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            mask = jnp.asarray([1, 0, 1])
+            fill = jnp.full((4,), 7)
+            return x * mask[0] + fill[0]
+        """
+        found, _ = _findings(src)
+        assert [f.rule for f in found] == ["R005", "R005"]
+
+    def test_explicit_dtype_is_clean(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            scale = jnp.array(0.5, dtype=jnp.float32)
+            fill = jnp.full((4,), 7, dtype=jnp.int32)
+            return x * scale + fill[0]
+        """
+        found, _ = _findings(src)
+        assert not found
+
+    def test_non_literal_value_is_clean(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            return jnp.asarray(x) * jnp.array(x.shape[0] * [0])
+        """
+        # neither a bare literal value: traced x, computed list
+        found, _ = _findings(src)
+        assert not found
+
+    def test_outside_jit_is_clean(self):
+        src = """
+        import jax.numpy as jnp
+        def host():
+            return jnp.array(0.5)
+        """
+        found, _ = _findings(src)
+        assert not found
+
+    def test_negated_literal_fires(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            return x + jnp.array(-1.0)
+        """
+        found, _ = _findings(src)
+        assert [f.rule for f in found] == ["R005"]
+
+    def test_pragma_suppresses(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            s = jnp.array(0.5)  # ds-lint: ok R005 promotion is intended here
+            return x * s
+        """
+        found, suppressed = _findings(src)
+        assert not found and len(suppressed) == 1
+
+
+class TestMergeReports:
+    def _f(self, rule, path="p"):
+        from deepspeed_tpu.analysis import Finding
+
+        return Finding(rule=rule, path=path, line=0, severity="error",
+                       message="m", fix_hint="")
+
+    def test_folds_reports_and_raw_lists(self):
+        from deepspeed_tpu.analysis import SanitizerReport, merge_reports
+
+        a = SanitizerReport(findings=[self._f("S001")], label="a")
+        b = SanitizerReport(findings=[self._f("S002"), self._f("S002")],
+                            label="b")
+        merged = merge_reports("all", a, b, [self._f("S003")])
+        assert merged.label == "all"
+        assert merged.by_rule() == {"S001": 1, "S002": 2, "S003": 1}
+        assert not merged.ok
+
+    def test_empty_merge_is_ok(self):
+        from deepspeed_tpu.analysis import SanitizerReport, merge_reports
+
+        merged = merge_reports("none", SanitizerReport(), SanitizerReport())
+        assert merged.ok and merged.by_rule() == {}
+        assert "clean" in merged.render()
+
+    def test_merge_preserves_finding_order(self):
+        from deepspeed_tpu.analysis import SanitizerReport, merge_reports
+
+        a = SanitizerReport(findings=[self._f("S001", "first")])
+        b = SanitizerReport(findings=[self._f("S002", "second")])
+        merged = merge_reports("ordered", a, b)
+        assert [f.path for f in merged.findings] == ["first", "second"]
+
+    def test_merge_with_cost_attachment_renders(self):
+        from deepspeed_tpu.analysis import (
+            CostReport,
+            SanitizerReport,
+            merge_reports,
+        )
+
+        merged = merge_reports("c", SanitizerReport())
+        merged.cost = CostReport(label="step", arg_bytes=2**20)
+        assert "cost[step]" in merged.render()
+
+
 class TestLintPragma:
     def test_same_line_pragma_suppresses(self):
         src = """
@@ -585,6 +715,76 @@ class TestLintPragma:
         """
         found, suppressed = _findings(src, TestLintR002.HOT)
         assert not found and len(suppressed) == 1
+
+    def test_multi_rule_pragma(self):
+        """One pragma naming several rules suppresses exactly those:
+        the R001+R002 double finding collapses, nothing else rides."""
+        src = """
+        import jax
+        @jax.jit
+        def step(x):
+            return float(x) + int(x)  # ds-lint: ok R001 R002 both host reads intended
+        """
+        found, suppressed = _findings(src, TestLintR002.HOT)
+        assert not [f for f in found if f.rule == "R001"]
+        assert all(s.rule in ("R001", "R002") for s in suppressed)
+        assert len(suppressed) >= 1
+
+    def test_malformed_reason_with_rule_like_tokens(self):
+        """Rule ids are harvested from the WHOLE pragma tail — a reason
+        that mentions another rule id widens the suppression. Documented
+        greedy behavior: keep rule ids out of prose reasons."""
+        src = """
+        import jax
+        class E:
+            def train_batch(self, b):
+                return jax.device_get(b)  # ds-lint: ok R001 relates to R002 cleanup
+        """
+        found, suppressed = _findings(src, TestLintR002.HOT)
+        # R002 appears in the tail (even as prose), so the R002 finding
+        # is suppressed despite R001 being the "named" rule
+        assert not found and len(suppressed) == 1
+
+    def test_unknown_rule_number_suppresses_nothing_named(self):
+        """A pragma naming only a non-existent 2-digit token has no
+        R\\d{3} ids at all — it degrades to a bare `ok` and suppresses
+        the line's findings (documented fallback)."""
+        src = """
+        import jax
+        class E:
+            def train_batch(self, b):
+                return jax.device_get(b)  # ds-lint: ok R99 typo'd rule id
+        """
+        found, suppressed = _findings(src, TestLintR002.HOT)
+        assert not found and len(suppressed) == 1
+
+    def test_stale_pragma_on_clean_line_is_inert(self):
+        """A pragma left behind after the offending code was fixed
+        suppresses nothing and breaks nothing — zero findings, zero
+        suppressed entries."""
+        src = """
+        import jax
+        class E:
+            def train_batch(self, b):
+                out = self._step(b)  # ds-lint: ok R002 stale note
+                return out
+        """
+        found, suppressed = _findings(src, TestLintR002.HOT)
+        assert not found and not suppressed
+
+    def test_pragma_two_lines_above_does_not_reach(self):
+        """The pragma scope is one line (same line or directly above) —
+        a distant pragma must NOT bless later findings."""
+        src = """
+        import jax
+        class E:
+            def train_batch(self, b):
+                # ds-lint: ok R002 only covers the next line
+                x = 1
+                return jax.device_get(b)
+        """
+        found, suppressed = _findings(src, TestLintR002.HOT)
+        assert len(found) == 1 and not suppressed
 
 
 class TestTreeIsClean:

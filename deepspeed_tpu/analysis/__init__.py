@@ -11,7 +11,13 @@ Three prongs (see docs/static_analysis.md):
               and baseline regressions (S005), roofline balance (S006).
               Baselines persist to MEMBUDGET.json
               (`python scripts/ds_budget.py --capture / --check`).
-  lint      — `ds-lint`, an AST pass with project rules R001-R005
+  numerics  — precision-flow analysis over the same artifacts: low-
+              precision accumulation (N001), fp32 master-weight
+              integrity (N002), loss-scale coverage (N003),
+              quantized-collective sanity (N004). Dtype ledgers
+              persist to NUMERICS.json
+              (`python scripts/ds_numerics.py --capture / --check`).
+  lint      — `ds-lint`, an AST pass with project rules R001-R006
               (`python scripts/ds_lint.py --strict`).
 """
 
@@ -34,6 +40,16 @@ from .costmodel import (
     roofline,
     save_baseline,
 )
+from .numerics import (
+    check_accumulation_dtypes,
+    check_loss_scale,
+    check_master_integrity,
+    check_program_numerics,
+    check_quantized_groups,
+    diff_ledgers,
+    dtype_ledger,
+    grad_elem_counts,
+)
 from .lint import lint_paths, lint_source, RULES
 
 __all__ = [
@@ -55,6 +71,14 @@ __all__ = [
     "load_baseline",
     "roofline",
     "save_baseline",
+    "check_accumulation_dtypes",
+    "check_loss_scale",
+    "check_master_integrity",
+    "check_program_numerics",
+    "check_quantized_groups",
+    "diff_ledgers",
+    "dtype_ledger",
+    "grad_elem_counts",
     "lint_paths",
     "lint_source",
     "RULES",

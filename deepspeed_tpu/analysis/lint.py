@@ -26,6 +26,14 @@ Rules
         promotion context instead of being pinned; the same expression
         hoisted to the call boundary is the exact python-scalar-
         promotion recompile class S003 catches dynamically
+  R006  precision-policy drift in a jit-root body: a `float64`
+        dtype mention (TPU has no f64 — under x64-off it silently
+        downcasts, under x64-on it doubles every byte), a dtype-less
+        `jnp.zeros`/`jnp.ones`/`jnp.arange` (the default dtype follows
+        global flags, not the active precision policy), or
+        `.astype(float)`/`.astype("float64")` (widening through the
+        python type). The static companion to the numerics
+        sanitizer's N001 (analysis/numerics.py)
 
 Pragma: `# ds-lint: ok` suppresses every rule on that line (or the line
 below a standalone pragma comment); `# ds-lint: ok R002 <reason>`
@@ -50,6 +58,9 @@ RULES = {
     "R004": "donate_argnums without an aliasing note",
     "R005": "weak-typed literal constant (jnp.array of a python "
             "scalar/list, no dtype) inside a jitted body",
+    "R006": "precision-policy drift (float64 mention, dtype-less "
+            "jnp.zeros/ones/arange, astype(float)) inside a jitted "
+            "body",
 }
 
 _PRAGMA_RE = re.compile(
@@ -302,6 +313,77 @@ def _check_r005(ctx: _Ctx, root: ast.AST, callbacks: Set[ast.AST]) -> None:
 
 
 # ----------------------------------------------------------------------
+# R006: precision-policy drift in jit bodies
+# ----------------------------------------------------------------------
+
+# constructors whose DEFAULT dtype follows global flags (x64, weak-type
+# promotion) instead of the active precision policy
+_R006_CTORS = ("zeros", "ones", "arange")
+
+
+def _check_r006(ctx: _Ctx, root: ast.AST, callbacks: Set[ast.AST]) -> None:
+    skip: Set[ast.AST] = set()
+    for cb in callbacks:
+        skip.update(ast.walk(cb))
+    for node in ast.walk(root):
+        if node in skip:
+            continue
+        if isinstance(node, ast.Attribute) and node.attr == "float64":
+            ctx.emit(
+                "R006", node,
+                f"{_dotted(node)} inside a jitted body — TPU has no "
+                "f64: under x64-off the value silently downcasts to "
+                "f32 (the config lied), under x64-on it doubles every "
+                "byte of the buffer",
+                "use an explicit f32/bf16 dtype from the active "
+                "precision policy",
+                severity="warning",
+            )
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _dotted(node.func)
+        parts = callee.rsplit(".", 1)
+        if len(parts) == 2 and parts[0] in _JNP_PREFIXES and \
+                parts[1] in _R006_CTORS and \
+                not any(kw.arg == "dtype" for kw in node.keywords):
+            # zeros/ones take dtype as the 2nd positional, arange as
+            # the 4th — fewer args with no dtype= means the default
+            dtype_pos = 3 if parts[1] == "arange" else 1
+            if len(node.args) <= dtype_pos:
+                ctx.emit(
+                    "R006", node,
+                    f"{callee}() without an explicit dtype inside a "
+                    "jitted body — the default dtype follows global "
+                    "flags (x64, promotion context), not the active "
+                    "precision policy; a widened buffer here is a "
+                    "silent 2x on bytes and a policy drift N001 only "
+                    "catches after compilation",
+                    "pin the dtype (e.g. jnp.zeros(shape, jnp.float32) "
+                    "or the policy compute dtype)",
+                    severity="warning",
+                )
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "astype" and node.args:
+            a = node.args[0]
+            widens = (
+                (isinstance(a, ast.Name) and a.id == "float")
+                or (isinstance(a, ast.Constant)
+                    and a.value in ("float64", "double"))
+            )
+            if widens:
+                ctx.emit(
+                    "R006", node,
+                    ".astype(float)/.astype('float64') inside a jitted "
+                    "body widens through the python type — the result "
+                    "dtype follows x64 flags, not the precision policy",
+                    "cast to an explicit jnp dtype (x.astype("
+                    "jnp.float32))",
+                    severity="warning",
+                )
+
+
+# ----------------------------------------------------------------------
 # R002: hot-path host syncs
 # ----------------------------------------------------------------------
 
@@ -525,6 +607,7 @@ def lint_source(source: str, relpath: str) -> Tuple[List[Finding],
     for root in roots:
         _check_r001(ctx, root, callbacks)
         _check_r005(ctx, root, callbacks)
+        _check_r006(ctx, root, callbacks)
     _check_r002(ctx, tree)
     _check_r003(ctx, tree)
     _check_r004(ctx, tree)

@@ -94,6 +94,27 @@ class FP16Config(ConfigModel):
     min_loss_scale: float = 1.0
 
 
+class DataTypesConfig(ConfigModel):
+    """ref: runtime/config.py data_types block. `grad_accum_dtype`
+    declares the gradient-accumulation/reduction precision the compiled
+    step must honor (None = fp32, the engine's construction); the
+    numerics sanitizer (analysis/numerics.py N001) verifies the HLO
+    against it."""
+
+    grad_accum_dtype: Optional[str] = None  # None -> fp32
+
+    @model_validator(mode="after")
+    def _check_dtype(self):
+        if self.grad_accum_dtype is not None and \
+                self.grad_accum_dtype.lower() not in (
+                    "fp32", "float32", "f32", "bf16", "bfloat16",
+                    "fp16", "float16", "f16"):
+            raise ValueError(
+                f"data_types.grad_accum_dtype={self.grad_accum_dtype!r}; "
+                "expected fp32/bf16/fp16")
+        return self
+
+
 class OptimizerConfig(ConfigModel):
     """ref: runtime/config.py optimizer block → ops/adam etc."""
 
@@ -403,12 +424,18 @@ class DeepSpeedTPUConfig(ConfigModel):
     gradient_clipping: float = 0.0
     prescale_gradients: bool = False
     seed: int = 1234
+    # ref: runtime/config.py communication_data_type — the dtype
+    # gradient-reduction collectives are DECLARED to carry (None = the
+    # compute dtype, the reference default for fp16/bf16 training).
+    # Verified against the compiled HLO by analysis/numerics.py N001.
+    communication_data_type: Optional[str] = None
 
     optimizer: OptimizerConfig = Field(default_factory=OptimizerConfig)
     scheduler: SchedulerConfig = Field(default_factory=SchedulerConfig)
     zero_optimization: ZeroConfig = Field(default_factory=ZeroConfig)
     bf16: BF16Config = Field(default_factory=BF16Config)
     fp16: FP16Config = Field(default_factory=FP16Config)
+    data_types: DataTypesConfig = Field(default_factory=DataTypesConfig)
     mesh: MeshConfig = Field(default_factory=MeshConfig)
     activation_checkpointing: ActivationCheckpointingConfig = Field(
         default_factory=ActivationCheckpointingConfig
@@ -558,11 +585,14 @@ class DeepSpeedTPUConfig(ConfigModel):
 # process-level fetch machinery) or by torch-only machinery we don't port
 # (SURVEY §7 "what we explicitly do NOT port").
 _REFERENCE_NOOP_KEYS: Dict[str, tuple] = {
+    # communication_data_type / data_types became REAL knobs in PR 5
+    # (the numerics sanitizer's declared precision policy) — no longer
+    # dropped here.
     "": (
-        "zero_allow_untested_optimizer", "communication_data_type",
+        "zero_allow_untested_optimizer",
         "sparse_gradients", "amp", "dump_state", "memory_breakdown",
         "gradient_predivide_factor", "dataloader_drop_last",
-        "data_types", "use_data_before_expert_parallel_",
+        "use_data_before_expert_parallel_",
     ),
     "zero_optimization": (
         # bucketing/prefetch/fetch machinery → XLA SPMD scheduling

@@ -1167,6 +1167,46 @@ class InferenceEngine:
                 "hbm_per_bucket": {
                     w: f["peak_hbm_bytes"] for w, f in sorted(fp.items())}}
 
+    def sanitize_numerics(self, widths: Optional[Sequence[int]] = None):
+        """Numerics sanitizer (analysis/numerics.py) over the serving
+        decode buckets: per width, the compiled decode program is
+        checked against the engine's serving dtype — accumulation
+        downcasts (N001: an additive reduce below fp32 that jax's
+        upcast-by-default semantics would never emit means an explicit
+        override snuck into the model). Compile-time only; defaults to
+        the warmed bucket widths (or the smallest bucket before
+        warmup). Returns a merged analysis.SanitizerReport."""
+        import warnings as _warnings
+
+        from ..analysis.numerics import check_program_numerics
+        from ..analysis.report import merge_reports
+        from ..runtime.precision import PrecisionPolicy, hlo_dtype_name
+
+        serving = hlo_dtype_name(self._dtype)
+        policy = PrecisionPolicy(
+            compute=serving, master=None, grad_accum="f32",
+            grad_comm=serving, loss_scaled=False)
+        if widths is None:
+            widths = sorted(self.warmup_footprints) or [
+                min(8, _bucket(self.config.max_batch_size, 8))]
+        reports = []
+        for w in (int(w) for w in widths):
+            toks = np.zeros((w,), np.int32)
+            ctx = np.zeros((w,), np.int32)
+            tables = np.full((w, self.config.blocks_per_seq),
+                             self.pad_block, np.int32)
+            # the donated-cache warning is S001 business, not ours
+            with _warnings.catch_warnings():
+                _warnings.simplefilter("ignore")
+                lowered = self._decode_fn(w, True).lower(
+                    self.params, self.cache, self._dev(toks),
+                    self._dev(tables), self._dev(ctx))
+                compiled = lowered.compile()
+            reports.append(check_program_numerics(
+                compiled, policy, lowered=lowered,
+                label=f"serving_decode[w{w}]"))
+        return merge_reports("serving_decode", *reports)
+
     # -- speculative (multi-token-per-stream) decoding -------------------
     def _verify_chunks(
         self, uids: Sequence[int], chunks: Sequence[np.ndarray],

@@ -1213,6 +1213,84 @@ class DeepSpeedTPUEngine:
         ]
         return cost, checks
 
+    def _compressed_kind(self) -> Optional[str]:
+        if self._onebit:
+            return "onebit"
+        if self._zoadam:
+            return "zoadam"
+        if self._qgz:
+            return "qgz"
+        return None
+
+    def _numerics_checks(self, compiled, lowered, label, master=None,
+                         opt=None, donated=True):
+        """N-series precision-flow checks for one compiled step
+        (analysis/numerics.py): accumulation dtypes vs the declared
+        policy (N001), fp32 master/optimizer integrity through the
+        donation table (N002), loss-scale coverage (N003)."""
+        from ..analysis.numerics import (
+            check_program_numerics,
+            grad_elem_counts,
+        )
+        from .precision import precision_policy
+
+        policy = precision_policy(
+            self.config, compressed=self._compressed_kind())
+        tree = master if master is not None else self.state.params
+        dp = int(self.mesh.shape.get("data", 1)
+                 * self.mesh.shape.get("zero", 1))
+        return check_program_numerics(
+            compiled, policy, lowered=lowered, master=master, opt=opt,
+            grad_counts=grad_elem_counts(tree, dp=dp), donated=donated,
+            label=label)
+
+    def _compressed_step_numerics(self, batch):
+        """[SanitizerReport] for the COMPRESSED step programs: the
+        1-bit / 0-1-Adam compressed-phase program (compiled here even
+        when the engine is still in warmup — the phase switch must not
+        be the first time its numerics are seen) and the qgZ fused
+        step's group geometry + wire dtypes (N004)."""
+        import warnings
+
+        from ..analysis.numerics import check_quantized_groups
+        from .precision import precision_policy
+
+        kind = self._compressed_kind()
+        if kind is None:
+            return []
+        policy = precision_policy(self.config, compressed=kind)
+        dp = int(self.mesh.shape.get("data", 1)
+                 * self.mesh.shape.get("zero", 1))
+        reports = []
+        if kind == "qgz":
+            # the fused step IS the quantized-gradient program
+            if self._train_step_fn is None:
+                self._train_step_fn = self._build_train_step()
+            fn, label = self._train_step_fn, "train_step[qgz]"
+            block = 2048  # comm.compressed.quantized_mean default
+        elif kind == "onebit":
+            if getattr(self, "_onebit_step_fn", None) is None:
+                self._onebit_step_fn = self._build_onebit_step()
+            fn, label, block = self._onebit_step_fn, "train_step[onebit]", None
+        else:  # zoadam: the compressed-momentum program of the schedule
+            fn = self._zo_programs.get("onebit")
+            if fn is None:
+                fn = self._zo_programs["onebit"] = \
+                    self._build_zoadam_step("onebit")
+            label, block = "train_step[zoadam]", None
+        with warnings.catch_warnings(), use_mesh(self.mesh):
+            warnings.simplefilter("ignore")
+            lowered = fn.lower(self.state, batch)
+            compiled = lowered.compile()
+        reports.append(self._numerics_checks(
+            compiled, lowered, label,
+            master=self.state.master if self._use_master else None,
+            opt=self.state.opt))
+        reports.append(check_quantized_groups(
+            self.state.params, dp, policy, block=block,
+            compiled_text=compiled.as_text(), label=label))
+        return reports
+
     def sanitize(self, batch, hbm_budget_bytes=None, target_devices=None):
         """Statically verify this engine's compiled step against an
         example host batch: (a) every donated TrainState buffer aliases
@@ -1220,10 +1298,14 @@ class DeepSpeedTPUEngine:
         SPMD partitioning (S002), (c) recompile hazards observed so far
         (S003), (d) the step's static cost model — peak HBM vs the
         per-device budget (S004), collective volume vs the live sharded
-        state (S005), roofline balance (S006). Compile-time only — no
-        step executes, no state mutates. Returns
-        analysis.SanitizerReport with `.cost` attached; `report.ok`
-        gates CI.
+        state (S005), roofline balance (S006), (e) the numerics
+        sanitizer — accumulation dtypes vs the declared precision
+        policy (N001), fp32 master/optimizer-state integrity (N002),
+        loss-scale coverage (N003), and on the 1-bit/0-1-Adam/qgZ
+        compressed programs the quantized-collective sanity check
+        (N004). Compile-time only — no step executes, no state
+        mutates. Returns analysis.SanitizerReport with `.cost`
+        attached; `report.ok` gates CI.
 
         hbm_budget_bytes: per-device budget (default: the running
         chip's HBM from platform/accelerator.py). target_devices:
@@ -1259,19 +1341,30 @@ class DeepSpeedTPUEngine:
                     argnames=("master", "opt"),
                     label="host_update",
                 ))
+                # the host tier's fp32 master/moments must BE fp32 —
+                # tree-level N002 (no compiled program consumes them
+                # on-device)
+                from ..analysis.numerics import check_master_integrity
+
+                reports.append(check_master_integrity(
+                    master=self.state.master, opt=self.state.opt,
+                    label="host_update"))
                 # the device half of the offloaded step carries the HBM
                 # footprint story (grads + params resident together)
                 if self._grad_step_fn is None:
                     self._grad_step_fn = self._build_grad_step()
                 with warnings.catch_warnings(), use_mesh(self.mesh):
                     warnings.simplefilter("ignore")
-                    compiled_g = self._grad_step_fn.lower(
+                    lowered_g = self._grad_step_fn.lower(
                         self._materialized_params(), self.state.step, batch
-                    ).compile()
+                    )
+                    compiled_g = lowered_g.compile()
                 cost, cost_checks = self._cost_checks(
                     compiled_g, "grad_step", hbm_budget_bytes,
                     target_devices)
                 reports.extend(cost_checks)
+                reports.append(self._numerics_checks(
+                    compiled_g, lowered_g, "grad_step", donated=False))
             rep = merge_reports("offload_step", *reports)
             rep.cost = cost
             return rep
@@ -1305,9 +1398,13 @@ class DeepSpeedTPUEngine:
             )
         cost, cost_checks = self._cost_checks(
             compiled, "train_step", hbm_budget_bytes, target_devices)
+        num = self._numerics_checks(
+            compiled, lowered, "train_step",
+            master=self.state.master if self._use_master else None,
+            opt=self.state.opt)
         rep = merge_reports(
             "train_step", don, shard, self._recompile_tracker.report(),
-            *cost_checks)
+            *cost_checks, num, *self._compressed_step_numerics(batch))
         rep.cost = cost
         return rep
 

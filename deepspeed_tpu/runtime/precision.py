@@ -11,12 +11,75 @@ overflow check, skip-update, and scale adjustment are all traced
 (no host round-trip per step, unlike the reference's `.item()` checks).
 """
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
 from ..config.config import FP16Config
+
+# numpy dtype name -> XLA/HLO shorthand, the vocabulary the numerics
+# sanitizer (analysis/numerics.py) compares compiled programs against
+_HLO_DTYPE_NAMES = {
+    "float64": "f64", "float32": "f32", "float16": "f16",
+    "bfloat16": "bf16",
+    "int64": "s64", "int32": "s32", "int16": "s16", "int8": "s8",
+    "uint64": "u64", "uint32": "u32", "uint16": "u16", "uint8": "u8",
+    "bool": "pred",
+}
+# config-file dtype spellings (reference data_types block) -> HLO names
+_CONFIG_DTYPE_NAMES = {
+    "fp32": "f32", "float32": "f32", "f32": "f32",
+    "fp16": "f16", "float16": "f16", "f16": "f16",
+    "bf16": "bf16", "bfloat16": "bf16",
+}
+
+
+def hlo_dtype_name(dtype) -> str:
+    """HLO shorthand ('f32', 'bf16', ...) of a numpy/jax dtype."""
+    import numpy as np
+
+    return _HLO_DTYPE_NAMES.get(np.dtype(dtype).name, str(np.dtype(dtype)))
+
+
+class PrecisionPolicy(NamedTuple):
+    """The precision contract a config DECLARES for its compiled steps —
+    what the numerics sanitizer (N001-N004) verifies the HLO against.
+    All dtype fields use HLO shorthand ('f32', 'bf16', 'f16')."""
+
+    compute: str                 # forward/backward compute dtype
+    master: Optional[str]        # master-weight dtype (None = no master)
+    grad_accum: str              # gradient ACCUMULATION dtype (scan acc)
+    grad_comm: str               # gradient-reduction COLLECTIVE payload
+    loss_scaled: bool            # fp16 dynamic loss scaling active
+    compressed: Optional[str] = None  # 'onebit' | 'zoadam' | 'qgz' | None
+
+
+def precision_policy(config, compressed: Optional[str] = None) -> PrecisionPolicy:
+    """Derive the declared policy from a DeepSpeedTPUConfig: compute
+    dtype from the bf16/fp16 blocks, fp32 master per bf16.master_weights
+    (fp16 always keeps one), grad accumulation from
+    `data_types.grad_accum_dtype` (default fp32 — the engine's scan
+    accumulators are fp32 by construction), collective payload from
+    `communication_data_type` (default: the compute dtype, the
+    reference default — XLA places the data-parallel grad psum on the
+    low-precision side of the master-cast boundary)."""
+    compute = hlo_dtype_name(config.compute_dtype)
+    use_master = compute != "f32" and (
+        config.bf16.master_weights if config.bf16.enabled else True)
+    declared = getattr(config, "data_types", None)
+    accum = getattr(declared, "grad_accum_dtype", None) if declared else None
+    comm = getattr(config, "communication_data_type", None)
+    return PrecisionPolicy(
+        compute=compute,
+        master="f32" if use_master else None,
+        grad_accum=_CONFIG_DTYPE_NAMES.get(str(accum).lower(), "f32")
+        if accum else "f32",
+        grad_comm=_CONFIG_DTYPE_NAMES.get(str(comm).lower(), compute)
+        if comm else compute,
+        loss_scaled=config.fp16.enabled,
+        compressed=compressed,
+    )
 
 
 class LossScaleState(NamedTuple):
@@ -39,8 +102,18 @@ def init_loss_scale(cfg: FP16Config) -> LossScaleState:
 
 def found_inf_in_grads(grads) -> jnp.ndarray:
     """Global overflow flag (ref: fused_optimizer.py overflow check via
-    _check_overflow). All-finite reduction fuses into the grad epilogue."""
-    leaves = jax.tree.leaves(grads)
+    _check_overflow). All-finite reduction fuses into the grad epilogue.
+    Integer-dtype leaves (token counts, masks riding a grad pytree) are
+    always finite and are skipped; an empty grad pytree reports no
+    overflow instead of raising."""
+
+    def is_float(g):
+        dt = getattr(g, "dtype", None)
+        return dt is None or jnp.issubdtype(dt, jnp.inexact)
+
+    leaves = [g for g in jax.tree.leaves(grads) if is_float(g)]
+    if not leaves:
+        return jnp.bool_(False)
     flags = [jnp.logical_not(jnp.all(jnp.isfinite(g))) for g in leaves]
     out = flags[0]
     for f in flags[1:]:

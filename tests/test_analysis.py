@@ -629,6 +629,91 @@ class TestLintR005:
         assert not found and len(suppressed) == 1
 
 
+class TestLintR006:
+    def test_float64_mention_fires(self):
+        src = """
+        import jax
+        import numpy as np
+        @jax.jit
+        def f(x):
+            return x.astype(np.float64)
+        """
+        found, _ = _findings(src)
+        assert [f.rule for f in found] == ["R006"]
+        assert "f64" in found[0].message
+
+    def test_dtypeless_zeros_and_arange_fire(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            acc = jnp.zeros((4, 4))
+            idx = jnp.arange(4)
+            return acc + x[idx]
+        """
+        found, _ = _findings(src)
+        assert [f.rule for f in found] == ["R006", "R006"]
+        assert all(f.severity == "warning" for f in found)
+
+    def test_pinned_dtypes_are_clean(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            acc = jnp.zeros((4, 4), jnp.float32)
+            acc2 = jnp.ones((4,), dtype=x.dtype)
+            idx = jnp.arange(4, dtype=jnp.int32)
+            return acc + acc2[idx]
+        """
+        found, _ = _findings(src)
+        assert not found
+
+    def test_astype_python_float_fires(self):
+        src = """
+        import jax
+        @jax.jit
+        def f(x):
+            return x.astype(float)
+        """
+        found, _ = _findings(src)
+        assert [f.rule for f in found] == ["R006"]
+
+    def test_astype_explicit_jnp_dtype_is_clean(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            return x.astype(jnp.float32)
+        """
+        found, _ = _findings(src)
+        assert not found
+
+    def test_outside_jit_is_clean(self):
+        src = """
+        import numpy as np
+        import jax.numpy as jnp
+        def host():
+            return jnp.zeros((4,)) + np.float64(1.0)
+        """
+        found, _ = _findings(src)
+        assert not found
+
+    def test_pragma_suppresses(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            acc = jnp.zeros(x.shape)  # ds-lint: ok R006 inherits x64 policy deliberately
+            return acc + x
+        """
+        found, suppressed = _findings(src)
+        assert not found and len(suppressed) == 1
+
+
 class TestMergeReports:
     def _f(self, rule, path="p"):
         from deepspeed_tpu.analysis import Finding

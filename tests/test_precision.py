@@ -112,3 +112,61 @@ def test_consecutive_hysteresis_refills_on_good_steps():
     s = update_loss_scale(s, jnp.bool_(False), c)  # refill
     s = update_loss_scale(s, jnp.bool_(True), c)  # burns refilled credit
     assert float(s.scale) == 256.0
+
+
+# --- direct overflow/growth WINDOW dynamics (PR-5 satellite) -----------
+
+def test_overflow_resets_growth_window():
+    """good_steps is the growth window's clock: an overflow at step
+    window-1 zeroes it, so growth needs a FULL clean window again."""
+    c = cfg(initial_scale_power=8, loss_scale_window=3, hysteresis=1)
+    s = init_loss_scale(c)
+    s = update_loss_scale(s, jnp.bool_(False), c)
+    s = update_loss_scale(s, jnp.bool_(False), c)
+    s = update_loss_scale(s, jnp.bool_(True), c)  # overflow at window-1
+    assert int(s.good_steps) == 0
+    assert float(s.scale) == 128.0  # hysteresis=1: immediate backoff
+    for _ in range(2):
+        s = update_loss_scale(s, jnp.bool_(False), c)
+    assert float(s.scale) == 128.0  # window not yet refilled
+    s = update_loss_scale(s, jnp.bool_(False), c)
+    assert float(s.scale) == 256.0  # full window elapsed -> grow
+
+
+def test_growth_exactly_at_window_boundary():
+    c = cfg(initial_scale_power=8, loss_scale_window=2, hysteresis=1)
+    s = init_loss_scale(c)
+    s = update_loss_scale(s, jnp.bool_(False), c)
+    assert float(s.scale) == 256.0  # 1 < window: no growth yet
+    s = update_loss_scale(s, jnp.bool_(False), c)
+    assert float(s.scale) == 512.0  # exactly window clean steps
+    assert int(s.good_steps) == 0  # window clock restarts after growth
+
+
+def test_growth_refills_hysteresis():
+    """Growth is the ONLY hysteresis refill under the reference default
+    (consecutive_hysteresis=False)."""
+    c = cfg(initial_scale_power=8, loss_scale_window=2, hysteresis=2)
+    s = init_loss_scale(c)
+    s = update_loss_scale(s, jnp.bool_(True), c)  # burn one credit
+    assert int(s.hysteresis_left) == 1
+    s = update_loss_scale(s, jnp.bool_(False), c)
+    s = update_loss_scale(s, jnp.bool_(False), c)  # window -> grow
+    assert float(s.scale) == 512.0
+    assert int(s.hysteresis_left) == 2  # refilled by growth
+    s = update_loss_scale(s, jnp.bool_(True), c)
+    assert float(s.scale) == 512.0  # credit absorbs the next overflow
+
+
+def test_found_inf_skips_integer_leaves():
+    grads = {"w": jnp.array([1.0, 2.0]),
+             "token_count": jnp.array([3], jnp.int32)}
+    assert not bool(found_inf_in_grads(grads))
+    grads["w"] = jnp.array([1.0, jnp.inf])
+    assert bool(found_inf_in_grads(grads))
+
+
+def test_found_inf_empty_and_integer_only_trees():
+    assert not bool(found_inf_in_grads({}))
+    assert not bool(found_inf_in_grads(
+        {"steps": jnp.zeros((2,), jnp.int32)}))

@@ -70,11 +70,16 @@ _PRAGMA_RE = re.compile(
 # per-token / per-step loops. A name matches when it equals an entry or
 # starts with `entry` + one of the listed prefixes.
 _HOT_FILES = ("runtime/engine.py", "inference/engine.py",
-              "runtime/hybrid_engine.py", "inference/scheduler.py")
+              "runtime/hybrid_engine.py", "inference/scheduler.py",
+              "inference/router.py")
 _HOT_FN_PREFIXES = (
     "train_batch", "eval_batch", "_dispatch", "decode", "_decode",
     "generate", "put", "step", "_sample", "prefill", "_prefill",
     "run", "_finalize", "_accept", "submit", "_admit",
+    # router/handoff loop (inference/router.py + the engine transfer
+    # path): readbacks route through utils/sync.serving_readback
+    "pump", "serve", "adopt", "requeue", "_route", "fail_replica",
+    "export_kv", "import_kv",
 )
 _SYNC_CALLS = ("block_until_ready", "device_get")
 # serving_readback: the scheduler loop's one named readback point

@@ -306,6 +306,67 @@ class ServingSchedulerConfig(ConfigModel):
         return self
 
 
+class ServingRouterConfig(ConfigModel):
+    """Multi-replica serving front door (inference/router.py
+    ServingRouter) — the fleet layer over N ServingScheduler-backed
+    engine replicas.
+
+    replicas: fleet size (informational when engines are passed
+    explicitly; a mismatch with the engine list raises).
+    policy: 'prefix_aware' scores each replica by
+    ``load/max_batch - cache_weight * cached_prefix_fraction`` using
+    the blake2b hash-chain prefix index as the locality signal;
+    'round_robin' ignores locality (the comparison baseline).
+    cache_weight: how many normalized-load units a fully-cached prompt
+    is worth — 0 reduces prefix_aware to pure least-loaded.
+    session_affinity: pin multi-turn sessions to their replica (turn
+    N+1 extends turn N's cached prefix); a pin breaks when the pinned
+    replica's backlog exceeds the least-loaded replica's by
+    affinity_evict_margin requests.
+    mode: 'colocated' replicas each run prefill AND decode;
+    'disaggregated' dedicates the first prefill_replicas replicas to
+    chunked prefill and hands finished sequences' paged KV blocks to
+    the decode replicas (DistServe/Splitwise) — fleets too small to
+    split fall back to colocated with a log line.
+    speculative_replicas: run the LAST K decode replicas' schedulers
+    in speculative mode (prompt-lookup self-drafting, greedy-only) —
+    the per-replica mode flag the router reports through metrics().
+    scheduler: the per-replica ServingSchedulerConfig."""
+
+    replicas: int = 1
+    policy: str = "prefix_aware"
+    cache_weight: float = 2.0
+    session_affinity: bool = True
+    affinity_evict_margin: int = 4
+    mode: str = "colocated"
+    prefill_replicas: int = 1
+    speculative_replicas: int = 0
+    scheduler: ServingSchedulerConfig = Field(
+        default_factory=ServingSchedulerConfig)
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.policy not in ("prefix_aware", "round_robin"):
+            raise ValueError(
+                f"unknown routing policy '{self.policy}' "
+                "(expected prefix_aware|round_robin)")
+        if self.mode not in ("colocated", "disaggregated"):
+            raise ValueError(
+                f"unknown router mode '{self.mode}' "
+                "(expected colocated|disaggregated)")
+        if self.replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        if self.prefill_replicas < 1:
+            raise ValueError("prefill_replicas must be >= 1")
+        if self.speculative_replicas < 0:
+            raise ValueError("speculative_replicas must be >= 0")
+        if self.cache_weight < 0:
+            raise ValueError("cache_weight must be >= 0")
+        if self.affinity_evict_margin < 0:
+            raise ValueError("affinity_evict_margin must be >= 0")
+        return self
+
+
 class CurriculumConfig(ConfigModel):
     """ref: runtime/data_pipeline/curriculum_scheduler.py config (the
     legacy 'curriculum_learning' block). Consumed by the engine: with

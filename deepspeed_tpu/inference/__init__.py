@@ -10,6 +10,7 @@ from .ragged import (
     SequenceDescriptor,
     StateManager,
 )
+from .router import ServingRouter, ServingRouterConfig
 from .scheduler import Request, ServingScheduler, ServingSchedulerConfig
 
 __all__ = [
@@ -22,6 +23,8 @@ __all__ = [
     "SequenceDescriptor",
     "StateManager",
     "Request",
+    "ServingRouter",
+    "ServingRouterConfig",
     "ServingScheduler",
     "ServingSchedulerConfig",
 ]

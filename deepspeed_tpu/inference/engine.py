@@ -34,6 +34,7 @@ from pydantic import Field
 from ..config.config import ConfigModel, PrefixCacheConfig
 from ..models import transformer as T
 from ..utils.logging import log_dist
+from ..utils.sync import serving_readback
 from . import model as M
 from .ragged import StateManager
 
@@ -321,6 +322,12 @@ class InferenceEngine:
             cache_pool_blocks=self.config.prefix_cache.pool_blocks,
         )
         self._cow_fn = None  # compiled (cache, src, dst) -> cache page copy
+        # compiled block-table transfer pair (disaggregated serving):
+        # gather a sequence's KV pages out / scatter them into another
+        # engine's cache. Fixed [blocks_per_seq] index width, so ONE
+        # program each regardless of sequence length.
+        self._kv_gather = None
+        self._kv_scatter = None
         # one RESERVED scratch block past the allocator's range: fused
         # write+attend RMWs every decode row's newest block, so padding
         # rows need a target that can never alias a live sequence
@@ -729,6 +736,112 @@ class InferenceEngine:
         cached-token ratio, LRU evictions, COW copies (ragged.py
         StateManager.cache_stats)."""
         return self.state.cache_stats()
+
+    # -- paged-KV block transfer (prefill/decode disaggregation) ---------
+    def _kv_gather_fn(self):
+        """Compiled gather of [blocks_per_seq] cache pages across every
+        layer: (cache, idx) -> ([L, B, bs, KV, D] k, same v). Pad slots
+        index the reserved scratch block, so one program serves every
+        sequence length."""
+        if self._kv_gather is None:
+            def gather(cache, idx):
+                return (jnp.stack([ck[idx] for ck in cache.k]),
+                        jnp.stack([cv[idx] for cv in cache.v]))
+
+            self._kv_gather = jax.jit(gather)
+        return self._kv_gather
+
+    def _kv_scatter_fn(self):
+        """Compiled scatter of transferred pages into this cache:
+        (cache, idx, k, v) -> cache with rows idx overwritten. Pad rows
+        land on the reserved scratch block (never a live page)."""
+        if self._kv_scatter is None:
+            def scatter(cache, idx, k, v):
+                return M.PagedCache(
+                    k=[ck.at[idx].set(k[l]) for l, ck in enumerate(cache.k)],
+                    v=[cv.at[idx].set(v[l]) for l, cv in enumerate(cache.v)],
+                )
+
+            # donated: the live cache aliases the returned one (an
+            # in-place page write, no second cache allocation)
+            self._kv_scatter = jax.jit(scatter, donate_argnums=(0,))
+        return self._kv_scatter
+
+    def _pad_block_idx(self, blocks: List[int]) -> np.ndarray:
+        idx = np.full((self.config.blocks_per_seq,), self.pad_block,
+                      np.int32)
+        idx[:len(blocks)] = blocks
+        return idx
+
+    def export_kv(self, uid: int) -> Dict[str, Any]:
+        """Serialize one sequence's paged KV for a cross-engine handoff
+        (the DistServe/Splitwise prefill->decode transfer): gather its
+        block pages in ONE compiled program and read them back as host
+        numpy. The payload is self-describing — seen_tokens, the token
+        record (for the receiver's prefix index), and the [L, n_blocks,
+        bs, KV, D] K/V page stacks — and import_kv() on any
+        geometry-identical engine reconstructs the sequence exactly.
+        The readback routes through utils.sync.serving_readback: it is
+        a deliberate transfer-boundary sync, sized in KV pages (never
+        logits), and the only host crossing in the handoff path."""
+        seq = self.state.get(uid)
+        if seq is None:
+            raise KeyError(f"unknown sequence uid {uid}")
+        nb = len(seq.blocks)
+        idx = self._pad_block_idx(seq.blocks)
+        self.recompile_tracker.record("kv_transfer_gather", (idx,))
+        k, v = self._kv_gather_fn()(self.cache, self._dev(idx))
+        return {
+            "seen_tokens": int(seq.seen_tokens),
+            "n_blocks": nb,
+            "token_ids": (list(seq.tokens[:seq.seen_tokens])
+                          if seq.tokens_valid else None),
+            "k": serving_readback(k)[:, :nb],
+            "v": serving_readback(v)[:, :nb],
+        }
+
+    def import_kv(self, uid: int, payload: Dict[str, Any]) -> None:
+        """Adopt a sequence whose KV pages arrive from export_kv() on a
+        peer engine: allocate blocks, scatter the pages in ONE compiled
+        program, and commit the token record (which also registers the
+        transferred prefix in THIS engine's hash-chain index, so later
+        prompts sharing it route here for free). Raises RuntimeError
+        when the pool cannot fit the sequence — callers fall back to
+        recompute (token-identical: draws key on seed/stream/position,
+        not on which replica runs them)."""
+        n_tok = int(payload["seen_tokens"])
+        nb = int(payload["n_blocks"])
+        k, v = payload["k"], payload["v"]
+        want = self.cache.k[0].shape[1:]  # (bs, KV, D) per page
+        if tuple(k.shape[2:]) != want or k.shape[0] != self.cfg.n_layers:
+            raise ValueError(
+                f"KV payload geometry {k.shape} does not match this "
+                f"engine's cache pages {(self.cfg.n_layers, nb) + want} — "
+                "disaggregated replicas must be model/geometry-identical")
+        seq = self.state.extend(uid, n_tok)  # may raise: pool exhausted
+        assert len(seq.blocks) == nb, (len(seq.blocks), nb)
+        idx = self._pad_block_idx(seq.blocks)
+        B = self.config.blocks_per_seq
+        dt = self.cache.k[0].dtype
+        kp = np.zeros((k.shape[0], B) + tuple(k.shape[2:]), dt)
+        vp = np.zeros_like(kp)
+        kp[:, :nb], vp[:, :nb] = k, v
+        self.recompile_tracker.record("kv_transfer_scatter", (idx,))
+        self.cache = self._kv_scatter_fn()(
+            self.cache, self._dev(idx), self._dev(kp), self._dev(vp))
+        self.state.commit(uid, n_tok, token_ids=payload["token_ids"])
+
+    def warmup_kv_transfer(self) -> None:
+        """Precompile + signature-baseline the handoff gather/scatter
+        pair over scratch-only indices, so the first real handoff in
+        steady-state serving compiles nothing (the same zero-recompile
+        contract warmup() gives the decode grid)."""
+        idx = self._pad_block_idx([])
+        self.recompile_tracker.record("kv_transfer_gather", (idx,))
+        k, v = self._kv_gather_fn()(self.cache, self._dev(idx))
+        self.recompile_tracker.record("kv_transfer_scatter", (idx,))
+        self.cache = self._kv_scatter_fn()(
+            self.cache, self._dev(idx), k, v)
 
     # -- scheduling queries (ref: engine_v2.py query:158/can_schedule:184)
     def query(self, uid: int) -> Dict[str, Any]:
@@ -1282,7 +1395,8 @@ class InferenceEngine:
 
         return_stats=True additionally returns a dict of per-run
         counters: steps, draft/accepted token totals, mean accepted
-        length, and draft_collapsed_steps — steps where the shared
+        length, draft_acceptance_rate (accepted draft tokens over
+        proposed draft tokens), and draft_collapsed_steps — steps where the shared
         verify-row budget (max_batch_size // n_live) forced per_seq=1
         so k=0 and speculation degenerated to one-token decode. The
         first such step also logs a warning, so a silently-serial
@@ -1312,11 +1426,10 @@ class InferenceEngine:
         sched.run()
         outs = [sched.finished[r].output for r in rids]
         if return_stats:
-            stats = dict(sched.spec_stats)
-            stats["mean_accepted"] = (
-                stats["accepted_tokens"] / stats["verified_chunks"]
-                if stats["verified_chunks"] else 0.0)
-            return outs, stats
+            # one authority for the derived rates (mean_accepted,
+            # draft_acceptance_rate): the scheduler's spec_summary —
+            # the same numbers the router reports per replica
+            return outs, sched.spec_summary()
         return outs
 
     # -- sampling (v1 generate inherits full HF sampling; here the same

@@ -278,6 +278,21 @@ class StateManager:
         return s
 
     # -- prefix index ----------------------------------------------------
+    def lookup_prefix(self, token_ids) -> int:
+        """How many leading tokens of `token_ids` this manager could
+        serve from its prefix index RIGHT NOW, without acquiring or
+        mutating anything — the routing signal a multi-replica front
+        door scores replicas by (inference/router.py). Mirrors the
+        admission cap exactly: a whole-prompt match reports len-1 (the
+        last token must run to produce logits), so the returned count
+        equals the `n_cached` an immediate extend(token_ids=...) on
+        this replica would get."""
+        if not self.enable_prefix_cache or len(token_ids) < 2:
+            return 0
+        chain = self._walk_chain(token_ids)
+        return max(0, min(len(chain) * self.block_size,
+                          len(token_ids) - 1))
+
     def _walk_chain(self, token_ids) -> List[Tuple[bytes, int]]:
         """Longest indexed full-block chain prefix of token_ids:
         [(key, block), ...] in position order. Read-only."""

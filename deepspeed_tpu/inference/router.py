@@ -1,0 +1,466 @@
+"""Multi-replica serving front door: prefix-aware request router with
+optional prefill/decode disaggregation.
+
+One ServingScheduler saturates one engine replica; serving heavy
+traffic needs the layer ABOVE it — the analog of the reference's
+MII/inference-v2 deployment tier. `ServingRouter` owns N scheduler-
+backed replicas and decides, per request, WHERE work runs:
+
+- **prefix-cache-aware scoring** (the KV-locality lever — Splitwise
+  Patel et al. 2024, SGLang's cache-aware routing): every replica's
+  blake2b hash-chain prefix index (inference/ragged.py) is queried
+  READ-ONLY for the longest cached prefix of the incoming prompt, and
+  the request routes to the replica minimizing
+  ``load - cache_weight * cached_fraction`` — a replica already
+  holding the prompt's system prefix wins unless it is drowning.
+  The index walk is pure host-side hashing: scoring N replicas costs
+  microseconds and touches no device state.
+- **session affinity**: multi-turn sessions pin to their replica (the
+  turn-2 prompt extends turn 1's prefix, which lives exactly there).
+  Pins break under load skew: when the pinned replica's backlog
+  exceeds the least-loaded replica's by `affinity_evict_margin`
+  requests, the session re-pins to the best-scored replica (its old
+  prefix usually follows via the cache score once the new replica
+  serves turn N).
+- **prefill/decode disaggregation** (DistServe Zhong et al. 2024 /
+  Splitwise): dedicated prefill replicas run chunked prefill and the
+  first-token sample, then PARK (scheduler state ``handoff``); the
+  router transfers the finished sequence's paged KV blocks to a decode
+  replica through the serialized block-table path
+  (engine.export_kv -> import_kv: one compiled gather, one host-side
+  payload, one compiled scatter) and the decode replica adopts it
+  RUNNING. Prefill interference never touches decode TPOT, and each
+  pool batches its own phase optimally. A fleet too small to split
+  (< 1 prefill + 1 decode) falls back to colocated mode with a log
+  line. Transfers compound with prefix caching: import registers the
+  moved prefix in the decode replica's hash index.
+- **speculative decoding as a replica MODE**: a per-replica flag
+  (`speculative_replicas`) runs the last K replicas' schedulers in the
+  speculative control plane — router-visible (per-replica
+  draft_acceptance_rate / draft_collapsed_steps in metrics()), not a
+  per-call wrapper.
+- **failover**: `fail_replica(i)` marks a replica dead and requeues
+  its in-flight requests onto live replicas. No token is lost or
+  changed: accepted output rides along on the Request, and recompute
+  re-draws identically because sampling keys on (seed, stream,
+  position) — the router owns both seed and stream, so WHERE a request
+  runs never shows in WHAT it generates.
+
+The router is single-threaded by design, like the scheduler under it:
+`serve()` round-robins step()/pump() across replicas until idle, and
+the serving simulator (bench.py --serving-sim --replicas N) drives
+step() per replica under a virtual clock instead. Real deployments
+put each replica's step loop on its own thread/host and call
+submit()/pump() from the front-end thread; all cross-replica state
+(routing tables, session pins) lives in this one object.
+
+Token identity across every topology (asserted in tests/test_router.py):
+colocated == disaggregated == any failover interleaving, because the
+transferred KV pages are bit-exact copies and draws key on
+(seed, stream, position).
+"""
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..config.config import ServingRouterConfig, ServingSchedulerConfig
+from ..utils.logging import log_dist
+from .engine import InferenceEngine
+from .scheduler import Request, ServingScheduler
+
+__all__ = ["ServingRouter", "ServingRouterConfig"]
+
+
+class ServingRouter:
+    """Front door over N ServingScheduler-backed engine replicas.
+
+    engines: one geometry-identical InferenceEngine per replica (same
+    model, kv_block_size, blocks_per_seq, cache dtype — validated;
+    disaggregation moves raw KV pages between them). config: a
+    ServingRouterConfig (or dict). sampling/seed are shared by every
+    replica's scheduler: the router hands each request a globally
+    unique stream id, so outputs are independent of placement."""
+
+    def __init__(
+        self,
+        engines: Sequence[InferenceEngine],
+        config: Union[ServingRouterConfig, Dict[str, Any], None] = None,
+        sampling: Optional[Dict[str, Any]] = None,
+        seed: int = 0,
+        speculative: Optional[Dict[str, int]] = None,
+    ):
+        engines = list(engines)
+        if not engines:
+            raise ValueError(
+                "ServingRouter needs at least one engine replica")
+        if isinstance(config, dict):
+            config = ServingRouterConfig(**config)
+        self.cfg = config or ServingRouterConfig()
+        if self.cfg.replicas > 1 and self.cfg.replicas != len(engines):
+            raise ValueError(
+                f"config.replicas={self.cfg.replicas} but "
+                f"{len(engines)} engines were provided")
+        self._check_homogeneous(engines)
+        self.seed = int(seed)
+
+        # -- role split -------------------------------------------------
+        self.mode = self.cfg.mode
+        n_p = self.cfg.prefill_replicas
+        if self.mode == "disaggregated" and (
+                len(engines) < 2 or n_p < 1 or len(engines) - n_p < 1):
+            log_dist(
+                f"serving router: fleet of {len(engines)} cannot split "
+                f"into {n_p} prefill + >=1 decode replicas — falling "
+                "back to colocated mode",
+                ranks=[0])
+            self.mode = "colocated"
+        if self.mode == "disaggregated":
+            self.prefill_idx = list(range(n_p))
+            self.decode_idx = list(range(n_p, len(engines)))
+        else:
+            self.prefill_idx = []
+            self.decode_idx = list(range(len(engines)))
+
+        # -- per-replica schedulers (speculative = a replica mode flag) -
+        n_spec = min(self.cfg.speculative_replicas, len(self.decode_idx))
+        spec_set = set(self.decode_idx[len(self.decode_idx) - n_spec:])
+        spec = dict(speculative) if speculative else \
+            {"ngram": 3, "draft_len": 4}
+        self.replica_mode: List[str] = []
+        self.schedulers: List[ServingScheduler] = []
+        for i, eng in enumerate(engines):
+            mode = ("prefill" if i in self.prefill_idx
+                    else "speculative" if i in spec_set else "decode"
+                    if self.mode == "disaggregated" else
+                    "speculative" if i in spec_set else "mixed")
+            self.replica_mode.append(mode)
+            self.schedulers.append(ServingScheduler(
+                eng, self.cfg.scheduler, sampling=sampling,
+                seed=self.seed,
+                speculative=spec if mode == "speculative" else None))
+        if self.mode == "disaggregated":
+            # the handoff gather/scatter pair joins the AOT-warmed set:
+            # the first real transfer must compile nothing (the same
+            # zero-recompile steady-state contract as the decode grid)
+            for eng in engines:
+                eng.warmup_kv_transfer()
+
+        # -- routing state ----------------------------------------------
+        self.dead: set = set()
+        self._reqs: Dict[int, Request] = {}      # gid -> request
+        self._where: Dict[int, int] = {}         # gid -> replica index
+        self._session_of: Dict[int, Any] = {}    # gid -> session id
+        self._sessions: Dict[Any, int] = {}      # session id -> replica
+        self._next_gid = 0
+        self._rr_next = 0                        # round-robin cursor
+        self._handoff_s: List[float] = []        # transfer wall times
+        self.counters: Dict[str, int] = {
+            "routed": 0, "cache_hit_routes": 0, "affinity_hits": 0,
+            "affinity_evictions": 0, "handoffs": 0,
+            "handoff_fallbacks": 0, "requeued_on_death": 0,
+        }
+
+    @staticmethod
+    def _check_homogeneous(engines: Sequence[InferenceEngine]) -> None:
+        ref = engines[0]
+        want = (ref.config.kv_block_size, ref.config.blocks_per_seq,
+                ref.cfg.n_layers, ref.cache.k[0].shape[1:],
+                ref.cache.k[0].dtype)
+        for i, e in enumerate(engines[1:], 1):
+            got = (e.config.kv_block_size, e.config.blocks_per_seq,
+                   e.cfg.n_layers, e.cache.k[0].shape[1:],
+                   e.cache.k[0].dtype)
+            if got != want:
+                raise ValueError(
+                    f"replica {i} geometry {got} != replica 0 {want} — "
+                    "the fleet must be model/geometry-identical (KV "
+                    "pages move between replicas verbatim)")
+
+    # -- load + scoring ---------------------------------------------------
+    def _load(self, i: int) -> int:
+        """Backlog of replica i, in requests (queued + in flight)."""
+        s = self.schedulers[i]
+        return len(s.waiting) + len(s.active) + len(s.handoff_ready)
+
+    def _live(self, pool: Sequence[int]) -> List[int]:
+        live = [i for i in pool if i not in self.dead]
+        if not live:
+            raise RuntimeError(
+                "serving router: no live replica in the "
+                f"{'prefill' if pool == self.prefill_idx else 'serving'} "
+                "pool")
+        return live
+
+    def _route(self, prompt: List[int], session: Any,
+               pool: Sequence[int]) -> int:
+        """Pick the replica for one prompt: session pin when healthy,
+        else cache-hit-weighted least-loaded (or plain round-robin
+        under policy='round_robin')."""
+        choice = self._pick(prompt, session, pool)
+        self.counters["routed"] += 1
+        # cache-hit routing rate counts the OUTCOME — did the request
+        # land where its prefix already lives? — regardless of which
+        # rule (pin, score, round-robin) made the pick
+        if self.schedulers[choice].engine.state.lookup_prefix(prompt) > 0:
+            self.counters["cache_hit_routes"] += 1
+        if session is not None and self.cfg.session_affinity:
+            self._sessions[session] = choice
+        return choice
+
+    def _pick(self, prompt: List[int], session: Any,
+              pool: Sequence[int]) -> int:
+        live = self._live(pool)
+        if len(live) == 1:
+            return live[0]
+        loads = {i: self._load(i) for i in live}
+        min_load = min(loads.values())
+        if session is not None and self.cfg.session_affinity:
+            pinned = self._sessions.get(session)
+            if pinned in loads:
+                if loads[pinned] - min_load <= self.cfg.affinity_evict_margin:
+                    self.counters["affinity_hits"] += 1
+                    return pinned
+                # load skew: break the pin, re-score below and re-pin
+                self.counters["affinity_evictions"] += 1
+        if self.cfg.policy == "round_robin":
+            for _ in range(len(self.schedulers)):
+                i = self._rr_next % len(self.schedulers)
+                self._rr_next += 1
+                if i in loads:
+                    return i
+        best, best_score = None, None
+        for i in live:
+            cached = self.schedulers[i].engine.state.lookup_prefix(prompt)
+            frac = cached / len(prompt)
+            cap = max(1, self.schedulers[i].engine.config.max_batch_size)
+            score = loads[i] / cap - self.cfg.cache_weight * frac
+            # ties break toward the less-loaded, then lower index
+            if best_score is None or (score, loads[i], i) < \
+                    (best_score, loads[best], best):
+                best, best_score = i, score
+        return best
+
+    # -- intake -----------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None,
+               session: Any = None) -> int:
+        """Route one request into the fleet; returns a router-global
+        request id. In disaggregated mode the request lands on a
+        prefill replica and moves to a decode replica at first token
+        (pump()); otherwise it lives its whole life where it lands.
+        `session` (any hashable) enables affinity pinning."""
+        prompt = [int(t) for t in prompt]
+        gid = self._next_gid
+        self._next_gid += 1
+        pool = (self.prefill_idx if self.mode == "disaggregated"
+                else self.decode_idx)
+        r = self._route(prompt, session, pool)
+        sched = self.schedulers[r]
+        sched.submit(prompt, max_new_tokens, eos_token_id, stream=gid,
+                     handoff=self.mode == "disaggregated")
+        req = sched.waiting[-1]  # submit() appends; single-threaded
+        self._reqs[gid] = req
+        self._where[gid] = r
+        if session is not None:
+            self._session_of[gid] = session
+        return gid
+
+    def result(self, gid: int) -> Request:
+        """The Request for a router-global id (live view: .output grows
+        as the fleet decodes; .done flips at finish)."""
+        return self._reqs[gid]
+
+    @property
+    def has_work(self) -> bool:
+        return any(self._pending())
+
+    def _pending(self):
+        for i, s in enumerate(self.schedulers):
+            if i in self.dead:
+                continue
+            yield s.has_work or bool(s.handoff_ready)
+
+    # -- disaggregation: the block-table transfer path --------------------
+    def pump(self) -> List[Dict[str, float]]:
+        """Move prefill-complete requests to decode replicas: export
+        the sequence's KV pages from the prefill engine (one compiled
+        gather + one serialized host payload), flush it there (its
+        full blocks PARK in the prefill replica's prefix pool — the
+        next same-prefix prompt still scores a hit), import on the
+        least-loaded live decode replica, adopt RUNNING. Returns one
+        record per transfer ({prefill, decode, export_s, import_s})
+        so callers — the virtual-time simulator — can charge the cost
+        to the right clocks. A decode replica that cannot take the
+        sequence (batch or pool full) falls back to requeue-for-
+        recompute, which is token-identical."""
+        moves: List[Dict[str, float]] = []
+        if self.mode != "disaggregated":
+            return moves
+        for p in self.prefill_idx:
+            if p in self.dead:
+                continue
+            ps = self.schedulers[p]
+            while ps.handoff_ready:
+                req = ps.handoff_ready.popleft()
+                gid = req.stream
+                t0 = time.perf_counter()
+                payload = ps.engine.export_kv(req.uid)
+                ps.engine.flush(req.uid)
+                req.uid = None
+                t1 = time.perf_counter()
+                live = self._live(self.decode_idx)
+                d = min(live, key=lambda i: (self._load(i), i))
+                try:
+                    self.schedulers[d].adopt(req, payload)
+                except RuntimeError:
+                    self.counters["handoff_fallbacks"] += 1
+                    req.handoff = False  # decode locally after recompute
+                    self.schedulers[d].requeue(req)
+                t2 = time.perf_counter()
+                self._where[gid] = d
+                self._handoff_s.append(t2 - t0)
+                self.counters["handoffs"] += 1
+                moves.append({"prefill": p, "decode": d,
+                              "export_s": t1 - t0, "import_s": t2 - t1})
+        return moves
+
+    # -- failover ---------------------------------------------------------
+    def fail_replica(self, i: int) -> int:
+        """Mark replica i dead and requeue its in-flight requests onto
+        live replicas (disaggregated: back through the prefill pool —
+        a moved sequence needs a fresh prefill of prompt+output). The
+        engine's state is NOT touched (a dead replica's device is
+        gone); accepted output rides along on each Request and the
+        recompute re-draws identically, so callers observe a latency
+        blip, never a token change. Returns the number of requests
+        requeued."""
+        if i in self.dead:
+            return 0
+        self.dead.add(i)
+        s = self.schedulers[i]
+        orphans = list(s.active) + list(s.waiting) + list(s.handoff_ready)
+        s.active.clear()
+        s.waiting.clear()
+        s.handoff_ready.clear()
+        self._sessions = {k: v for k, v in self._sessions.items()
+                          if v != i}
+        moved = 0
+        for req in orphans:
+            req.uid = None  # the KV died with the replica
+            gid = req.stream
+            pool = (self.prefill_idx if self.mode == "disaggregated"
+                    else self.decode_idx)
+            r = self._route(req.base, self._session_of.get(gid), pool)
+            req.handoff = self.mode == "disaggregated"
+            self.schedulers[r].requeue(req)
+            self._where[gid] = r
+            self.counters["requeued_on_death"] += 1
+            moved += 1
+        log_dist(
+            f"serving router: replica {i} failed; requeued {moved} "
+            f"in-flight requests onto live replicas", ranks=[0])
+        return moved
+
+    # -- driving ----------------------------------------------------------
+    def step(self) -> bool:
+        """One fleet sweep: step every live replica once, then pump
+        handoffs. Returns False when nothing progressed."""
+        progressed = False
+        for i, sched in enumerate(self.schedulers):
+            if i in self.dead:
+                continue
+            if sched.step():
+                progressed = True
+        if self.pump():
+            progressed = True
+        return progressed
+
+    def serve(self, tick=None) -> None:
+        """Drive the fleet until idle (single-threaded round-robin —
+        the simulator/test driver; production threads one loop per
+        replica). tick(router), when given, runs once per sweep before
+        stepping — the arrival-injection hook."""
+        stalls = 0
+        while True:
+            if tick is not None:
+                tick(self)
+            progressed = self.step()
+            if not self.has_work and not progressed:
+                break
+            if progressed:
+                stalls = 0
+                continue
+            stalls += 1
+            if stalls > 2:
+                raise RuntimeError(
+                    "serving router stalled with work pending "
+                    f"({sum(len(s.waiting) for s in self.schedulers)} "
+                    "waiting)")
+
+    # -- observability ----------------------------------------------------
+    def describe(self) -> Dict[str, Any]:
+        """Static fleet topology: mode, per-replica role flags."""
+        return {
+            "mode": self.mode,
+            "replicas": len(self.schedulers),
+            "replica_mode": list(self.replica_mode),
+            "prefill_replicas": list(self.prefill_idx),
+            "decode_replicas": list(self.decode_idx),
+            "policy": self.cfg.policy,
+        }
+
+    def metrics(self) -> Dict[str, float]:
+        """Fleet-aggregate metrics under fleet/ plus every replica's
+        scheduler metrics under replica<i>/ — the monitor feed
+        (monitor.serving_events(router, step) emits all of them)."""
+        def pct(xs, q):
+            return float(np.percentile(np.asarray(xs), q) * 1e3) if xs \
+                else 0.0
+
+        m: Dict[str, float] = {}
+        ttft: List[float] = []
+        tpot: List[float] = []
+        spec_drafts = spec_accepted = spec_chunks = 0.0
+        spec_collapsed = 0.0
+        for i, s in enumerate(self.schedulers):
+            for k, v in s.metrics().items():
+                m[f"replica{i}/{k}"] = v
+            ttft += s._ttft
+            tpot += s._tpot
+            if s._spec:
+                spec_drafts += s.spec_stats["draft_tokens"]
+                spec_accepted += s.spec_stats["accepted_tokens"]
+                spec_chunks += s.spec_stats["verified_chunks"]
+                spec_collapsed += s.spec_stats["draft_collapsed_steps"]
+        m["fleet/replicas"] = float(len(self.schedulers))
+        m["fleet/live_replicas"] = float(
+            len(self.schedulers) - len(self.dead))
+        m["fleet/disaggregated"] = float(self.mode == "disaggregated")
+        m["fleet/queue_depth"] = float(
+            sum(len(s.waiting) for s in self.schedulers))
+        m["fleet/active"] = float(
+            sum(len(s.active) for s in self.schedulers))
+        m["fleet/finished"] = float(
+            sum(len(s.finished) for s in self.schedulers))
+        m["fleet/ttft_p50_ms"] = pct(ttft, 50)
+        m["fleet/ttft_p95_ms"] = pct(ttft, 95)
+        m["fleet/tpot_p50_ms"] = pct(tpot, 50)
+        m["fleet/tpot_p95_ms"] = pct(tpot, 95)
+        routed = self.counters["routed"]
+        m["fleet/cache_hit_route_rate"] = (
+            self.counters["cache_hit_routes"] / routed if routed else 0.0)
+        m["fleet/handoff_p50_ms"] = pct(self._handoff_s, 50)
+        m["fleet/handoff_p95_ms"] = pct(self._handoff_s, 95)
+        m["fleet/recompiles"] = float(sum(
+            len(s.engine.recompile_tracker.findings)
+            for s in self.schedulers))
+        if spec_chunks:
+            m["fleet/spec_draft_collapsed_steps"] = spec_collapsed
+            m["fleet/spec_draft_acceptance_rate"] = (
+                (spec_accepted - spec_chunks) / spec_drafts
+                if spec_drafts else 0.0)
+        for k, v in self.counters.items():
+            m[f"fleet/{k}"] = float(v)
+        return m

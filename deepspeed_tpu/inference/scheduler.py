@@ -73,8 +73,8 @@ __all__ = ["Request", "ServingScheduler", "ServingSchedulerConfig",
 # schema — the two must not collide)
 SchedulerConfig = ServingSchedulerConfig
 
-WAITING, PREFILL, RUNNING, FINISHED = ("waiting", "prefill", "running",
-                                       "finished")
+WAITING, PREFILL, RUNNING, FINISHED, HANDOFF = (
+    "waiting", "prefill", "running", "finished", "handoff")
 
 
 @dataclasses.dataclass
@@ -98,6 +98,10 @@ class Request:
     finish_reason: Optional[str] = None    # eos | length | capacity
     preemptions: int = 0
     n_cached: int = 0                # prefix-cache-served prompt tokens
+    # prefill/decode disaggregation (inference/router.py): a handoff
+    # request parks after its FIRST sampled token — KV intact — for the
+    # router to transfer to a decode replica, instead of decoding here
+    handoff: bool = False
 
     @property
     def base(self) -> List[int]:
@@ -162,11 +166,14 @@ class ServingScheduler:
         self.waiting: "deque[Request]" = deque()
         self.active: List[Request] = []   # admission order; PREFILL/RUNNING
         self.finished: Dict[int, Request] = {}
+        # prefill-complete handoff requests awaiting KV transfer to a
+        # decode replica (router.pump() drains this; disaggregated mode)
+        self.handoff_ready: "deque[Request]" = deque()
         self._next_rid = 0
         self.counters: Dict[str, int] = {
             "steps": 0, "admitted": 0, "finished": 0, "preemptions": 0,
             "batched_tokens": 0, "fused_steps": 0, "chained_steps": 0,
-            "wave_prefills": 0,
+            "wave_prefills": 0, "handoffs": 0, "adopted": 0,
         }
         self.spec_stats: Dict[str, float] = {
             "steps": 0, "verified_chunks": 0, "draft_tokens": 0,
@@ -244,10 +251,15 @@ class ServingScheduler:
     # -- request intake --------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None,
-               stream: Optional[int] = None) -> int:
+               stream: Optional[int] = None,
+               handoff: bool = False) -> int:
         """Queue one request; returns its request id. The stream id
         (default: the rid) keys the request's PRNG stream — generate()
-        passes 0..n-1 so a fixed seed reproduces its exact batch."""
+        passes 0..n-1 so a fixed seed reproduces its exact batch.
+        handoff=True marks a disaggregated prefill request: it parks in
+        handoff_ready after its first sampled token instead of decoding
+        here (inference/router.py transfers its KV to a decode
+        replica)."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -261,7 +273,8 @@ class ServingScheduler:
                       max_new_tokens=int(max_new_tokens),
                       eos_token_id=eos_token_id,
                       stream=int(stream) if stream is not None else rid,
-                      arrival=time.perf_counter())
+                      arrival=time.perf_counter(),
+                      handoff=bool(handoff))
         if self.scfg.needs_presence:
             pres = np.zeros((self.engine.cfg.vocab_size,), np.uint8)
             toks = np.asarray(prompt, np.int64)
@@ -269,6 +282,48 @@ class ServingScheduler:
             req.presence = pres
         self.waiting.append(req)
         return rid
+
+    def requeue(self, req: Request) -> None:
+        """Accept an EXISTING Request for (re)compute on this replica —
+        the router's failover / handoff-capacity-fallback path. The
+        request keeps its identity (stream, arrival, accepted output),
+        so the re-drawn continuation is token-identical to never having
+        moved: draws key on (seed, stream, position). The dead/source
+        replica's KV is NOT flushed here — it is gone or already
+        released by the caller."""
+        req.uid = None
+        req.fed = 0
+        req.pending = None
+        req.state = WAITING
+        req.preemptions += 1
+        # a foreign rid may collide with a local one: re-key it so
+        # self.finished stays one-entry-per-request
+        req.rid = self._next_rid
+        self._next_rid += 1
+        self.waiting.append(req)
+
+    def adopt(self, req: Request, payload: Dict[str, Any]) -> None:
+        """Admit a prefill-complete request whose KV arrives by block
+        transfer (engine.import_kv payload): the sequence starts
+        RUNNING here with its first token pending — no recompute.
+        Raises RuntimeError when the batch or the KV pool cannot take
+        it (callers fall back to requeue())."""
+        if len(self.active) >= self.engine.config.max_batch_size:
+            raise RuntimeError(
+                f"decode replica at max_batch_size "
+                f"{self.engine.config.max_batch_size}")
+        uid = self._alloc_uid()
+        self.engine.import_kv(uid, payload)  # may raise: pool exhausted
+        req.uid = uid
+        req.rid = self._next_rid
+        self._next_rid += 1
+        req.handoff = False
+        req.fed = int(payload["seen_tokens"])
+        req.pending = req.output[-1]
+        req.state = RUNNING
+        self.active.append(req)
+        self.counters["adopted"] += 1
+        self.counters["admitted"] += 1
 
     @property
     def has_work(self) -> bool:
@@ -655,6 +710,17 @@ class ServingScheduler:
             self._finish(req, "length")
             return
         req.pending = tok
+        if req.handoff:
+            # disaggregated prefill: first token produced, KV complete —
+            # park for the router's block transfer instead of decoding
+            # here. Blocks stay allocated until export; finish-path
+            # cases above (EOS / budget-of-1) never reach this, so a
+            # request that needs no decode never pays a transfer.
+            req.state = HANDOFF
+            self.active.remove(req)
+            self.handoff_ready.append(req)
+            self.counters["handoffs"] += 1
+            return
         req.state = RUNNING
 
     def _finalize(self, step: _Step) -> None:
@@ -718,17 +784,7 @@ class ServingScheduler:
         n_live = len(running)
         per_seq = max(1, eng.config.max_batch_size // n_live)
         st = self.spec_stats
-        if per_seq == 1 and draft_len > 0:
-            if st["draft_collapsed_steps"] == 0:
-                log_dist(
-                    "speculative serving: max_batch_size "
-                    f"{eng.config.max_batch_size} // {n_live} live "
-                    "sequences leaves no draft rows (per_seq=1, k=0); "
-                    "speculation is running as plain decode — raise "
-                    "max_batch_size or lower concurrency",
-                    ranks=[0],
-                )
-            st["draft_collapsed_steps"] += 1
+        collapsed = per_seq == 1 and draft_len > 0
         chunks: List[Tuple[Request, np.ndarray]] = []
         for req in list(running):
             if req.state != RUNNING:
@@ -751,6 +807,21 @@ class ServingScheduler:
             chunks.append((req, chunk))
         if not chunks:
             return None
+        # collapse accounting is per DISPATCHED step (counted only once
+        # chunks exist), so draft_collapsed_steps can never exceed
+        # steps — the invariant the stats contract promises and the
+        # pre-scheduler engine loop kept
+        if collapsed:
+            if st["draft_collapsed_steps"] == 0:
+                log_dist(
+                    "speculative serving: max_batch_size "
+                    f"{eng.config.max_batch_size} // {n_live} live "
+                    "sequences leaves no draft rows (per_seq=1, k=0); "
+                    "speculation is running as plain decode — raise "
+                    "max_batch_size or lower concurrency",
+                    ranks=[0],
+                )
+            st["draft_collapsed_steps"] += 1
         st["steps"] += 1
         st["verified_chunks"] += len(chunks)
         st["draft_tokens"] += sum(len(c) - 1 for _, c in chunks)
@@ -939,9 +1010,26 @@ class ServingScheduler:
             m["batched_tokens_per_step"] = (
                 self.counters["batched_tokens"] / self.counters["steps"])
         if self._spec:
-            vc = self.spec_stats["verified_chunks"]
-            self.spec_stats["mean_accepted"] = (
-                self.spec_stats["accepted_tokens"] / vc if vc else 0.0)
-            for k, v in self.spec_stats.items():
+            for k, v in self.spec_summary().items():
                 m[f"spec_{k}"] = float(v)
         return m
+
+    def spec_summary(self) -> Dict[str, float]:
+        """The speculative-decoding stats with their derived rates
+        folded in: mean_accepted (tokens committed per verified chunk,
+        includes the guaranteed pending token, so >= 1) and
+        draft_acceptance_rate (accepted DRAFT tokens / proposed draft
+        tokens — the policy signal: 0 means the n-gram draft never
+        lands, collapse aside). One authority for both the engine's
+        generate_speculative(return_stats=True) and the router's
+        per-replica reporting."""
+        st = dict(self.spec_stats)
+        vc = st["verified_chunks"]
+        st["mean_accepted"] = st["accepted_tokens"] / vc if vc else 0.0
+        # every verified chunk's slot 0 is the already-committed pending
+        # token — only the remainder of `accepted` came from drafts
+        drafts = st["draft_tokens"]
+        st["draft_acceptance_rate"] = (
+            (st["accepted_tokens"] - vc) / drafts if drafts else 0.0)
+        self.spec_stats["mean_accepted"] = st["mean_accepted"]
+        return st

@@ -104,15 +104,22 @@ def inference_cache_events(engine, step: int,
 
 def serving_events(scheduler, step: int,
                    prefix: str = "inference/serving") -> List[Event]:
-    """Turn a ServingScheduler's counters into monitor events (same
-    contract as inference_cache_events):
+    """Turn a ServingScheduler's — or a ServingRouter's — counters into
+    monitor events (same contract as inference_cache_events):
 
         monitor.write_events(serving_events(scheduler, step))
+        monitor.write_events(serving_events(router, step))
 
-    Emits host-timed TTFT/TPOT percentiles (ms), queue depth, active
-    sequences, admitted/finished/preempted request counts, batched
-    tokens per iteration, and the engine's recompile-finding count
-    under `prefix`/<name> (inference/scheduler.py metrics())."""
+    For a scheduler: host-timed TTFT/TPOT percentiles (ms), queue
+    depth, active sequences, admitted/finished/preempted request
+    counts, batched tokens per iteration, and the engine's recompile-
+    finding count (inference/scheduler.py metrics()). For a router
+    (inference/router.py): every replica's scheduler metrics under
+    `prefix`/replica<i>/<name> plus fleet aggregates under
+    `prefix`/fleet/<name> — fleet TTFT/TPOT percentiles, cache-hit
+    routing rate, session-affinity hits/evictions, KV-handoff count
+    and latency percentiles, failover requeues, live-replica count,
+    and per-replica speculative acceptance when spec replicas exist."""
     metrics = scheduler.metrics()
     return [(f"{prefix}/{name}", float(value), step)
             for name, value in sorted(metrics.items())]

@@ -23,6 +23,13 @@ def main():
     from deepspeed_tpu.inference import model as M
     from deepspeed_tpu.inference import init_inference
     from deepspeed_tpu.models import transformer as T
+    from deepspeed_tpu.platform.accelerator import bench_device_guard
+
+    # backend-init timeouts are flaky infra (BENCH_r04/r05): retry with
+    # backoff, then emit an infra_flake-marked line instead of hanging
+    rc = bench_device_guard("llama_350m_decode_tokens_per_sec")
+    if rc is not None:
+        return rc
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:

@@ -37,7 +37,16 @@ def main():
     import jax.numpy as jnp
 
     from deepspeed_tpu.models import transformer as T
-    from deepspeed_tpu.platform.accelerator import get_accelerator
+    from deepspeed_tpu.platform.accelerator import (
+        bench_device_guard,
+        get_accelerator,
+    )
+
+    # backend-init timeouts are flaky infra (BENCH_r04/r05): retry with
+    # backoff, then emit an infra_flake-marked line instead of hanging
+    rc = bench_device_guard("layer_mfu_scaling")
+    if rc is not None:
+        return rc
 
     acc = get_accelerator()
     assert acc.is_tpu(), "scaling bench needs the chip"

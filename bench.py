@@ -35,6 +35,17 @@ for capacity scaling. vs_baseline is the prefix-aware/round-robin
 goodput ratio; exit is non-zero unless prefix-aware wins, the fleet
 scales >= 0.8 per replica, steady state compiles nothing after warmup
 on every replica, and every lane's outputs are token-identical.
+
+`python bench.py --serving-sim --chaos <plan>` (plan = 'default' or a
+FaultPlan JSON path) runs the CHAOS lane: the same virtual-clock
+fleet sim served clean and then under the injected fault plan
+(replica death mid-decode, KV-handoff failures, a straggler window).
+Exit is non-zero unless the chaos pass loses zero tokens with
+token-identical outputs, failover is triggered by the health monitor
+(the lane never calls fail_replica), the straggler is restored via a
+half-open probe, and goodput degradation / orphan-drain recovery stay
+within the plan's budget. scripts/ds_chaos.py gates this in CI
+(docs/fault_tolerance.md).
 """
 
 import json
@@ -525,6 +536,303 @@ def _router_sim(n_replicas: int):
     ok = (goodput_ratio > 1.0 and scaling >= 0.8 * n_replicas
           and zero_recompiles and token_identical)
     return 0 if ok else 1
+
+
+# ---------------------------------------------------------------------------
+# chaos lane: the fleet sim under an injected fault plan
+# ---------------------------------------------------------------------------
+
+def _default_chaos_plan(n_replicas: int) -> dict:
+    """The CI chaos plan (scripts/ds_chaos.py gates on it): one decode
+    replica dies permanently mid-decode, two KV handoffs fail, and a
+    second decode replica straggles through a window long enough to
+    trip the dispatch deadline. Budgets are virtual-clock seconds —
+    deterministic, so they gate CI without flake."""
+    # replica 0 = the prefill replica (disaggregated 1 + N-1); the
+    # death and straggler target two DIFFERENT decode replicas
+    return {
+        "name": "default",
+        "seed": 0,
+        "budget": {"min_goodput_ratio": 0.30, "max_recovery_s": 5.0,
+                   "max_shed": 0},
+        "faults": [
+            # decode replica 1 dies on its 30th dispatch and stays dead
+            # (probes fail forever): detection, failover, and requeue
+            # must all be automatic
+            {"point": "scheduler.step", "kind": "raise",
+             "error": "replica_dead", "where": {"replica": 1},
+             "at": 30, "times": -1},
+            {"point": "router.probe", "kind": "raise",
+             "error": "replica_dead", "where": {"replica": 1},
+             "times": -1},
+            # two consecutive KV exports fail: the router must fall
+            # back to requeue-for-recompute with identical tokens
+            {"point": "engine.export_kv", "kind": "raise",
+             "error": "handoff", "at": 4, "times": 2},
+            # decode replica 2 straggles 0.25 virtual-s/step for a
+            # 25-step window: the dispatch deadline must trip the
+            # breaker, and the half-open probe must restore it once
+            # the window drains
+            {"point": "scheduler.step", "kind": "delay", "value": 0.25,
+             "where": {"replica": 2}, "at": 10, "times": 25},
+        ],
+    }
+
+
+def _chaos_lane(build_engine, n_replicas, router_cfg, trace, plan=None,
+                seed=0):
+    """The _fleet_lane event loop with the self-healing control plane
+    in it: every replica step is a health observation (modeled virtual
+    cost + injected straggler delay), breaker trips fail replicas over
+    automatically, and half-open probes restore them — the lane itself
+    NEVER calls fail_replica. Returns the _fleet_lane-shaped record
+    plus the failover/recovery audit."""
+    from deepspeed_tpu.inference import ServingRouter
+    from deepspeed_tpu.resilience import armed
+
+    engines = [build_engine() for _ in range(n_replicas)]
+    now_box = [0.0]
+    router = ServingRouter(engines, router_cfg, seed=seed,
+                           clock=lambda: now_box[0])
+    n_req = len(trace)
+    nb = engines[0].config.blocks_per_seq
+
+    def run():
+        clocks = [0.0] * n_replicas
+        vt_first, vt_finish = {}, {}
+        gid_of = {}
+        unfinished = set()
+        i = 0
+        idle_spins = 0
+        while len(vt_finish) < n_req:
+            live = [j for j in range(n_replicas) if j not in router.dead
+                    and (router.schedulers[j].has_work
+                         or router.schedulers[j].handoff_ready)]
+            if i < n_req and (not live or
+                              trace[i][0] <= min(clocks[j] for j in live)):
+                t_arr, prompt, max_new, session = trace[i]
+                gid = router.submit(prompt, max_new, session=session)
+                gid_of[i] = gid
+                unfinished.add(i)
+                r = router._where[gid]
+                clocks[r] = max(clocks[r], t_arr)
+                i += 1
+                continue
+            if not live:
+                # everything with work is dead or breaker-open: advance
+                # virtual time so backoffs expire and probes can run
+                idle_spins += 1
+                if idle_spins > 10_000:
+                    raise RuntimeError(
+                        "chaos lane wedged: no live replica has work "
+                        f"but {n_req - len(vt_finish)} requests are "
+                        "unfinished")
+                now_box[0] += 0.01
+                for j, ev in router.poll_health(now=now_box[0]):
+                    if ev == "close":
+                        clocks[j] = max(clocks[j], now_box[0])
+                continue
+            idle_spins = 0
+            j = min(live, key=lambda x: clocks[x])
+            sj = router.schedulers[j]
+            steps0 = sj.counters["steps"]
+            toks0 = sj.counters["batched_tokens"]
+            ok = True
+            try:
+                sj.step()
+            except Exception:
+                ok = False
+            cost = (C_DISPATCH * max(1, sj.counters["steps"] - steps0)
+                    + C_TOKEN * (sj.counters["batched_tokens"] - toks0)
+                    + sj.drain_fault_delay())
+            clocks[j] += cost
+            now_box[0] = max(now_box[0], clocks[j])
+            router.note_step_result(j, ok, cost, now=clocks[j])
+            for j2, ev in router.poll_health(now=now_box[0]):
+                if ev == "close":
+                    clocks[j2] = max(clocks[j2], now_box[0])
+            for k in sorted(unfinished):
+                req = router.result(gid_of[k])
+                if k not in vt_first and req.first_token_t is not None:
+                    vt_first[k] = clocks[j]
+                if req.done:
+                    vt_finish[k] = clocks[j]
+                    unfinished.discard(k)
+            for mv in router.pump():
+                p, d = mv["prefill"], mv["decode"]
+                xfer = C_XFER + C_BLOCK * nb
+                clocks[p] += xfer
+                clocks[d] = max(clocks[d], clocks[p]) + xfer
+                now_box[0] = max(now_box[0], clocks[d])
+        # probe drain: the trace can finish before a tripped breaker's
+        # backoff expires — keep virtual time flowing (bounded horizon)
+        # so recoverable replicas get their half-open probe and rejoin;
+        # a permanently dead replica keeps failing probes and stays out
+        horizon = now_box[0] + 30.0
+        while router.dead and now_box[0] < horizon:
+            now_box[0] += 0.05
+            router.poll_health(now=now_box[0])
+        return vt_first, vt_finish, gid_of
+
+    if plan is not None:
+        with armed(plan):
+            vt_first, vt_finish, gid_of = run()
+    else:
+        vt_first, vt_finish, gid_of = run()
+    makespan = max(max(vt_finish.values()), trace[-1][0])
+    fleet = router.metrics()
+    finish_by_gid = {gid_of[k]: vt for k, vt in vt_finish.items()}
+    failovers = []
+    for ev in router._failover_events:
+        drained = [finish_by_gid.get(g) for g in ev["gids"]]
+        failovers.append({
+            "replica": ev["replica"], "auto": bool(ev["auto"]),
+            "t_s": round(ev["t"], 4),
+            "orphans": len(ev["gids"]),
+            # orphan-drain recovery: failover -> last orphan finished
+            "recovery_s": round(
+                max([d for d in drained if d is not None] + [ev["t"]])
+                - ev["t"], 4),
+            "restored": ev["recovered_at"] is not None,
+        })
+    return {
+        "goodput_rps": n_req / makespan,
+        "makespan_s": makespan,
+        "ttft_s": [vt_first[k] - trace[k][0] for k in sorted(vt_first)],
+        "outputs": [list(router.result(g).output) for g in range(n_req)],
+        "finished": int(sum(1 for k in range(n_req)
+                            if router.result(gid_of[k]).done)),
+        "failovers": failovers,
+        "auto_failovers": int(fleet["fleet/auto_failovers"]),
+        "manual_failovers": int(sum(1 for f in failovers if not f["auto"])),
+        "breaker_opens": int(fleet["fleet/breaker_opens"]),
+        "breaker_closes": int(fleet["fleet/breaker_closes"]),
+        "replica_restores": int(fleet["fleet/replica_restores"]),
+        "handoffs": int(fleet["fleet/handoffs"]),
+        "handoff_fallbacks": int(fleet["fleet/handoff_fallbacks"]),
+        "requeued_on_death": int(fleet["fleet/requeued_on_death"]),
+        "shed_requests": int(fleet["fleet/shed_requests"]),
+        "live_replicas": int(fleet["fleet/live_replicas"]),
+        "recovery_p95_ms": round(fleet["fleet/recovery_p95_ms"], 2),
+    }
+
+
+def _chaos_sim(n_replicas: int, plan_arg: str):
+    """Chaos gate (scripts/ds_chaos.py; docs/fault_tolerance.md): the
+    deterministic virtual-clock fleet sim served twice — clean, then
+    under the injected FaultPlan — asserting ZERO token loss and
+    token-identical outputs, health-monitor-triggered failover (the
+    lane never calls fail_replica), bounded goodput degradation, and
+    orphan-drain recovery within the plan's budget."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference import init_inference
+    from deepspeed_tpu.models import transformer as T
+    from deepspeed_tpu.resilience import FaultPlan
+
+    if plan_arg == "default":
+        plan = FaultPlan.from_dict(_default_chaos_plan(n_replicas))
+    else:
+        plan = FaultPlan.from_json(plan_arg)
+    budget = {"min_goodput_ratio": 0.30, "max_recovery_s": 5.0,
+              "max_shed": 0, **plan.budget}
+
+    mcfg = T.TransformerConfig(
+        vocab_size=256, n_layers=2, n_heads=4, d_model=64,
+        max_seq=160, variant="llama", use_flash=False)
+    params = T.init(mcfg, jax.random.PRNGKey(0))
+
+    def build_engine():
+        return init_inference(
+            params, mcfg,
+            dict(max_seq_len=128, kv_block_size=16, num_kv_blocks=64,
+                 min_prefill_bucket=16, max_batch_size=8),
+            dtype=jnp.float32)
+
+    # the _router_sim shared-prefix Poisson workload, sized so the
+    # injected faults land mid-flight (queues still deep at the death
+    # step) — disaggregated so the handoff-failure fault has a path
+    rng = np.random.default_rng(0)
+    n_req, n_groups = 64, 8
+    prefixes = [list(rng.integers(0, 256, 64)) for _ in range(n_groups)]
+    arrivals = np.cumsum(rng.exponential(0.002, n_req))
+    group_of = rng.permutation(np.arange(n_req) % n_groups)
+    trace = []
+    for k in range(n_req):
+        g = int(group_of[k])
+        tail = list(rng.integers(0, 256, int(rng.integers(4, 13))))
+        trace.append((float(arrivals[k]), prefixes[g] + tail,
+                      int(rng.integers(6, 15)), g))
+
+    cfg = {
+        "replicas": n_replicas, "policy": "prefix_aware",
+        "mode": "disaggregated", "prefill_replicas": 1,
+        "health_enabled": True, "failure_threshold": 3,
+        # virtual-clock thresholds: a healthy modeled step costs
+        # 2-8 ms (C_DISPATCH + tokens*C_TOKEN); the 0.25 s injected
+        # straggler delay overruns the deadline by 5x
+        "dispatch_deadline_s": 0.05,
+        "breaker_backoff_s": 0.4, "breaker_backoff_mult": 2.0,
+        "breaker_backoff_max_s": 5.0,
+        "scheduler": {"max_num_batched_tokens": 64, "prefill_chunk": 16},
+    }
+    clean = _chaos_lane(build_engine, n_replicas, cfg, trace)
+    chaos = _chaos_lane(build_engine, n_replicas, cfg, trace, plan=plan)
+
+    def pct(xs, q):
+        return round(float(np.percentile(np.asarray(xs), q)) * 1e3, 2)
+
+    goodput_ratio = chaos["goodput_rps"] / clean["goodput_rps"]
+    max_recovery = max(
+        [f["recovery_s"] for f in chaos["failovers"]] + [0.0])
+    token_loss = sum(
+        1 for a, b in zip(chaos["outputs"], clean["outputs"]) if a != b)
+    gates = {
+        "zero_token_loss": (chaos["finished"] == n_req
+                            and token_loss == 0),
+        "auto_failover_no_manual_call": (
+            chaos["auto_failovers"] >= 1
+            and chaos["manual_failovers"] == 0),
+        "goodput_within_budget": goodput_ratio >= budget["min_goodput_ratio"],
+        "recovery_within_budget": max_recovery <= budget["max_recovery_s"],
+        "shed_within_budget": chaos["shed_requests"] <= budget["max_shed"],
+        "straggler_restored": chaos["replica_restores"] >= 1,
+        "handoff_fallback_exercised": chaos["handoff_fallbacks"] >= 1,
+    }
+    out = {
+        "metric": "serving_chaos_goodput_ratio",
+        "value": round(goodput_ratio, 3),
+        "unit": "chaos/clean",
+        "vs_baseline": round(goodput_ratio, 3),
+        "replicas": n_replicas,
+        "plan": {"name": plan.name, "faults": len(plan.faults),
+                 "fired": len(plan.fired), "budget": budget},
+        "gates": gates,
+        "clean": {"goodput_rps": round(clean["goodput_rps"], 2),
+                  "ttft_ms": {"p50": pct(clean["ttft_s"], 50),
+                              "p95": pct(clean["ttft_s"], 95)}},
+        "chaos": {
+            "goodput_rps": round(chaos["goodput_rps"], 2),
+            "ttft_ms": {"p50": pct(chaos["ttft_s"], 50),
+                        "p95": pct(chaos["ttft_s"], 95)},
+            "finished": chaos["finished"],
+            "auto_failovers": chaos["auto_failovers"],
+            "breaker_opens": chaos["breaker_opens"],
+            "breaker_closes": chaos["breaker_closes"],
+            "replica_restores": chaos["replica_restores"],
+            "handoffs": chaos["handoffs"],
+            "handoff_fallbacks": chaos["handoff_fallbacks"],
+            "requeued_on_death": chaos["requeued_on_death"],
+            "live_replicas": chaos["live_replicas"],
+            "max_recovery_s": round(max_recovery, 4),
+            "failovers": chaos["failovers"],
+        },
+        "platform": jax.default_backend(),
+    }
+    print(json.dumps(out))
+    return 0 if all(gates.values()) else 1
 
 
 def main():
@@ -1018,5 +1326,8 @@ if __name__ == "__main__":
         argv = sys.argv[1:]
         n = int(argv[argv.index("--replicas") + 1]) \
             if "--replicas" in argv else 1
+        if "--chaos" in argv:
+            plan = argv[argv.index("--chaos") + 1]
+            sys.exit(_chaos_sim(n if n > 1 else 4, plan))
         sys.exit(_router_sim(n) if n > 1 else _serving_sim())
     sys.exit(main())

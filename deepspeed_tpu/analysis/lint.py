@@ -71,7 +71,11 @@ _PRAGMA_RE = re.compile(
 # starts with `entry` + one of the listed prefixes.
 _HOT_FILES = ("runtime/engine.py", "inference/engine.py",
               "runtime/hybrid_engine.py", "inference/scheduler.py",
-              "inference/router.py")
+              "inference/router.py",
+              # resilience primitives live INSIDE the per-step hot
+              # paths (fault points, health observations) — a host
+              # sync added here would tax every dispatch
+              "resilience/faults.py", "resilience/health.py")
 _HOT_FN_PREFIXES = (
     "train_batch", "eval_batch", "_dispatch", "decode", "_decode",
     "generate", "put", "step", "_sample", "prefill", "_prefill",
@@ -80,6 +84,10 @@ _HOT_FN_PREFIXES = (
     # path): readbacks route through utils/sync.serving_readback
     "pump", "serve", "adopt", "requeue", "_route", "fail_replica",
     "export_kv", "import_kv",
+    # self-healing loop (resilience/ + router health plumbing)
+    "fault_point", "_hit", "observe", "probe", "_probe", "due_probe",
+    "note_step_result", "poll_health", "restore_replica", "_shed",
+    "drain_fault_delay",
 )
 _SYNC_CALLS = ("block_until_ready", "device_get")
 # serving_readback: the scheduler loop's one named readback point

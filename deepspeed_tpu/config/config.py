@@ -331,7 +331,23 @@ class ServingRouterConfig(ConfigModel):
     speculative_replicas: run the LAST K decode replicas' schedulers
     in speculative mode (prompt-lookup self-drafting, greedy-only) —
     the per-replica mode flag the router reports through metrics().
-    scheduler: the per-replica ServingSchedulerConfig."""
+    scheduler: the per-replica ServingSchedulerConfig.
+
+    Self-healing (deepspeed_tpu/resilience, docs/fault_tolerance.md):
+    health_enabled turns on the per-replica circuit breaker — a
+    replica whose dispatch raises (or, with dispatch_deadline_s > 0,
+    overruns the deadline) failure_threshold times in a row is failed
+    over AUTOMATICALLY (the fail_replica requeue machinery, no manual
+    call), then probed after an exponential backoff
+    (breaker_backoff_s doubling by breaker_backoff_mult up to
+    breaker_backoff_max_s) and restored when the probe succeeds.
+    handoff_timeout_s > 0 bounds each KV export+import; a timed-out or
+    failed transfer falls back to the token-identical
+    requeue-for-recompute path. max_fleet_queue > 0 bounds the fleet's
+    total waiting queue; over it, submissions shed per shed_policy:
+    'fair' sheds the queue-heaviest session's newest waiting request
+    (the submitting session itself when it is the heaviest),
+    'reject' always sheds the new request."""
 
     replicas: int = 1
     policy: str = "prefix_aware"
@@ -341,6 +357,15 @@ class ServingRouterConfig(ConfigModel):
     mode: str = "colocated"
     prefill_replicas: int = 1
     speculative_replicas: int = 0
+    health_enabled: bool = True
+    failure_threshold: int = 3
+    dispatch_deadline_s: float = 0.0
+    breaker_backoff_s: float = 1.0
+    breaker_backoff_mult: float = 2.0
+    breaker_backoff_max_s: float = 30.0
+    handoff_timeout_s: float = 0.0
+    max_fleet_queue: int = 0
+    shed_policy: str = "fair"
     scheduler: ServingSchedulerConfig = Field(
         default_factory=ServingSchedulerConfig)
 
@@ -364,6 +389,21 @@ class ServingRouterConfig(ConfigModel):
             raise ValueError("cache_weight must be >= 0")
         if self.affinity_evict_margin < 0:
             raise ValueError("affinity_evict_margin must be >= 0")
+        if self.failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        if self.dispatch_deadline_s < 0 or self.handoff_timeout_s < 0:
+            raise ValueError("deadlines/timeouts must be >= 0 (0 = off)")
+        if self.breaker_backoff_s <= 0 or self.breaker_backoff_mult < 1 \
+                or self.breaker_backoff_max_s < self.breaker_backoff_s:
+            raise ValueError(
+                "breaker backoff needs backoff_s > 0, mult >= 1, "
+                "max >= backoff_s")
+        if self.max_fleet_queue < 0:
+            raise ValueError("max_fleet_queue must be >= 0 (0 = unbounded)")
+        if self.shed_policy not in ("fair", "reject"):
+            raise ValueError(
+                f"unknown shed_policy '{self.shed_policy}' "
+                "(expected fair|reject)")
         return self
 
 

@@ -47,6 +47,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..resilience.faults import fault_point
+
 HEARTBEAT_DIR_ENV = "DS_ELASTIC_HEARTBEAT_DIR"
 GENERATION_ENV = "DS_ELASTIC_GENERATION"
 RESUME_DIR_ENV = "DS_ELASTIC_RESUME_DIR"
@@ -81,6 +83,12 @@ class Heartbeat:
         os.makedirs(hb_dir, exist_ok=True)
 
     def beat(self, step: int) -> None:
+        # chaos fault point: kind='skip' suppresses the write — an
+        # alive-but-wedged controller, exactly what staleness detection
+        # exists for (deterministic stall tests without real hangs)
+        act = fault_point("heartbeat.beat", rank=self.rank)
+        if act is not None and act.kind == "skip":
+            return
         payload = json.dumps({
             "rank": self.rank, "step": int(step),
             "generation": self.generation, "time": time.time(),

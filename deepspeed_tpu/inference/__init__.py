@@ -10,7 +10,7 @@ from .ragged import (
     SequenceDescriptor,
     StateManager,
 )
-from .router import ServingRouter, ServingRouterConfig
+from .router import RequestShedError, ServingRouter, ServingRouterConfig
 from .scheduler import Request, ServingScheduler, ServingSchedulerConfig
 
 __all__ = [
@@ -23,6 +23,7 @@ __all__ = [
     "SequenceDescriptor",
     "StateManager",
     "Request",
+    "RequestShedError",
     "ServingRouter",
     "ServingRouterConfig",
     "ServingScheduler",

@@ -22,6 +22,7 @@ constructs this engine; greedy `generate` is provided for parity with
 the wrapped-module generate path.
 """
 
+import time
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import jax
@@ -32,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from pydantic import Field
 
 from ..config.config import ConfigModel, PrefixCacheConfig
+from ..resilience.faults import fault_point
 from ..models import transformer as T
 from ..utils.logging import log_dist
 from ..utils.sync import serving_readback
@@ -784,6 +786,9 @@ class InferenceEngine:
         The readback routes through utils.sync.serving_readback: it is
         a deliberate transfer-boundary sync, sized in KV pages (never
         logits), and the only host crossing in the handoff path."""
+        act = fault_point("engine.export_kv", uid=uid)
+        if act is not None and act.kind == "delay":
+            time.sleep(act.value)  # a hung transfer (timeout-guard tests)
         seq = self.state.get(uid)
         if seq is None:
             raise KeyError(f"unknown sequence uid {uid}")
@@ -809,6 +814,7 @@ class InferenceEngine:
         when the pool cannot fit the sequence — callers fall back to
         recompute (token-identical: draws key on seed/stream/position,
         not on which replica runs them)."""
+        fault_point("engine.import_kv", uid=uid)
         n_tok = int(payload["seen_tokens"])
         nb = int(payload["n_blocks"])
         k, v = payload["k"], payload["v"]

@@ -27,6 +27,7 @@ next prefetch round would race that transfer.
 import os
 import shutil
 import threading
+import time
 import uuid
 from typing import Any, Dict, List, Optional
 
@@ -34,14 +35,16 @@ import jax
 import numpy as np
 
 from ..ops.aio import AsyncIOHandle
-from ..utils.logging import log_dist
+from ..resilience.faults import fault_point
+from ..utils.logging import log_dist, logger
 
 
 class NvmeLayerStore:
     """Per-leaf NVMe files + in-flight prefetch state for one engine."""
 
     def __init__(self, path: str, n_layers: int, n_threads: int = 4,
-                 block_size: int = 1 << 20, read_ahead: int = 2):
+                 block_size: int = 1 << 20, read_ahead: int = 2,
+                 io_retries: int = 3, retry_backoff_s: float = 0.01):
         tag = f"serve-rank{jax.process_index()}-{uuid.uuid4().hex[:8]}"
         self.dir = os.path.join(path, "ds_tpu_swap", tag)
         os.makedirs(self.dir, exist_ok=True)
@@ -60,6 +63,11 @@ class NvmeLayerStore:
         # and race two reads into one buffer.
         self._inflight: Dict[int, List[tuple]] = {}
         self._lock = threading.Lock()
+        # transient NVMe/filesystem hiccups heal with a bounded retry;
+        # a failure that survives the budget SURFACES (raised from the
+        # serving read path, logged terminally from the close drain)
+        self.io_retries = max(0, int(io_retries))
+        self.retry_backoff_s = float(retry_backoff_s)
         import atexit
         import functools
 
@@ -70,6 +78,31 @@ class NvmeLayerStore:
                                           ignore_errors=True)
         atexit.register(self._cleanup)
         self._closed = False
+
+    def _io_retry(self, fn, what: str, terminal: str = "raise"):
+        """Run one aio operation with a bounded retry + exponential
+        backoff (transient NVMe/fs errors heal). After the budget:
+        terminal='raise' re-raises (serving reads must surface a dead
+        disk, not return garbage), terminal='log' emits one error and
+        returns None (the close() drain must still release the rest)."""
+        for attempt in range(self.io_retries + 1):
+            try:
+                fault_point("offload.io", what=what)
+                return fn()
+            except Exception as e:
+                if attempt == self.io_retries:
+                    logger.error(
+                        f"NVMe store: {what} failed after "
+                        f"{attempt + 1} attempts: {e!r}")
+                    if terminal == "raise":
+                        raise
+                    return None
+                delay = self.retry_backoff_s * (2 ** attempt)
+                logger.warning(
+                    f"NVMe store: {what} hit transient error ({e!r}); "
+                    f"retry {attempt + 1}/{self.io_retries} in "
+                    f"{delay:.3f}s")
+                time.sleep(delay)
 
     def close(self) -> None:
         """Drain in-flight reads, drop the aio pool, reclaim the NVMe
@@ -84,21 +117,21 @@ class NvmeLayerStore:
             self._inflight.clear()
             aio = self.aio
         # wait OUTSIDE the lock: a concurrent read_layer may hold its
-        # own popped tickets and must not deadlock against the drain
+        # own popped tickets and must not deadlock against the drain.
+        # terminal='log': one wedged ticket must not leak the rest of
+        # the pool or the NVMe directory
         for pairs in drained:
             for t, _ in pairs:
-                try:
-                    aio.wait(t)
-                except Exception:
-                    pass
+                self._io_retry(lambda t=t: aio.wait(t),
+                               f"drain of ticket {t}", terminal="log")
         self.aio = None
         shutil.rmtree(self.dir, ignore_errors=True)
         import atexit
 
         try:
             atexit.unregister(self._cleanup)
-        except Exception:
-            pass
+        except ValueError:
+            pass  # already unregistered (repeat close)
 
     def __del__(self):
         try:
@@ -122,7 +155,8 @@ class NvmeLayerStore:
             tickets.append(self.aio.async_pwrite(arr, f))
             rows.append((i, f, arr.shape, arr.dtype))
         for t in tickets:
-            self.aio.wait(t)
+            self._io_retry(lambda t=t: self.aio.wait(t),
+                           f"staging write of layer {l}")
         # staging is strictly single-threaded and precedes any serving
         # read (finish_staging is the barrier) — no lock needed here
         self._manifest[l] = rows  # ds-lint: ok R003 single-threaded staging phase
@@ -177,7 +211,11 @@ class NvmeLayerStore:
             pairs = self._inflight.pop(l)
             aio = self.aio
         for t, _ in pairs:
-            aio.wait(t)
+            # transient I/O heals here; a persistent failure raises out
+            # of the serving step (a dead disk must never return a
+            # zero-filled layer as weights)
+            self._io_retry(lambda t=t: aio.wait(t),
+                           f"read of layer {l}")
         # decode walks layers cyclically (every step re-streams the
         # model): prefetch wraps around
         with self._lock:

@@ -45,6 +45,20 @@ backed replicas and decides, per request, WHERE work runs:
   re-draws identically because sampling keys on (seed, stream,
   position) — the router owns both seed and stream, so WHERE a request
   runs never shows in WHAT it generates.
+- **self-healing** (deepspeed_tpu/resilience, docs/fault_tolerance.md):
+  with `health_enabled` every dispatch is a health observation — a
+  step that raises, or overruns `dispatch_deadline_s`, feeds a
+  per-replica circuit breaker; `failure_threshold` consecutive
+  failures trip it and the router calls its own fail_replica
+  machinery AUTOMATICALLY, probes the replica after an exponential
+  backoff (half-open), and `restore_replica()` rejoins it on a
+  passing probe (state flushed — the orphans decode elsewhere — pins
+  and routing re-enabled). KV handoffs are failure/timeout-guarded:
+  a failed or overdue export/import falls back to the
+  requeue-for-recompute path, which is token-identical. Under
+  overload, `max_fleet_queue` bounds the fleet's waiting queue and
+  sheds with per-session fairness (RequestShedError / finish_reason
+  'shed') instead of growing latency without bound.
 
 The router is single-threaded by design, like the scheduler under it:
 `serve()` round-robins step()/pump() across replicas until idle, and
@@ -66,11 +80,20 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from ..config.config import ServingRouterConfig, ServingSchedulerConfig
+from ..resilience.faults import fault_point
+from ..resilience.health import CLOSED, STATE_CODE, BreakerConfig, FleetHealth
 from ..utils.logging import log_dist
 from .engine import InferenceEngine
-from .scheduler import Request, ServingScheduler
+from .scheduler import FINISHED, Request, ServingScheduler
 
-__all__ = ["ServingRouter", "ServingRouterConfig"]
+__all__ = ["ServingRouter", "ServingRouterConfig", "RequestShedError"]
+
+
+class RequestShedError(RuntimeError):
+    """The fleet queue is at max_fleet_queue and the shed policy chose
+    the NEW request as the victim (its session already holds the most
+    queued work, or shed_policy='reject'). Callers back off / surface
+    429; nothing was enqueued."""
 
 
 class ServingRouter:
@@ -90,6 +113,7 @@ class ServingRouter:
         sampling: Optional[Dict[str, Any]] = None,
         seed: int = 0,
         speculative: Optional[Dict[str, int]] = None,
+        clock=None,
     ):
         engines = list(engines)
         if not engines:
@@ -136,10 +160,12 @@ class ServingRouter:
                     if self.mode == "disaggregated" else
                     "speculative" if i in spec_set else "mixed")
             self.replica_mode.append(mode)
-            self.schedulers.append(ServingScheduler(
+            sched = ServingScheduler(
                 eng, self.cfg.scheduler, sampling=sampling,
                 seed=self.seed,
-                speculative=spec if mode == "speculative" else None))
+                speculative=spec if mode == "speculative" else None)
+            sched.replica_index = i  # fault-point ctx + health identity
+            self.schedulers.append(sched)
         if self.mode == "disaggregated":
             # the handoff gather/scatter pair joins the AOT-warmed set:
             # the first real transfer must compile nothing (the same
@@ -160,7 +186,23 @@ class ServingRouter:
             "routed": 0, "cache_hit_routes": 0, "affinity_hits": 0,
             "affinity_evictions": 0, "handoffs": 0,
             "handoff_fallbacks": 0, "requeued_on_death": 0,
+            "auto_failovers": 0, "replica_restores": 0,
+            "shed_requests": 0, "handoff_timeouts": 0,
         }
+
+        # -- self-healing state ------------------------------------------
+        # the clock is injectable so the deterministic virtual-time
+        # fleet simulator and wall-clock serving share one health path
+        self._clock = clock or time.monotonic
+        self.health = FleetHealth(len(engines), BreakerConfig(
+            failure_threshold=self.cfg.failure_threshold,
+            dispatch_deadline_s=self.cfg.dispatch_deadline_s,
+            backoff_s=self.cfg.breaker_backoff_s,
+            backoff_mult=self.cfg.breaker_backoff_mult,
+            backoff_max_s=self.cfg.breaker_backoff_max_s))
+        # failover audit: {replica, t, gids, auto, recovered_at}
+        self._failover_events: List[Dict[str, Any]] = []
+        self._recovery_s: List[float] = []       # open -> restored
 
     @staticmethod
     def _check_homogeneous(engines: Sequence[InferenceEngine]) -> None:
@@ -250,8 +292,14 @@ class ServingRouter:
         request id. In disaggregated mode the request lands on a
         prefill replica and moves to a decode replica at first token
         (pump()); otherwise it lives its whole life where it lands.
-        `session` (any hashable) enables affinity pinning."""
+        `session` (any hashable) enables affinity pinning. When the
+        fleet queue is at max_fleet_queue, the shed policy runs first:
+        either an already-queued request of the queue-heaviest session
+        is shed to make room (finish_reason 'shed'), or this submission
+        raises RequestShedError."""
         prompt = [int(t) for t in prompt]
+        if self.cfg.max_fleet_queue > 0:
+            self._shed_for_room(session)
         gid = self._next_gid
         self._next_gid += 1
         pool = (self.prefill_idx if self.mode == "disaggregated"
@@ -271,6 +319,52 @@ class ServingRouter:
         """The Request for a router-global id (live view: .output grows
         as the fleet decodes; .done flips at finish)."""
         return self._reqs[gid]
+
+    # -- overload: bounded fleet queue + per-session-fair shed ------------
+    def _session_key(self, req: Request) -> Any:
+        # session-less requests form one anonymous fairness class
+        return self._session_of.get(req.stream)
+
+    def _shed_for_room(self, session: Any) -> None:
+        """Graceful degradation: called before enqueueing a new request
+        when max_fleet_queue > 0. Under the bound this is a no-op; at
+        the bound, per-session fairness picks the victim — the NEWEST
+        waiting request of the session holding the most queued work.
+        When the submitting session itself is (tied-)heaviest, or
+        shed_policy='reject', the NEW request is the victim
+        (RequestShedError; nothing enqueued)."""
+        waiting = [(i, req) for i, s in enumerate(self.schedulers)
+                   if i not in self.dead for req in s.waiting]
+        if len(waiting) < self.cfg.max_fleet_queue:
+            return
+        self.counters["shed_requests"] += 1
+        if self.cfg.shed_policy == "reject":
+            raise RequestShedError(
+                f"fleet queue at max_fleet_queue="
+                f"{self.cfg.max_fleet_queue}; request rejected")
+        counts: Dict[Any, int] = {}
+        for _, req in waiting:
+            key = self._session_key(req)
+            counts[key] = counts.get(key, 0) + 1
+        heaviest = max(counts.values())
+        mine = counts.get(session, 0) if session is not None else 0
+        if session is None or mine >= heaviest:
+            raise RequestShedError(
+                "fleet queue full and the submitting session holds the "
+                f"most queued work ({mine}/{heaviest}); request shed")
+        # shed the queue-heaviest session's newest waiting request
+        victims = [(i, req) for i, req in waiting
+                   if counts[self._session_key(req)] == heaviest]
+        i, victim = victims[-1]
+        self.schedulers[i].waiting.remove(victim)
+        victim.state = FINISHED
+        victim.finish_reason = "shed"
+        victim.finish_t = time.perf_counter()
+        self.schedulers[i].finished[victim.rid] = victim
+        log_dist(
+            f"serving router: fleet queue at {self.cfg.max_fleet_queue}; "
+            f"shed request gid={victim.stream} of session "
+            f"{self._session_key(victim)!r} on replica {i}", ranks=[0])
 
     @property
     def has_work(self) -> bool:
@@ -292,9 +386,12 @@ class ServingRouter:
         least-loaded live decode replica, adopt RUNNING. Returns one
         record per transfer ({prefill, decode, export_s, import_s})
         so callers — the virtual-time simulator — can charge the cost
-        to the right clocks. A decode replica that cannot take the
-        sequence (batch or pool full) falls back to requeue-for-
-        recompute, which is token-identical."""
+        to the right clocks. Every transfer leg is guarded: a decode
+        replica that cannot take the sequence (batch or pool full), a
+        failed export/import, or an export overrunning
+        handoff_timeout_s all fall back to requeue-for-recompute,
+        which is token-identical (draws key on seed/stream/position
+        and prompt + accepted output ride on the Request)."""
         moves: List[Dict[str, float]] = []
         if self.mode != "disaggregated":
             return moves
@@ -306,15 +403,42 @@ class ServingRouter:
                 req = ps.handoff_ready.popleft()
                 gid = req.stream
                 t0 = time.perf_counter()
-                payload = ps.engine.export_kv(req.uid)
+                try:
+                    payload = ps.engine.export_kv(req.uid)
+                except Exception as e:
+                    # export failed: the prefill-side pages are suspect
+                    # — release them and recompute on a decode replica
+                    log_dist(
+                        f"serving router: KV export of gid={gid} on "
+                        f"replica {p} failed ({e!r}); falling back to "
+                        "recompute", ranks=[0])
+                    if ps.engine.state.get(req.uid) is not None:
+                        ps.engine.flush(req.uid)
+                    req.uid = None
+                    self.counters["handoff_fallbacks"] += 1
+                    self._requeue_for_recompute(req)
+                    continue
                 ps.engine.flush(req.uid)
                 req.uid = None
                 t1 = time.perf_counter()
+                if self.cfg.handoff_timeout_s > 0 \
+                        and t1 - t0 > self.cfg.handoff_timeout_s:
+                    # a hung transfer must not stall the decode fleet:
+                    # discard the payload, recompute instead
+                    log_dist(
+                        f"serving router: KV export of gid={gid} took "
+                        f"{t1 - t0:.3f}s > handoff_timeout_s="
+                        f"{self.cfg.handoff_timeout_s}; falling back to "
+                        "recompute", ranks=[0])
+                    self.counters["handoff_timeouts"] += 1
+                    self.counters["handoff_fallbacks"] += 1
+                    self._requeue_for_recompute(req)
+                    continue
                 live = self._live(self.decode_idx)
                 d = min(live, key=lambda i: (self._load(i), i))
                 try:
                     self.schedulers[d].adopt(req, payload)
-                except RuntimeError:
+                except Exception:
                     self.counters["handoff_fallbacks"] += 1
                     req.handoff = False  # decode locally after recompute
                     self.schedulers[d].requeue(req)
@@ -326,8 +450,20 @@ class ServingRouter:
                               "export_s": t1 - t0, "import_s": t2 - t1})
         return moves
 
+    def _requeue_for_recompute(self, req: Request) -> int:
+        """The token-identical fallback shared by every failed-handoff
+        leg: re-queue prompt + accepted output for local decode on the
+        least-loaded live decode replica."""
+        live = self._live(self.decode_idx)
+        d = min(live, key=lambda i: (self._load(i), i))
+        req.handoff = False
+        self.schedulers[d].requeue(req)
+        self._where[req.stream] = d
+        return d
+
     # -- failover ---------------------------------------------------------
-    def fail_replica(self, i: int) -> int:
+    def fail_replica(self, i: int, now: Optional[float] = None,
+                     _auto: bool = False) -> int:
         """Mark replica i dead and requeue its in-flight requests onto
         live replicas (disaggregated: back through the prefill pool —
         a moved sequence needs a fresh prefill of prompt+output). The
@@ -335,10 +471,19 @@ class ServingRouter:
         gone); accepted output rides along on each Request and the
         recompute re-draws identically, so callers observe a latency
         blip, never a token change. Returns the number of requests
-        requeued."""
+        requeued.
+
+        Called MANUALLY the breaker is parked (held): auto-probing
+        must never resurrect a replica an operator killed on purpose —
+        only restore_replica() brings it back. The health monitor's
+        automatic path leaves the breaker OPEN so backoff + half-open
+        probes drive the rejoin."""
         if i in self.dead:
             return 0
+        now = self._clock() if now is None else now
         self.dead.add(i)
+        if not _auto:
+            self.health.hold(i)
         s = self.schedulers[i]
         orphans = list(s.active) + list(s.waiting) + list(s.handoff_ready)
         s.active.clear()
@@ -358,22 +503,125 @@ class ServingRouter:
             self._where[gid] = r
             self.counters["requeued_on_death"] += 1
             moved += 1
+        self._failover_events.append({
+            "replica": i, "t": now, "auto": _auto,
+            "gids": [req.stream for req in orphans],
+            "recovered_at": None})
         log_dist(
-            f"serving router: replica {i} failed; requeued {moved} "
+            f"serving router: replica {i} failed "
+            f"({'auto' if _auto else 'manual'}); requeued {moved} "
             f"in-flight requests onto live replicas", ranks=[0])
         return moved
 
+    # -- self-healing: observations, probes, rejoin -----------------------
+    def note_step_result(self, i: int, ok: bool, duration_s: float,
+                         now: Optional[float] = None) -> Optional[str]:
+        """Feed one dispatch observation into replica i's breaker and
+        act on the transition: 'open' triggers automatic failover
+        through the fail_replica requeue machinery. step() calls this
+        with wall times; the virtual-clock fleet simulator calls it
+        directly with modeled durations (straggler delays included).
+        Returns the breaker event, if any."""
+        if not self.cfg.health_enabled:
+            return None
+        now = self._clock() if now is None else now
+        ev = self.health.observe(i, ok, duration_s, now)
+        if ev == "open":
+            self.counters["auto_failovers"] += 1
+            self.fail_replica(i, now=now, _auto=True)
+        return ev
+
+    def poll_health(self, now: Optional[float] = None) -> List[tuple]:
+        """Advance breaker lifecycles: every OPEN replica past its
+        backoff gets ONE half-open probe; a passing probe restores the
+        replica into routing, a failing one re-opens with doubled
+        backoff. Returns [(replica, event)] for this poll."""
+        if not self.cfg.health_enabled:
+            return []
+        now = self._clock() if now is None else now
+        events = []
+        for i in self.health.due_probes(now):
+            try:
+                self._probe_replica(i)
+                ok = True
+            except Exception as e:
+                ok = False
+                log_dist(
+                    f"serving router: half-open probe of replica {i} "
+                    f"failed ({e!r}); backing off", ranks=[0])
+            ev = self.health.probe_result(i, ok, now)
+            if ev == "close":
+                self.restore_replica(i, now=now)
+            events.append((i, ev))
+        return events
+
+    def _probe_replica(self, i: int) -> None:
+        """The half-open liveness probe: the chaos fault point plus a
+        cheap engine-state touch. Real deployments override this with
+        an RPC ping / tiny compiled no-op."""
+        fault_point("router.probe", replica=i)
+        _ = self.schedulers[i].engine.state.free_blocks
+
+    def restore_replica(self, i: int, now: Optional[float] = None) -> None:
+        """Rejoin a failed replica: flush every sequence orphaned at
+        failover (the requeued requests decode elsewhere — the pages
+        here are stale; flushed full blocks still park in the prefix
+        pool, so the rejoin is cache-warm), reset its breaker, and
+        re-enable routing. Session pins re-form through scoring; no
+        pin survives a death, so nothing routes here until the replica
+        wins a pick again."""
+        if i not in self.dead:
+            return
+        now = self._clock() if now is None else now
+        s = self.schedulers[i]
+        for uid in list(s.engine.state.tracked_uids):
+            s.engine.flush(uid)
+        s.active.clear()
+        s.waiting.clear()
+        s.handoff_ready.clear()
+        self.dead.discard(i)
+        if self.health.state(i) != CLOSED:
+            self.health.reset(i)  # manual restore of a held breaker
+        for ev in reversed(self._failover_events):
+            if ev["replica"] == i and ev["recovered_at"] is None:
+                ev["recovered_at"] = now
+                self._recovery_s.append(max(0.0, now - ev["t"]))
+                break
+        self.counters["replica_restores"] += 1
+        log_dist(f"serving router: replica {i} restored into routing",
+                 ranks=[0])
+
     # -- driving ----------------------------------------------------------
     def step(self) -> bool:
-        """One fleet sweep: step every live replica once, then pump
-        handoffs. Returns False when nothing progressed."""
+        """One fleet sweep: step every live replica once (each dispatch
+        is a health observation when health_enabled — failures feed the
+        breaker instead of propagating, and a tripped breaker fails the
+        replica over automatically), then pump handoffs and poll
+        breaker probes. Returns False when nothing progressed."""
         progressed = False
         for i, sched in enumerate(self.schedulers):
             if i in self.dead:
                 continue
-            if sched.step():
-                progressed = True
+            t0 = self._clock()
+            ok = True
+            try:
+                if sched.step():
+                    progressed = True
+            except Exception as e:
+                if not self.cfg.health_enabled:
+                    raise
+                ok = False
+                log_dist(
+                    f"serving router: replica {i} dispatch failed "
+                    f"({e!r})", ranks=[0])
+            if self.cfg.health_enabled:
+                now = self._clock()
+                dur = (now - t0) + sched.drain_fault_delay()
+                if self.note_step_result(i, ok, dur, now=now) == "open":
+                    progressed = True  # fleet state changed: orphans moved
         if self.pump():
+            progressed = True
+        if self.poll_health():
             progressed = True
         return progressed
 
@@ -427,6 +675,7 @@ class ServingRouter:
         for i, s in enumerate(self.schedulers):
             for k, v in s.metrics().items():
                 m[f"replica{i}/{k}"] = v
+            m[f"replica{i}/health_state"] = STATE_CODE[self.health.state(i)]
             ttft += s._ttft
             tpot += s._tpot
             if s._spec:
@@ -461,6 +710,14 @@ class ServingRouter:
             m["fleet/spec_draft_acceptance_rate"] = (
                 (spec_accepted - spec_chunks) / spec_drafts
                 if spec_drafts else 0.0)
+        # resilience: breaker lifecycle counters, failover audit,
+        # recovery-time percentiles (failover -> restored, same clock
+        # the driver feeds — virtual in the chaos sim, wall otherwise)
+        for k, v in self.health.metrics().items():
+            m[f"fleet/{k}"] = v
+        m["fleet/failovers"] = float(len(self._failover_events))
+        m["fleet/recovery_p50_ms"] = pct(self._recovery_s, 50)
+        m["fleet/recovery_p95_ms"] = pct(self._recovery_s, 95)
         for k, v in self.counters.items():
             m[f"fleet/{k}"] = float(v)
         return m

@@ -60,6 +60,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 import numpy as np
 
 from ..config.config import ServingSchedulerConfig
+from ..resilience.faults import fault_point
 from ..utils.logging import log_dist
 from ..utils.sync import serving_readback
 from .engine import InferenceEngine, _bucket
@@ -182,6 +183,13 @@ class ServingScheduler:
         }
         self._ttft: List[float] = []
         self._tpot: List[float] = []
+        # set by ServingRouter (fault-point ctx + health identity);
+        # standalone schedulers leave it None
+        self.replica_index: Optional[int] = None
+        # injected straggler time (resilience/faults 'delay' kind)
+        # accrues here: virtual-clock drivers charge it to their
+        # clocks, wall drivers fold it into the health observation
+        self.fault_delay_s = 0.0
         if self.cfg.warmup:
             use_pres = self.scfg.needs_presence
             chunks = ((self.cfg.decode_chunk,)
@@ -313,7 +321,14 @@ class ServingScheduler:
                 f"decode replica at max_batch_size "
                 f"{self.engine.config.max_batch_size}")
         uid = self._alloc_uid()
-        self.engine.import_kv(uid, payload)  # may raise: pool exhausted
+        try:
+            self.engine.import_kv(uid, payload)  # may raise: pool exhausted
+        except Exception:
+            # a failed import must not leak half-allocated blocks —
+            # callers fall back to requeue-for-recompute on this engine
+            if self.engine.state.get(uid) is not None:
+                self.engine.flush(uid)
+            raise
         req.uid = uid
         req.rid = self._next_rid
         self._next_rid += 1
@@ -848,9 +863,21 @@ class ServingScheduler:
         return _Step([], 0)  # already finalized (host verification)
 
     # -- public driving --------------------------------------------------
+    def drain_fault_delay(self) -> float:
+        """Collect and reset injected straggler time (0.0 outside chaos
+        runs)."""
+        d, self.fault_delay_s = self.fault_delay_s, 0.0
+        return d
+
     def step(self) -> bool:
         """One scheduling iteration (dispatch + finalize). Returns False
-        when there was nothing to do."""
+        when there was nothing to do. Chaos fault point
+        'scheduler.step' fires BEFORE dispatch: an injected replica
+        death raises with no state half-mutated (requeue is safe), an
+        injected straggler delay accrues to fault_delay_s."""
+        act = fault_point("scheduler.step", replica=self.replica_index)
+        if act is not None and act.kind == "delay":
+            self.fault_delay_s += act.value
         st = self._dispatch()
         if st is None:
             return False

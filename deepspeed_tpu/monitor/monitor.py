@@ -119,7 +119,18 @@ def serving_events(scheduler, step: int,
     `prefix`/fleet/<name> — fleet TTFT/TPOT percentiles, cache-hit
     routing rate, session-affinity hits/evictions, KV-handoff count
     and latency percentiles, failover requeues, live-replica count,
-    and per-replica speculative acceptance when spec replicas exist."""
+    and per-replica speculative acceptance when spec replicas exist.
+
+    Resilience feed (deepspeed_tpu/resilience, docs/fault_tolerance.md)
+    — same call, no extra wiring: per-replica circuit-breaker state
+    codes (`replica<i>/health_state`: 0 closed / 1 open / 2 half-open /
+    3 held) and fleet-level `fleet/breaker_opens|closes|probes`,
+    `fleet/health_failures`, `fleet/state_transitions`,
+    `fleet/auto_failovers`, `fleet/failovers`,
+    `fleet/replica_restores`, `fleet/shed_requests` (overload
+    backpressure), `fleet/handoff_fallbacks`/`fleet/handoff_timeouts`,
+    and failover->restore recovery-time percentiles
+    (`fleet/recovery_p50_ms`/`fleet/recovery_p95_ms`)."""
     metrics = scheduler.metrics()
     return [(f"{prefix}/{name}", float(value), step)
             for name, value in sorted(metrics.items())]

@@ -1,0 +1,43 @@
+"""Fault injection + self-healing primitives (docs/fault_tolerance.md).
+
+`faults` is the deterministic chaos harness (FaultPlan, fault_point,
+arm/disarm); `health` is the per-replica circuit breaker the serving
+router's auto-failover runs on. Training-side failure detection lives
+in elasticity/agent.py (heartbeats); crash-consistent checkpointing in
+runtime/checkpoint.py (commit markers + verified-tag fallback) — both
+carry fault points from here."""
+
+from .faults import (
+    CheckpointCrashError,
+    FaultAction,
+    FaultPlan,
+    FaultSpec,
+    HandoffError,
+    InjectedFault,
+    InjectedIOError,
+    ReplicaDeadError,
+    active_plan,
+    arm,
+    armed,
+    corrupt_file,
+    disarm,
+    fault_point,
+)
+from .health import (
+    CLOSED,
+    HALF_OPEN,
+    HELD,
+    OPEN,
+    BreakerConfig,
+    FleetHealth,
+    ReplicaBreaker,
+)
+
+__all__ = [
+    "FaultPlan", "FaultSpec", "FaultAction", "fault_point", "arm",
+    "disarm", "armed", "active_plan", "corrupt_file",
+    "InjectedFault", "ReplicaDeadError", "HandoffError",
+    "InjectedIOError", "CheckpointCrashError",
+    "BreakerConfig", "ReplicaBreaker", "FleetHealth",
+    "CLOSED", "OPEN", "HALF_OPEN", "HELD",
+]

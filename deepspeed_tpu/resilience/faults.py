@@ -1,0 +1,295 @@
+"""Deterministic fault injection: the chaos half of the self-healing
+serving fleet (docs/fault_tolerance.md).
+
+Faults in production arrive from the environment — a preempted VM, a
+flaky NIC, a crashed writer — which makes every recovery path the least
+tested code in the system. This module inverts that: recovery paths are
+driven by a seeded, REPLAYABLE `FaultPlan` injected at named **fault
+points** compiled into the real code paths (router dispatch, KV
+handoff, checkpoint commit, offload I/O, heartbeats), so CI exercises
+replica death, handoff failure, stragglers, and crash-consistent
+checkpoint recovery deterministically (scripts/ds_chaos.py; the
+Varuna/Bamboo-class preemption-tolerance posture, PAPERS).
+
+Design constraints:
+
+- **zero overhead disarmed**: a fault point is one module-global
+  ``None`` check when no plan is armed — safe to leave in per-step hot
+  paths forever.
+- **deterministic**: a spec fires on the Nth *matching* invocation of
+  its point (`at`), for `times` consecutive matches (-1 = forever).
+  No wall clocks, no RNG in the trigger path; the plan's `seed` only
+  drives payload choices (which byte to corrupt). Same plan + same
+  workload = same failure schedule, replica for replica.
+- **typed failures**: injected errors subclass `InjectedFault` so
+  recovery code can assert it healed an *injected* fault, and so a
+  stray injection outside a chaos lane is attributable in one grep.
+
+Fault points registered across the tree (ctx keys in parens):
+
+  scheduler.step      (replica)   ServingScheduler.step entry — raise =
+                                  replica death mid-decode; delay =
+                                  straggler (accrues to
+                                  ``scheduler.fault_delay_s``; virtual-
+                                  clock drivers charge it, real drivers
+                                  sleep it)
+  engine.export_kv    (uid)       KV handoff export (raise/delay)
+  engine.import_kv    (uid)       KV handoff import (raise/delay)
+  router.probe        (replica)   health-monitor half-open probe
+  checkpoint.save     (tag)       orbax write (transient I/O error —
+                                  save retry heals it)
+  checkpoint.commit   (tag)       the commit window: state durable,
+                                  marker not yet written (crash here =
+                                  an uncommitted tag on disk)
+  checkpoint.corrupt  (tag, dir)  post-commit bitrot (kind='corrupt'
+                                  flips bytes in one state file)
+  offload.io          (what)      NvmeLayerStore aio op (transient
+                                  I/O — bounded retry heals it)
+  heartbeat.beat      (rank)      kind='skip' suppresses the write (a
+                                  wedged-but-alive controller)
+"""
+
+import contextlib
+import dataclasses
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional, Union
+
+__all__ = [
+    "FaultPlan", "FaultSpec", "FaultAction", "fault_point", "arm",
+    "disarm", "armed", "active_plan", "corrupt_file",
+    "InjectedFault", "ReplicaDeadError", "HandoffError",
+    "InjectedIOError", "CheckpointCrashError",
+]
+
+
+class InjectedFault(RuntimeError):
+    """Base of every injected failure (grep-able provenance)."""
+
+
+class ReplicaDeadError(InjectedFault):
+    """A serving replica died mid-step (device gone)."""
+
+
+class HandoffError(InjectedFault):
+    """A KV block transfer (export/import) failed."""
+
+
+class InjectedIOError(InjectedFault, OSError):
+    """A transient storage-layer I/O failure (retry-able)."""
+
+
+class CheckpointCrashError(InjectedFault):
+    """Process crash inside the checkpoint commit window."""
+
+
+_ERRORS = {
+    "replica_dead": ReplicaDeadError,
+    "handoff": HandoffError,
+    "io": InjectedIOError,
+    "ckpt_crash": CheckpointCrashError,
+    "generic": InjectedFault,
+}
+
+_KINDS = ("raise", "delay", "skip", "corrupt")
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One deterministic failure rule.
+
+    point: fault-point name (registry in the module docstring).
+    kind:  'raise' (throw `error`), 'delay' (hand `value` seconds to
+           the call site), 'skip' (suppress the guarded action),
+           'corrupt' (call site mutates bytes via corrupt_file).
+    where: ctx filters — every key must equal the call site's ctx for
+           the invocation to count as a match.
+    at:    fire from the at-th matching invocation (1-based).
+    times: for how many consecutive matches (-1 = forever)."""
+
+    point: str
+    kind: str = "raise"
+    error: str = "generic"
+    value: float = 0.0
+    where: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    at: int = 1
+    times: int = 1
+    note: str = ""
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown fault kind '{self.kind}' "
+                             f"(expected one of {_KINDS})")
+        if self.kind == "raise" and self.error not in _ERRORS:
+            raise ValueError(f"unknown error '{self.error}' "
+                             f"(expected one of {sorted(_ERRORS)})")
+        if self.at < 1:
+            raise ValueError("at is 1-based and must be >= 1")
+
+
+class FaultAction:
+    """Non-raising verdict of a fault point: kind + value + the spec."""
+
+    __slots__ = ("kind", "value", "spec")
+
+    def __init__(self, kind: str, value: float, spec: FaultSpec):
+        self.kind = kind
+        self.value = value
+        self.spec = spec
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"FaultAction({self.kind}, {self.value})"
+
+
+class FaultPlan:
+    """A seeded, ordered set of FaultSpecs plus the chaos lane's pass
+    budget. Counters live here (not in the specs), so one plan object
+    can be reset and replayed."""
+
+    def __init__(self, faults: List[Union[FaultSpec, Dict[str, Any]]],
+                 seed: int = 0, budget: Optional[Dict[str, float]] = None,
+                 name: str = "chaos"):
+        self.name = name
+        self.seed = int(seed)
+        # chaos-gate budget: min_goodput_ratio (chaos/clean goodput),
+        # max_recovery_s (virtual failover->drained), max_token_loss
+        self.budget: Dict[str, float] = dict(budget or {})
+        self.faults: List[FaultSpec] = [
+            f if isinstance(f, FaultSpec) else FaultSpec(**f)
+            for f in faults]
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> "FaultPlan":
+        self._matched = [0] * len(self.faults)
+        self.fired: List[str] = []   # human-readable injection log
+        return self
+
+    # -- construction -----------------------------------------------------
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FaultPlan":
+        return cls(d.get("faults", []), seed=d.get("seed", 0),
+                   budget=d.get("budget"), name=d.get("name", "chaos"))
+
+    @classmethod
+    def from_json(cls, path_or_text: str) -> "FaultPlan":
+        if os.path.exists(path_or_text):
+            with open(path_or_text) as f:
+                d = json.load(f)
+            d.setdefault("name", os.path.basename(path_or_text))
+        else:
+            d = json.loads(path_or_text)
+        return cls.from_dict(d)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name, "seed": self.seed, "budget": self.budget,
+            "faults": [dataclasses.asdict(f) for f in self.faults],
+        }
+
+    # -- the trigger path -------------------------------------------------
+    def _hit(self, point: str, ctx: Dict[str, Any]):
+        """One fault-point invocation: count matches, fire what is due.
+        A 'raise' spec throws immediately; other kinds return the last
+        due FaultAction (None when nothing fires)."""
+        act: Optional[FaultAction] = None
+        for k, spec in enumerate(self.faults):
+            if spec.point != point:
+                continue
+            if any(ctx.get(key) != want for key, want in spec.where.items()):
+                continue
+            # count + fire-log under the lock: fault points sit in
+            # io_callback paths, so invocations arrive from unordered
+            # threads (the offload.io point)
+            with self._lock:
+                self._matched[k] += 1
+                n = self._matched[k]
+                due = n >= spec.at and (
+                    spec.times < 0 or n < spec.at + spec.times)
+                if due:
+                    detail = (spec.error if spec.kind == "raise"
+                              else f"{spec.value}" if spec.kind == "delay"
+                              else spec.kind)
+                    self.fired.append(f"{point}#{n}:{spec.kind}:{detail}")
+            if not due:
+                continue
+            if spec.kind == "raise":
+                raise _ERRORS[spec.error](
+                    f"injected {spec.error} at {point} "
+                    f"(matching invocation {n}, plan '{self.name}')")
+            act = FaultAction(spec.kind, spec.value, spec)
+        return act
+
+
+# -- the armed-plan singleton ---------------------------------------------
+# One process-global plan: fault points are sprinkled across modules
+# that must not know about each other, and chaos runs arm exactly one
+# plan at a time (the lane's determinism depends on it).
+_ACTIVE: Optional[FaultPlan] = None
+
+
+def arm(plan: Union[FaultPlan, Dict[str, Any], str]) -> FaultPlan:
+    """Arm a plan (FaultPlan | dict | JSON path/text). Returns it."""
+    global _ACTIVE
+    if isinstance(plan, str):
+        plan = FaultPlan.from_json(plan)
+    elif isinstance(plan, dict):
+        plan = FaultPlan.from_dict(plan)
+    _ACTIVE = plan
+    return plan
+
+
+def disarm() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def armed(plan: Union[FaultPlan, Dict[str, Any], str]):
+    """Scope-bound arming: ``with armed(plan) as p: ...`` — disarms on
+    exit even when the injected fault propagates."""
+    p = arm(plan)
+    try:
+        yield p
+    finally:
+        disarm()
+
+
+def fault_point(point: str, **ctx) -> Optional[FaultAction]:
+    """The injection site. Disarmed: one global read + None check.
+    Armed: may raise an InjectedFault subclass, or return a FaultAction
+    ('delay'/'skip'/'corrupt') for the call site to interpret."""
+    plan = _ACTIVE
+    if plan is None:
+        return None
+    return plan._hit(point, ctx)
+
+
+def corrupt_file(path: str, seed: int = 0) -> int:
+    """Deterministically flip one byte per KiB (min 1) in the middle
+    half of a file — the injected-bitrot payload behind
+    kind='corrupt'. Returns the number of bytes flipped."""
+    import numpy as np
+
+    size = os.path.getsize(path)
+    if size == 0:
+        return 0
+    rng = np.random.default_rng(
+        seed ^ int.from_bytes(os.path.basename(path).encode()[:8].ljust(8, b"\0"), "little"))
+    n = max(1, size // 1024)
+    lo, hi = size // 4, max(size // 4 + 1, 3 * size // 4)
+    offsets = sorted(set(int(x) for x in rng.integers(lo, hi, n)))
+    with open(path, "r+b") as f:
+        for off in offsets:
+            f.seek(off)
+            b = f.read(1)
+            if not b:
+                continue
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0xFF]))
+    return len(offsets)

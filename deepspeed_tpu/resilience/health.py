@@ -1,0 +1,184 @@
+"""Per-replica serving health: dispatch deadlines + a consecutive-
+failure circuit breaker with exponential backoff and half-open probes.
+
+The serving analog of elasticity/agent.py's HealthMonitor (which
+watches *training* controllers via heartbeat files): here the signal is
+each replica's own dispatch behavior — a step that raises, or takes
+longer than the dispatch deadline, is a failure observation. The state
+machine per replica is the classic circuit breaker:
+
+    CLOSED --(failure_threshold consecutive failures)--> OPEN
+    OPEN   --(backoff elapsed)--> HALF_OPEN (one probe allowed)
+    HALF_OPEN --probe ok--> CLOSED (replica rejoins routing)
+    HALF_OPEN --probe fails--> OPEN (backoff *= mult, capped)
+    any    --hold()--> HELD (manual fail_replica: no auto-probing;
+                             only an explicit restore_replica reopens)
+
+The monitor itself is clock-agnostic: every observation carries `now`,
+so the deterministic virtual-clock fleet simulator (bench.py
+--serving-sim --chaos) and a wall-clock deployment share one code
+path. `ServingRouter` owns an instance and translates OPEN transitions
+into its existing `fail_replica` requeue machinery — failover becomes
+automatic instead of a test API (docs/fault_tolerance.md).
+"""
+
+import dataclasses
+from typing import Dict, List, Optional
+
+__all__ = ["BreakerConfig", "ReplicaBreaker", "FleetHealth",
+           "CLOSED", "OPEN", "HALF_OPEN", "HELD"]
+
+CLOSED, OPEN, HALF_OPEN, HELD = "closed", "open", "half_open", "held"
+
+# numeric encoding for metrics sinks (monitor events are floats)
+STATE_CODE = {CLOSED: 0.0, OPEN: 1.0, HALF_OPEN: 2.0, HELD: 3.0}
+
+
+@dataclasses.dataclass
+class BreakerConfig:
+    """Health thresholds (router config carries these flat)."""
+
+    failure_threshold: int = 3       # consecutive failures -> OPEN
+    dispatch_deadline_s: float = 0.0  # 0 = exception-only detection
+    backoff_s: float = 1.0           # first OPEN -> HALF_OPEN wait
+    backoff_mult: float = 2.0        # per failed probe
+    backoff_max_s: float = 30.0
+
+
+class ReplicaBreaker:
+    """One replica's health state (pure state machine, injectable
+    clock via the `now` argument on every transition)."""
+
+    def __init__(self, cfg: BreakerConfig):
+        self.cfg = cfg
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self.backoff_s = cfg.backoff_s
+        self.opened_at: Optional[float] = None
+        self.failures = 0            # lifetime failure observations
+        self.opens = 0
+        self.closes = 0
+        self.probes = 0
+
+    def observe(self, ok: bool, duration_s: float, now: float) -> Optional[str]:
+        """One dispatch observation. Returns 'open' when this
+        observation tripped the breaker, 'close' when a half-open
+        probe-by-traffic healed it, else None."""
+        deadline = self.cfg.dispatch_deadline_s
+        failed = (not ok) or (deadline > 0 and duration_s > deadline)
+        if self.state == HELD:
+            return None
+        if failed:
+            self.failures += 1
+            self.consecutive_failures += 1
+            if self.state == HALF_OPEN:
+                return self._reopen(now)
+            if (self.state == CLOSED
+                    and self.consecutive_failures >= self.cfg.failure_threshold):
+                return self._open(now)
+            return None
+        self.consecutive_failures = 0
+        if self.state == HALF_OPEN:
+            return self._close()
+        return None
+
+    def probe_result(self, ok: bool, now: float) -> Optional[str]:
+        """Outcome of an explicit half-open probe."""
+        self.probes += 1
+        if self.state != HALF_OPEN:
+            return None
+        return self._close() if ok else self._reopen(now)
+
+    def due_probe(self, now: float) -> bool:
+        """OPEN and past backoff: transition to HALF_OPEN and allow one
+        probe. (HALF_OPEN itself never re-probes — the pending probe's
+        result decides.)"""
+        if self.state != OPEN or self.opened_at is None:
+            return False
+        if now - self.opened_at < self.backoff_s:
+            return False
+        self.state = HALF_OPEN
+        return True
+
+    def hold(self) -> None:
+        """Manual failover: park the breaker so auto-probing can never
+        resurrect a replica an operator (or test) killed on purpose."""
+        self.state = HELD
+        self.opened_at = None
+
+    def reset(self) -> None:
+        """Explicit restore: back to CLOSED with fresh backoff."""
+        if self.state != CLOSED:
+            self.closes += 1
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self.backoff_s = self.cfg.backoff_s
+        self.opened_at = None
+
+    # -- transitions ------------------------------------------------------
+    def _open(self, now: float) -> str:
+        self.state = OPEN
+        self.opened_at = now
+        self.opens += 1
+        return "open"
+
+    def _reopen(self, now: float) -> str:
+        self.state = OPEN
+        self.opened_at = now
+        self.backoff_s = min(self.backoff_s * self.cfg.backoff_mult,
+                             self.cfg.backoff_max_s)
+        return "reopen"
+
+    def _close(self) -> str:
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self.backoff_s = self.cfg.backoff_s
+        self.opened_at = None
+        self.closes += 1
+        return "close"
+
+
+class FleetHealth:
+    """Breakers for N replicas + fleet-level transition counters."""
+
+    def __init__(self, n: int, cfg: BreakerConfig):
+        self.cfg = cfg
+        self.breakers: List[ReplicaBreaker] = [
+            ReplicaBreaker(cfg) for _ in range(n)]
+        self.transitions: List[str] = []   # "<i>:<event>" audit trail
+
+    def observe(self, i: int, ok: bool, duration_s: float,
+                now: float) -> Optional[str]:
+        ev = self.breakers[i].observe(ok, duration_s, now)
+        if ev:
+            self.transitions.append(f"{i}:{ev}")
+        return ev
+
+    def probe_result(self, i: int, ok: bool, now: float) -> Optional[str]:
+        ev = self.breakers[i].probe_result(ok, now)
+        if ev:
+            self.transitions.append(f"{i}:probe_{ev}")
+        return ev
+
+    def due_probes(self, now: float) -> List[int]:
+        return [i for i, b in enumerate(self.breakers) if b.due_probe(now)]
+
+    def hold(self, i: int) -> None:
+        self.breakers[i].hold()
+        self.transitions.append(f"{i}:held")
+
+    def reset(self, i: int) -> None:
+        self.breakers[i].reset()
+        self.transitions.append(f"{i}:restored")
+
+    def state(self, i: int) -> str:
+        return self.breakers[i].state
+
+    def metrics(self) -> Dict[str, float]:
+        return {
+            "breaker_opens": float(sum(b.opens for b in self.breakers)),
+            "breaker_closes": float(sum(b.closes for b in self.breakers)),
+            "breaker_probes": float(sum(b.probes for b in self.breakers)),
+            "health_failures": float(sum(b.failures for b in self.breakers)),
+            "state_transitions": float(len(self.transitions)),
+        }

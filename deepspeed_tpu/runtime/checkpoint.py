@@ -9,25 +9,122 @@ addressable shards, restore re-shards to whatever mesh the new run uses
 (deepspeed/checkpoint/ds_to_universal.py) is mostly free here: saved
 arrays are logical/global, not per-rank shards.
 
-Layout mirrors the reference's tag scheme:
-  <save_dir>/<tag>/state/...   (orbax tree)
+Layout mirrors the reference's tag scheme, hardened with a commit
+protocol (docs/fault_tolerance.md) so 'latest'/meta.json can never
+point at an uncommitted or corrupt tree:
+
+  <save_dir>/<tag>/INCOMPLETE     (written FIRST; removed at commit —
+                                   its presence marks a crash window)
+  <save_dir>/<tag>/state/...      (orbax tree)
   <save_dir>/<tag>/meta.json
-  <save_dir>/latest            (text file holding the newest tag)
-"""
+  <save_dir>/<tag>/manifest.json  (per-file size + blake2b checksum)
+  <save_dir>/<tag>/COMMITTED      (written LAST; holds the manifest
+                                   digest — marker + matching checksums
+                                   = a verified tag)
+  <save_dir>/latest               (text file holding the newest tag;
+                                   only ever updated AFTER COMMITTED)
+
+A crash anywhere before COMMITTED leaves INCOMPLETE behind and 'latest'
+still pointing at the previous tag; post-commit bitrot is caught by the
+checksummed manifest. `load(tag=None)` falls back to the newest
+VERIFIED tag when the one 'latest' names fails verification — the
+elastic agent's resume (elasticity/agent.py) rides this, so a host that
+died mid-save can never wedge the restart on a half-written tree
+(the Varuna/Bamboo preemption-tolerance posture)."""
 
 import contextlib
+import hashlib
 import json
 import os
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 
+from ..resilience.faults import active_plan, corrupt_file, fault_point
 from ..utils.logging import log_dist
+
+_INCOMPLETE = "INCOMPLETE"
+_COMMITTED = "COMMITTED"
+_MANIFEST = "manifest.json"
+_MARKERS = (_INCOMPLETE, _COMMITTED, _MANIFEST)
+
+
+class CheckpointCorruptError(RuntimeError):
+    """The requested tag failed verification (uncommitted crash residue
+    or checksum mismatch) and no verified fallback exists."""
+
+
+def _file_digest(path: str) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _manifest_digest(manifest: Dict) -> str:
+    return hashlib.blake2b(
+        json.dumps(manifest, sort_keys=True).encode(),
+        digest_size=16).hexdigest()
+
+
+def build_manifest(tag_dir: str) -> Dict:
+    """Checksummed inventory of everything under the tag dir except the
+    protocol markers themselves."""
+    files: Dict[str, Dict] = {}
+    for root, _, names in os.walk(tag_dir):
+        for name in sorted(names):
+            rel = os.path.relpath(os.path.join(root, name), tag_dir)
+            if rel in _MARKERS:
+                continue
+            p = os.path.join(tag_dir, rel)
+            files[rel] = {"size": os.path.getsize(p),
+                          "blake2b": _file_digest(p)}
+    return {"files": files}
+
+
+def verify_tag(load_dir: str, tag: str) -> Tuple[bool, str]:
+    """Is <load_dir>/<tag> a committed, uncorrupted checkpoint?
+    Returns (ok, reason). Tags written before the commit protocol
+    (no markers at all) are accepted as legacy."""
+    tag_dir = os.path.join(os.path.abspath(load_dir), tag)
+    if not os.path.isdir(tag_dir):
+        return False, "tag dir missing"
+    if os.path.exists(os.path.join(tag_dir, _INCOMPLETE)):
+        return False, "uncommitted (crash window residue)"
+    committed = os.path.join(tag_dir, _COMMITTED)
+    manifest_p = os.path.join(tag_dir, _MANIFEST)
+    if not os.path.exists(committed):
+        if os.path.exists(manifest_p):
+            return False, "manifest without commit marker"
+        return True, "legacy (pre-commit-protocol tag)"
+    try:
+        with open(manifest_p) as f:
+            manifest = json.load(f)
+        with open(committed) as f:
+            want = f.read().strip()
+    except (OSError, ValueError) as e:
+        return False, f"unreadable markers ({e})"
+    if _manifest_digest(manifest) != want:
+        return False, "manifest digest mismatch"
+    for rel, rec in manifest.get("files", {}).items():
+        p = os.path.join(tag_dir, rel)
+        if not os.path.exists(p):
+            return False, f"missing file {rel}"
+        if os.path.getsize(p) != rec["size"]:
+            return False, f"size mismatch in {rel}"
+        if _file_digest(p) != rec["blake2b"]:
+            return False, f"checksum mismatch in {rel}"
+    return True, "verified"
 
 
 class CheckpointEngine:
-    def __init__(self, async_save: bool = False):
+    def __init__(self, async_save: bool = False, save_retries: int = 3,
+                 retry_backoff_s: float = 0.05):
         self.async_save = async_save
+        self.save_retries = int(save_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
         self._ckptr = None
         self._pending = None
         if async_save:
@@ -49,22 +146,87 @@ class CheckpointEngine:
         return self._ckptr
 
     def save(self, save_dir: str, tag: str, state: Any, meta: Dict) -> None:
+        """Crash-consistent save: INCOMPLETE marker first, orbax tree
+        (with bounded retry + exponential backoff on transient I/O
+        errors), then the commit sequence — meta, checksummed manifest,
+        COMMITTED marker, and ONLY then the 'latest' pointer. Async
+        saves defer the whole commit sequence to wait(): until the
+        background orbax write lands, the tag stays marked INCOMPLETE
+        and 'latest' untouched, so a crash in that window is detected
+        at load instead of resuming from a half-written tree."""
         save_dir = os.path.abspath(save_dir)
-        path = os.path.join(save_dir, tag, "state")
-        os.makedirs(os.path.join(save_dir, tag), exist_ok=True)
+        tag_dir = os.path.join(save_dir, tag)
+        path = os.path.join(tag_dir, "state")
+        os.makedirs(tag_dir, exist_ok=True)
         self.wait()  # one in-flight async save at a time (ref: nebula engine semantics)
-        ckptr = self._checkpointer()
-        ckptr.save(path, state, force=True)
         if jax.process_index() == 0:
-            with open(os.path.join(save_dir, tag, "meta.json"), "w") as f:
-                json.dump(meta, f)
+            with open(os.path.join(tag_dir, _INCOMPLETE), "w") as f:
+                f.write("commit pending")
+        ckptr = self._checkpointer()
+        self._save_with_retry(ckptr, path, state, tag)
         if self.async_save:
-            # 'latest' must only point at committed data: defer the pointer
-            # update until the background commit finishes (wait()).
-            self._pending = (ckptr, save_dir, tag)
+            # the tag must only become loadable once the background
+            # commit finishes: defer meta/manifest/COMMITTED/'latest'
+            # to wait() (pre-hardening, meta.json landed HERE — a crash
+            # before the orbax commit left a tag that looked complete)
+            self._pending = (ckptr, save_dir, tag, meta)
         else:
-            self._write_latest(save_dir, tag)
+            self._commit(save_dir, tag, meta)
         log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
+
+    def _save_with_retry(self, ckptr, path: str, state: Any,
+                         tag: str) -> None:
+        """Transient storage errors (an NFS blip, a GCS 5xx) heal with
+        a bounded retry; anything still failing after the budget
+        surfaces. Only OSError is retried — a shape/type error from
+        orbax retries into the same wall."""
+        for attempt in range(self.save_retries + 1):
+            try:
+                fault_point("checkpoint.save", tag=tag)
+                ckptr.save(path, state, force=True)
+                return
+            except OSError as e:
+                if attempt == self.save_retries:
+                    log_dist(
+                        f"checkpoint save of {tag} failed after "
+                        f"{attempt + 1} attempts: {e!r}", ranks=[0])
+                    raise
+                delay = self.retry_backoff_s * (2 ** attempt)
+                log_dist(
+                    f"checkpoint save of {tag} hit transient I/O error "
+                    f"({e!r}); retry {attempt + 1}/{self.save_retries} "
+                    f"in {delay:.2f}s", ranks=[0])
+                time.sleep(delay)
+
+    def _commit(self, save_dir: str, tag: str, meta: Dict) -> None:
+        """The commit sequence: anything before COMMITTED can crash and
+        the tag stays invisible (INCOMPLETE present, 'latest' old)."""
+        tag_dir = os.path.join(save_dir, tag)
+        fault_point("checkpoint.commit", tag=tag)  # the crash window
+        if jax.process_index() == 0:
+            with open(os.path.join(tag_dir, "meta.json"), "w") as f:
+                json.dump(meta, f)
+            manifest = build_manifest(tag_dir)
+            with open(os.path.join(tag_dir, _MANIFEST), "w") as f:
+                json.dump(manifest, f)
+            with open(os.path.join(tag_dir, _COMMITTED), "w") as f:
+                f.write(_manifest_digest(manifest))
+            try:
+                os.remove(os.path.join(tag_dir, _INCOMPLETE))
+            except OSError:
+                pass
+        self._write_latest(save_dir, tag)
+        act = fault_point("checkpoint.corrupt", tag=tag, dir=tag_dir)
+        if act is not None and act.kind == "corrupt":
+            # injected post-commit bitrot: flip bytes in the largest
+            # state file — verification must catch it at load
+            plan = active_plan()
+            state_dir = os.path.join(tag_dir, "state")
+            victims = [os.path.join(r, n)
+                       for r, _, ns in os.walk(state_dir) for n in ns]
+            if victims:
+                victim = max(victims, key=os.path.getsize)
+                corrupt_file(victim, seed=plan.seed if plan else 0)
 
     @staticmethod
     def _write_latest(save_dir: str, tag: str) -> None:
@@ -74,10 +236,13 @@ class CheckpointEngine:
 
     def wait(self) -> None:
         if self._pending is not None:
-            ckptr, save_dir, tag = self._pending
-            ckptr.wait_until_finished()
-            self._write_latest(save_dir, tag)
+            ckptr, save_dir, tag, meta = self._pending
+            # crash semantics: a failed commit is not retried on the
+            # next wait() — the tag stays INCOMPLETE and load falls
+            # back to the previous verified one
             self._pending = None
+            ckptr.wait_until_finished()
+            self._commit(save_dir, tag, meta)
 
     def resolve_tag(self, load_dir: str, tag: Optional[str]) -> str:
         load_dir = os.path.abspath(load_dir)
@@ -89,12 +254,56 @@ class CheckpointEngine:
                 tag = f.read().strip()
         return tag
 
+    def resolve_verified_tag(self, load_dir: str,
+                             tag: Optional[str]) -> str:
+        """resolve_tag + verification. An EXPLICIT tag that fails
+        verification raises (the caller asked for that exact version);
+        a failing 'latest' falls back to the newest verified tag in
+        the directory — the crash-consistent resume path."""
+        load_dir = os.path.abspath(load_dir)
+        explicit = tag is not None
+        resolved = self.resolve_tag(load_dir, tag)
+        if explicit and not os.path.isdir(os.path.join(load_dir, resolved)):
+            # absent is not corrupt: keep the miss contract (tiered
+            # fast-tier sweeps, caller typos) a FileNotFoundError
+            raise FileNotFoundError(
+                f"checkpoint tag {resolved} not found in {load_dir}")
+        ok, why = verify_tag(load_dir, resolved)
+        if ok:
+            return resolved
+        if explicit:
+            raise CheckpointCorruptError(
+                f"checkpoint {resolved} in {load_dir} failed "
+                f"verification: {why}")
+        log_dist(
+            f"checkpoint {resolved} (from 'latest') failed verification "
+            f"({why}); falling back to the newest verified tag",
+            ranks=[0])
+        candidates = [
+            t for t in os.listdir(load_dir)
+            if t != resolved and os.path.isdir(os.path.join(load_dir, t))]
+        candidates.sort(
+            key=lambda t: os.path.getmtime(os.path.join(load_dir, t)),
+            reverse=True)
+        for cand in candidates:
+            ok, cand_why = verify_tag(load_dir, cand)
+            if ok:
+                log_dist(
+                    f"resuming from verified fallback tag {cand} "
+                    f"({cand_why})", ranks=[0])
+                return cand
+            log_dist(f"fallback candidate {cand} rejected: {cand_why}",
+                     ranks=[0])
+        raise CheckpointCorruptError(
+            f"no verified checkpoint in {load_dir}: latest tag "
+            f"{resolved} is bad ({why}) and no fallback verifies")
+
     def peek_meta(self, load_dir: str, tag: Optional[str]) -> Dict:
         """Read meta.json without touching tensor data (used to reconcile
         structure differences before restore)."""
         self.wait()  # an in-flight async save must commit before any read
         load_dir = os.path.abspath(load_dir)
-        tag = self.resolve_tag(load_dir, tag)
+        tag = self.resolve_verified_tag(load_dir, tag)
         meta_path = os.path.join(load_dir, tag, "meta.json")
         if os.path.exists(meta_path):
             with open(meta_path) as f:
@@ -108,7 +317,7 @@ class CheckpointEngine:
 
         self.wait()
         load_dir = os.path.abspath(load_dir)
-        tag = self.resolve_tag(load_dir, tag)
+        tag = self.resolve_verified_tag(load_dir, tag)
         path = os.path.join(load_dir, tag, "state")
         restore_args = ocp.checkpoint_utils.construct_restore_args(template_state)
         state = self._checkpointer().restore(
@@ -260,10 +469,13 @@ class TieredCheckpointEngine:
         self.fast.wait()
         val: Optional[Tuple[CheckpointEngine, str, str]] = None
         try:
-            resolved = self.fast.resolve_tag(load_dir, tag)
+            # verification-aware: an unverified fast-tier 'latest'
+            # (crash residue, bitrot) falls back first to an older
+            # verified fast-tier tag, then to the durable tier
+            resolved = self.fast.resolve_verified_tag(load_dir, tag)
             if os.path.isdir(os.path.join(os.path.abspath(load_dir), resolved, "state")):
                 val = (self.fast, load_dir, resolved)
-        except FileNotFoundError:
+        except (FileNotFoundError, CheckpointCorruptError):
             pass
         if val is None:
             if not self.enable_tier_load:
@@ -272,7 +484,8 @@ class TieredCheckpointEngine:
                        tag if tag is not None else "")
             else:
                 val = (self.durable, self.load_path,
-                       self.durable.resolve_tag(self.load_path, tag))
+                       self.durable.resolve_verified_tag(self.load_path,
+                                                         tag))
         return val
 
     def peek_meta(self, load_dir: str, tag: Optional[str]) -> Dict:

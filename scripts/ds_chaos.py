@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""ds-chaos CLI — deterministic fault-injection gate for the serving
+fleet (docs/fault_tolerance.md).
+
+Usage:
+    python scripts/ds_chaos.py                   # default plan, 4 replicas
+    python scripts/ds_chaos.py --plan my.json    # custom FaultPlan
+    python scripts/ds_chaos.py --replicas 6
+    python scripts/ds_chaos.py --strict          # identical today; kept
+                                                 # for gate-CLI symmetry
+
+The fifth tier-1 pre-test gate next to ds_lint / ds_budget /
+ds_numerics / the serving-fleet smoke (.claude/skills/verify/SKILL.md):
+runs `bench.py --serving-sim --chaos <plan>` — the virtual-clock fleet
+simulation served clean and then under the injected fault plan
+(replica death mid-decode, KV-handoff failures, a straggler window) —
+and fails unless every chaos gate holds:
+
+  zero_token_loss               every request finishes, outputs
+                                token-identical to the clean pass
+  auto_failover_no_manual_call  failover came from the health monitor
+                                (the lane never calls fail_replica)
+  goodput_within_budget         chaos/clean goodput >= plan budget
+  recovery_within_budget        orphan-drain recovery <= plan budget
+  straggler_restored            the slowed replica rejoined via a
+                                half-open probe
+  handoff_fallback_exercised    a failed KV transfer fell back to the
+                                token-identical recompute path
+
+Everything is virtual-time and seeded: a red gate is a control-plane
+regression, never flake.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--plan", default="default",
+                    help="'default' or a FaultPlan JSON path")
+    ap.add_argument("--replicas", type=int, default=4,
+                    help="fleet size (>= 2; default 4)")
+    ap.add_argument("--strict", action="store_true",
+                    help="accepted for symmetry with the other gates "
+                         "(every chaos gate is already hard)")
+    args = ap.parse_args(argv)
+    if args.replicas < 2:
+        ap.error("--replicas must be >= 2 (the chaos plan needs a "
+                 "fleet to fail over inside)")
+
+    import bench
+
+    rc = bench._chaos_sim(args.replicas, args.plan)
+    print(json.dumps({"ok": rc == 0, "gate": "ds_chaos",
+                      "plan": args.plan, "replicas": args.replicas}),
+          file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

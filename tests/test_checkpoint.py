@@ -107,6 +107,61 @@ def test_fp16_checkpoint_into_fp32_engine(tmp_path):
     assert e2.state.loss_scale is None
 
 
+def test_corrupt_latest_falls_back_to_verified_tag(tmp_path):
+    """The crash-consistent resume path the elastic agent rides
+    (docs/fault_tolerance.md): an engine whose newest checkpoint is
+    corrupt (injected bitrot) must resume from the previous VERIFIED
+    tag instead of wedging — engine.load_checkpoint goes through
+    CheckpointEngine.resolve_verified_tag."""
+    import os
+
+    from deepspeed_tpu.resilience import corrupt_file
+
+    e1 = build_engine()
+    b = batch()
+    e1.train_batch(b)
+    e1.save_checkpoint(str(tmp_path), tag="step1")
+    e1.train_batch(b)
+    e1.save_checkpoint(str(tmp_path), tag="step2")
+    state_dir = tmp_path / "step2" / "state"
+    victims = [os.path.join(r, n)
+               for r, _, ns in os.walk(state_dir) for n in ns]
+    corrupt_file(max(victims, key=os.path.getsize))
+
+    e2 = build_engine()
+    tag, _ = e2.load_checkpoint(str(tmp_path))
+    assert tag == "step1"
+    assert e2.global_steps == e1.global_steps - 1
+
+
+def test_injected_commit_crash_resumes_from_previous(tmp_path):
+    """PR-7 satellite regression: a crash in the async-save commit
+    window (state durable, markers unwritten) must leave 'latest' on
+    the previous tag and resume from it."""
+    import pytest as _pytest
+
+    from deepspeed_tpu.resilience import (
+        CheckpointCrashError, FaultPlan, armed)
+
+    e1 = build_engine(checkpoint={"async_save": True})
+    b = batch()
+    e1.train_batch(b)
+    e1.save_checkpoint(str(tmp_path), tag="step1")
+    e1.checkpoint_engine.wait()
+    e1.train_batch(b)
+    plan = FaultPlan([{"point": "checkpoint.commit", "kind": "raise",
+                       "error": "ckpt_crash", "where": {"tag": "step2"}}])
+    with armed(plan):
+        with _pytest.raises(CheckpointCrashError):
+            e1.save_checkpoint(str(tmp_path), tag="step2")
+            e1.checkpoint_engine.wait()
+    assert (tmp_path / "latest").read_text() == "step1"
+
+    e2 = build_engine()
+    tag, _ = e2.load_checkpoint(str(tmp_path))
+    assert tag == "step1"
+
+
 def test_reshard_zero_stage_across_load(tmp_path):
     """Save under ZeRO-2, load under ZeRO-3 with a different layout —
     the universal-checkpoint property (ref: deepspeed/checkpoint

@@ -46,6 +46,19 @@ token-identical outputs, failover is triggered by the health monitor
 half-open probe, and goodput degradation / orphan-drain recovery stay
 within the plan's budget. scripts/ds_chaos.py gates this in CI
 (docs/fault_tolerance.md).
+
+`python bench.py --train-chaos [plan]` (plan = 'default' =
+TRAINCHAOS.json, or a path) runs the TRAINING chaos lane on the
+virtual 8-device CPU mesh: one elastic training run executed
+uninterrupted and then under the injected plan — a mid-run rank
+preemption answered from peer-redundant ZeRO shards (world shrink +
+regrow, zero disk restores), transient dataloader/collective faults
+healed by bounded retries, and a straggler window that must flag.
+Exit is non-zero unless the data-order ledger is byte-exact, the loss
+trajectory matches the uninterrupted run (bitwise before the
+preemption, within the plan's reassociation budget after), and
+rollback/reconstruction stay within budget. scripts/ds_elastic.py
+gates this in CI (docs/fault_tolerance.md, docs/elasticity.md).
 """
 
 import json
@@ -835,6 +848,237 @@ def _chaos_sim(n_replicas: int, plan_arg: str):
     return 0 if all(gates.values()) else 1
 
 
+# ---------------------------------------------------------------------------
+# training chaos lane: preemption-tolerant elastic training under a plan
+# ---------------------------------------------------------------------------
+
+def _default_train_chaos_plan() -> dict:
+    """The CI training chaos plan (scripts/ds_elastic.py gates on it;
+    the committed TRAINCHAOS.json is this dict). One rank is preempted
+    mid-run (peer-redundant shards must recover it with NO disk
+    restore), a transient dataloader I/O error and a transient
+    control-plane collective error must heal inside their bounded
+    retries, and a post-regrow straggler window must show up in the
+    per-rank straggler flags. The `workload` block drives the lane's
+    geometry; `budget` bounds the recovery."""
+    return {
+        "name": "train-default",
+        "seed": 0,
+        "budget": {
+            # a recovery may replay at most the mirror cadence
+            "max_rollback_steps": 2,
+            # loss drift vs the uninterrupted run: float reassociation
+            # only (the shrunken world re-orders the gradient
+            # reduction), never a trajectory change
+            "max_loss_rel_diff": 1e-3,
+            "max_reconstruction_s": 60.0,
+            "max_disk_restores": 0,
+        },
+        "workload": {
+            "world": 4, "total_steps": 12, "every_k_steps": 2,
+            "regrow_at": 10, "regrow_to": 4,
+        },
+        "faults": [
+            # logical rank 2's host preempted at the dispatch of step 7
+            # (value names the lost rank); state is at step 6, the
+            # mirror boundary — recovery reconstructs from peers and
+            # reshards 4 -> 2
+            {"point": "engine.step", "kind": "raise", "error": "preempted",
+             "value": 2, "where": {"step": 7}, "at": 1, "times": 1},
+            # transient batch-fetch failure: the trainer's bounded
+            # retry re-fetches the SAME batch (loader position clean)
+            {"point": "dataloader.fetch", "kind": "raise", "error": "io",
+             "at": 3, "times": 1},
+            # transient control-plane collective failure during a
+            # mirror barrier: the comm guard's retry heals it
+            {"point": "comm.collective", "kind": "raise", "error": "io",
+             "at": 2, "times": 1},
+            # post-regrow straggler window: two slow steps that must
+            # trip the per-rank straggler flag in the monitor feed
+            {"point": "engine.step", "kind": "delay", "value": 0.5,
+             "where": {"step": 11}, "at": 1, "times": 1},
+            {"point": "engine.step", "kind": "delay", "value": 0.5,
+             "where": {"step": 12}, "at": 1, "times": 1},
+        ],
+    }
+
+
+def _train_chaos(plan_arg: str):
+    """Training chaos gate (scripts/ds_elastic.py;
+    docs/fault_tolerance.md): the same elastic training run executed
+    twice on the virtual 8-device CPU mesh — uninterrupted, then under
+    the injected FaultPlan (a mid-run rank preemption + world shrink +
+    regrow, transient data/comm faults, a straggler window) — asserting
+    recovery from PEER-REDUNDANT shards with zero disk-checkpoint
+    restores, a byte-exact data-order ledger (zero sample loss or
+    duplication), a loss trajectory identical where the restored world
+    permits (bitwise before the preemption; within the plan's
+    reassociation budget across the shrink/regrow), and bounded
+    rollback/reconstruction cost."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_threefry_partitionable", True)
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.elasticity import ElasticTrainer
+    from deepspeed_tpu.models import transformer as T
+    from deepspeed_tpu.monitor.monitor import training_resilience_events
+    from deepspeed_tpu.platform.mesh import build_mesh
+    from deepspeed_tpu.resilience import FaultPlan, armed
+    from deepspeed_tpu.runtime.dataloader import (
+        DeepSpeedTPUDataLoader,
+        RepeatingLoader,
+    )
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    if plan_arg == "default":
+        committed = os.path.join(root, "TRAINCHAOS.json")
+        raw = (json.load(open(committed)) if os.path.exists(committed)
+               else _default_train_chaos_plan())
+    else:
+        raw = json.load(open(plan_arg))
+    plan = FaultPlan.from_dict(raw)
+    budget = {**_default_train_chaos_plan()["budget"], **plan.budget}
+    wk = {**_default_train_chaos_plan()["workload"],
+          **raw.get("workload", {})}
+    world, total_steps = int(wk["world"]), int(wk["total_steps"])
+
+    mcfg = T.TransformerConfig(
+        vocab_size=128, n_layers=2, n_heads=4, d_model=64, max_seq=32,
+        variant="llama", use_flash=False)
+    elastic_block = {
+        "enabled": True, "max_train_batch_size": 16,
+        "micro_batch_sizes": [2, 4], "min_gpus": 1, "max_gpus": 16,
+    }
+
+    def make_engine(w):
+        mesh = build_mesh({"data": w}, devices=jax.devices()[:w])
+        return ds.initialize(
+            {"optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+             "elasticity": dict(elastic_block),
+             "zero_optimization": {"stage": 1},
+             "seed": 7, "steps_per_print": 10**9},
+            loss_fn=T.make_loss_fn(mcfg),
+            param_init_fn=lambda k: T.init(mcfg, k),
+            param_logical_specs=T.logical_specs(mcfg),
+            mesh=mesh)
+
+    class _Toy:
+        def __init__(self, n=64):
+            r = np.random.default_rng(5)
+            self.items = [
+                {"tokens": r.integers(0, 128, (33,)).astype(np.int32)}
+                for _ in range(n)]
+
+        def __len__(self):
+            return len(self.items)
+
+        def __getitem__(self, i):
+            return self.items[i]
+
+    def make_loader():
+        return RepeatingLoader(DeepSpeedTPUDataLoader(
+            _Toy(), batch_size=16, shuffle=True, seed=11))
+
+    def run_lane(armed_plan):
+        tr = ElasticTrainer(
+            make_engine, world, make_loader(),
+            every_k_steps=int(wk["every_k_steps"]),
+            elastic_block=elastic_block)
+        if armed_plan is not None:
+            with armed(armed_plan):
+                tr.run(total_steps, regrow_at=wk.get("regrow_at"),
+                       regrow_to=wk.get("regrow_to"))
+        else:
+            tr.run(total_steps)
+        return tr
+
+    clean = run_lane(None)
+    chaos = run_lane(plan)
+
+    # the committed trajectories (post-rollback truncation)
+    steps = list(range(1, total_steps + 1))
+    exactly_once = (sorted(clean.history) == steps
+                    and sorted(chaos.history) == steps)
+    def ledger_bytes(tr):
+        return json.dumps([[s, tr.ledger[s][0], list(tr.ledger[s][1])]
+                           for s in sorted(tr.ledger)]).encode()
+
+    ledger_exact = ledger_bytes(clean) == ledger_bytes(chaos)
+    kill_steps = [int(f.where["step"]) for f in plan.faults
+                  if f.point == "engine.step" and f.kind == "raise"
+                  and "step" in f.where]
+    prefix_end = (min(kill_steps) - 1) if kill_steps else total_steps
+    prefix_exact = all(clean.history[s] == chaos.history[s]
+                       for s in range(1, prefix_end + 1))
+    rel = {s: abs(clean.history[s] - chaos.history[s])
+           / max(abs(clean.history[s]), 1e-12) for s in steps}
+    max_rel = max(rel.values()) if rel else 0.0
+    metrics = chaos.resilience_metrics()
+    has_straggler_fault = any(
+        f.point == "engine.step" and f.kind == "delay"
+        for f in plan.faults)
+
+    gates = {
+        "recovered_from_peer_shards": (
+            chaos.reconstructions >= 1 if kill_steps else True),
+        "zero_disk_restore": metrics["disk_restores"]
+        <= budget["max_disk_restores"],
+        "data_order_ledger_byte_exact": ledger_exact,
+        "exactly_once_sample_delivery": exactly_once,
+        "loss_prefix_bitwise_identical": prefix_exact,
+        "loss_trajectory_within_budget": max_rel
+        <= budget["max_loss_rel_diff"],
+        "rollback_within_mirror_cadence": chaos.last_rollback_steps
+        <= budget["max_rollback_steps"],
+        "reconstruction_within_budget": chaos.last_reconstruction_s
+        <= budget["max_reconstruction_s"],
+        "world_restored": chaos.world == world,
+    }
+    if has_straggler_fault:
+        gates["straggler_flagged"] = metrics["straggler_steps"] >= 1
+
+    out = {
+        "metric": "train_chaos_max_loss_drift",
+        "value": round(max_rel, 9),
+        "unit": "relative",
+        "vs_baseline": round(
+            max_rel / budget["max_loss_rel_diff"], 6),
+        "plan": {"name": plan.name, "faults": len(plan.faults),
+                 "fired": plan.fired, "budget": budget,
+                 "workload": wk},
+        "gates": gates,
+        "chaos": {
+            "generations": int(chaos.generation),
+            "final_world": int(chaos.world),
+            "reconstructions": int(chaos.reconstructions),
+            "reconstruction_ms": round(
+                chaos.last_reconstruction_s * 1e3, 1),
+            "rollback_steps": int(chaos.last_rollback_steps),
+            "mirrors_taken": int(metrics["mirrors_taken"]),
+            "bytes_mirrored": int(metrics["bytes_mirrored"]),
+            "disk_restores": int(metrics["disk_restores"]),
+            "straggler_steps": int(metrics["straggler_steps"]),
+            "monitor_events": len(
+                training_resilience_events(chaos, total_steps)),
+        },
+        "loss": {
+            "clean_final": round(clean.history[total_steps], 6),
+            "chaos_final": round(chaos.history[total_steps], 6),
+            "per_step_rel_diff_max": round(max_rel, 9),
+        },
+        "platform": jax.default_backend(),
+    }
+    print(json.dumps(out))
+    return 0 if all(gates.values()) else 1
+
+
 def main():
     # backend init can HANG (not fail) when the accelerator runtime or
     # its tunnel is wedged; a bench that never returns is worse than an
@@ -1322,6 +1566,12 @@ def _serving_7b_bench(on_tpu: bool):
 if __name__ == "__main__":
     if "--prefix-microbench" in sys.argv[1:]:
         sys.exit(_prefix_cache_microbench())
+    if "--train-chaos" in sys.argv[1:]:
+        argv = sys.argv[1:]
+        i = argv.index("--train-chaos")
+        plan = (argv[i + 1] if i + 1 < len(argv)
+                and not argv[i + 1].startswith("-") else "default")
+        sys.exit(_train_chaos(plan))
     if "--serving-sim" in sys.argv[1:]:
         argv = sys.argv[1:]
         n = int(argv[argv.index("--replicas") + 1]) \

@@ -1,6 +1,7 @@
 """Communication facade (ref: deepspeed/comm — see comm.py module docs)."""
 
 from .comm import (
+    CollectiveTimeoutError,
     all_gather,
     all_reduce,
     all_to_all,
@@ -8,6 +9,7 @@ from .comm import (
     barrier,
     broadcast,
     broadcast_host,
+    collective_timeout_from_env,
     get_local_device_count,
     get_process_count,
     get_rank,

@@ -12,17 +12,110 @@ layer carries over nearly unchanged (see logger.py).
 """
 
 import os
-from typing import Optional, Sequence, Union
+import threading
+import time
+from typing import Callable, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..resilience.faults import fault_point
 from ..utils.logging import logger
 from .logger import comms_logger
 
 _initialized = False
+
+
+class CollectiveTimeoutError(RuntimeError):
+    """A host-side collective did not complete within the deadline —
+    on TPU this means a dead or wedged peer (XLA collectives have no
+    timeout of their own; a survivor would otherwise block forever).
+    Carries the op name and replica group so the elastic supervisor
+    can report WHICH rendezvous hung."""
+
+    def __init__(self, op: str, replica_group: str, timeout_s: float):
+        self.op = op
+        self.replica_group = replica_group
+        self.timeout_s = timeout_s
+        super().__init__(
+            f"collective '{op}' over {replica_group} did not complete "
+            f"within {timeout_s:.1f}s (dead or wedged peer)"
+        )
+
+
+def collective_timeout_from_env(default: float = 0.0) -> float:
+    """DS_COMM_TIMEOUT_S: deadline for host-side control-plane
+    collectives (0 = no deadline; the elastic agent's heartbeat layer
+    is then the only hang detector)."""
+    try:
+        return float(os.environ.get("DS_COMM_TIMEOUT_S", default))
+    except ValueError:
+        return default
+
+
+def _guarded_collective(op: str, fn: Callable, replica_group: str,
+                        timeout_s: Optional[float] = None,
+                        retries: int = 2,
+                        backoff_s: float = 0.05):
+    """Run one host-side collective under a deadline + bounded retry.
+
+    Transient failures (an OSError from the coordination service, an
+    injected 'io' fault) retry with exponential backoff — metadata
+    broadcasts and barriers are idempotent, so a retry re-enters the
+    same rendezvous. A DEADLINE overrun is different: the peer is dead
+    or wedged, re-entering would hang again, so it surfaces immediately
+    as a typed CollectiveTimeoutError for the supervisor
+    (elasticity/agent.py) to act on. The watcher thread cannot cancel a
+    truly hung XLA call — it is abandoned daemonized, exactly the
+    tradeoff run_elastic's teardown already assumes.
+
+    Chaos fault point 'comm.collective' (ctx: op, group): kind='raise'
+    error='io' = transient (heals within `retries`); kind='delay' with
+    value >= the deadline = a deterministic timeout verdict WITHOUT a
+    real hang (tests stay fast), value < deadline = a slow-but-alive
+    peer (charged as wall time)."""
+    if timeout_s is None:
+        timeout_s = collective_timeout_from_env()
+    for attempt in range(retries + 1):
+        try:
+            act = fault_point("comm.collective", op=op, group=replica_group)
+            if act is not None and act.kind == "delay":
+                if timeout_s and act.value >= timeout_s:
+                    raise CollectiveTimeoutError(op, replica_group,
+                                                 timeout_s)
+                time.sleep(act.value)
+            if not timeout_s:
+                return fn()
+            result: dict = {}
+
+            def run():
+                try:
+                    result["value"] = fn()
+                except BaseException as e:  # surfaced on the caller thread
+                    result["error"] = e
+
+            t = threading.Thread(target=run, daemon=True,
+                                 name=f"ds-comm-{op}")
+            t.start()
+            t.join(timeout_s)
+            if t.is_alive():
+                raise CollectiveTimeoutError(op, replica_group, timeout_s)
+            if "error" in result:
+                raise result["error"]
+            return result.get("value")
+        except CollectiveTimeoutError:
+            raise
+        except OSError as e:
+            if attempt == retries:
+                raise
+            delay = backoff_s * (2 ** attempt)
+            logger.warning(
+                f"collective '{op}' over {replica_group} hit transient "
+                f"error ({e!r}); retry {attempt + 1}/{retries} in "
+                f"{delay:.2f}s")
+            time.sleep(delay)
 
 
 def init_distributed(
@@ -98,22 +191,40 @@ def get_local_device_count() -> int:
     return jax.local_device_count()
 
 
-def barrier(name: str = "barrier") -> None:
-    """Cross-host sync (ref: comm.py barrier)."""
-    if jax.process_count() > 1:
+def barrier(name: str = "barrier", timeout_s: Optional[float] = None,
+            retries: int = 2) -> None:
+    """Cross-host sync (ref: comm.py barrier), guarded: a dead peer
+    surfaces as CollectiveTimeoutError (when DS_COMM_TIMEOUT_S or
+    `timeout_s` sets a deadline) instead of hanging this controller
+    forever. The fault point fires on every world size so chaos lanes
+    exercise the guard even single-process."""
+
+    def do():
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(name)
+
+    _guarded_collective(f"barrier[{name}]", do, replica_group="world",
+                        timeout_s=timeout_s, retries=retries)
+
+
+def broadcast_host(value, src: int = 0, timeout_s: Optional[float] = None,
+                   retries: int = 2):
+    """Host-side metadata broadcast (ref: comm.py broadcast for small CPU
+    tensors), guarded like `barrier`. Single-host: identity."""
+
+    def do():
+        if jax.process_count() == 1:
+            return value
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(name)
+        return multihost_utils.broadcast_one_to_all(
+            value, is_source=get_rank() == src)
 
-
-def broadcast_host(value, src: int = 0):
-    """Host-side metadata broadcast (ref: comm.py broadcast for small CPU
-    tensors). Single-host: identity."""
-    if jax.process_count() == 1:
-        return value
-    from jax.experimental import multihost_utils
-
-    return multihost_utils.broadcast_one_to_all(value, is_source=get_rank() == src)
+    return _guarded_collective("broadcast_host", do,
+                               replica_group=f"world(src={src})",
+                               timeout_s=timeout_s, retries=retries)
 
 
 # ---------------------------------------------------------------------------

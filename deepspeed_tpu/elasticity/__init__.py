@@ -11,3 +11,4 @@ from .elasticity import (
     ElasticityIncompatibleWorldSize,
     compute_elastic_config,
 )
+from .trainer import ElasticTrainer

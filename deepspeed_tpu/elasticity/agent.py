@@ -227,6 +227,10 @@ def _launch_generation(
     exits and heartbeat staleness (launch_local only catches death; a
     hung-but-alive rank needs the heartbeat). Returns (rc, reason) with
     reason in {'ok', 'exit', 'heartbeat', 'timeout', 'startup'}."""
+    # chaos fault point: a raise here models the RELAUNCH itself failing
+    # (rendezvous host gone, quota refused) — the supervisor must count
+    # the burned generation and keep shrinking, not wedge
+    fault_point("elastic.launch", generation=generation, world=num_procs)
     port = str(_free_port())
     procs: List[subprocess.Popen] = []
     threads: List[threading.Thread] = []
@@ -368,13 +372,21 @@ def run_elastic(
                 os.remove(_hb_path(heartbeat_dir, r))
             except OSError:
                 pass
-        rc, reason = _launch_generation(
-            cmd, world, generation, heartbeat_dir,
-            hb_timeout_s=heartbeat_timeout_s,
-            first_beat_timeout_s=first_beat_timeout_s,
-            devices_per_proc=devices_per_proc, env_extra=extra,
-            timeout_s=generation_timeout_s,
-        )
+        try:
+            rc, reason = _launch_generation(
+                cmd, world, generation, heartbeat_dir,
+                hb_timeout_s=heartbeat_timeout_s,
+                first_beat_timeout_s=first_beat_timeout_s,
+                devices_per_proc=devices_per_proc, env_extra=extra,
+                timeout_s=generation_timeout_s,
+            )
+        except OSError as e:
+            # the relaunch itself failed (spawn error, injected
+            # elastic.launch fault): a burned generation, not a wedge —
+            # fall through to the shrink-and-retry arm
+            print(f"[elastic-agent g{generation}] launch failed: {e!r}",
+                  file=sys.stderr)
+            rc, reason = 1, "launch"
         if rc == 0:
             return 0
         if generation == max_restarts:

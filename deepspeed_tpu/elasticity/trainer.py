@@ -1,0 +1,352 @@
+"""Preemption-tolerant elastic training loop: peer-redundant shards +
+checkpoint-free resharding (docs/elasticity.md, docs/fault_tolerance.md).
+
+`run_elastic` (agent.py) already restarts a world that lost a host —
+but its workers resume from the last committed DISK checkpoint, paying
+a full restore plus every step since the last save. This module is the
+Bamboo/Gemini upgrade for the in-process half of that journey: the
+trainer mirrors each rank's ZeRO shard slice to a neighbor every K
+steps (resilience/redundancy.py), and when a preemption kills <= R
+ranks it
+
+  1. reconstructs the lost shards from surviving peers (host memory,
+     no disk),
+  2. rolls the world back to the last mirror boundary (<= K-1 steps),
+  3. rebuilds the engine at an elastic-compatible surviving world size
+     and lays the assembled state onto the new mesh
+     (`reshard_state(old_mesh -> new_mesh)`),
+  4. restores the dataloader position carried in the same snapshot, so
+     the replay consumes exactly the samples the dead world would have
+     — the committed (step -> sample ids) ledger is byte-identical to
+     an uninterrupted run (no loss, no duplication).
+
+`resize()` is the regrow half: when preempted capacity returns, the
+live state reshards onto the bigger mesh with no rollback at all.
+Model RNG needs no carrying — the engine derives every step's stream
+from fold_in(seed, step).
+
+The same trainer drives the deterministic training chaos lane
+(`bench.py --train-chaos`, gated by scripts/ds_elastic.py): a FaultPlan
+preempts a rank mid-run via the 'engine.step' fault point and the gate
+asserts peer recovery with zero disk restores and a loss trajectory
+matching the uninterrupted run.
+"""
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..resilience.faults import (
+    InjectedIOError,
+    RankPreemptedError,
+    fault_point,
+)
+from ..resilience.redundancy import (
+    PeerRedundantStore,
+    UnrecoverableWorldError,
+    assemble_tree,
+    export_rank_payloads,
+    reshard_state,
+)
+from ..utils.logging import log_dist
+from .agent import WorldDegradedError
+from .elasticity import compute_elastic_config
+
+__all__ = ["ElasticTrainer"]
+
+
+class ElasticTrainer:
+    """Drive a DeepSpeedTPUEngine through preemptions without disk.
+
+    make_engine(world) must return a FRESH engine whose data-parallel
+    world equals `world` (an elastic-batch config re-derives the same
+    global batch at every compatible size, so the trajectory is
+    comparable across resizes). `loader` needs the stateful-loader
+    contract (runtime/dataloader.py): iteration, state_dict /
+    load_state_dict, and last_batch_indices for the exactly-once
+    ledger.
+
+    elastic_block: the config's "elasticity" dict — consulted on
+    shrink so the trainer lands on a world size every worker would
+    accept instead of burning a generation discovering it.
+    """
+
+    def __init__(
+        self,
+        make_engine: Callable[[int], Any],
+        world: int,
+        loader,
+        every_k_steps: int = 1,
+        spare: int = 1,
+        min_world: int = 1,
+        elastic_block: Optional[Dict[str, Any]] = None,
+        checkpoint_dir: Optional[str] = None,
+        straggler_factor: float = 3.0,
+        clock=time.perf_counter,
+    ):
+        self.make_engine = make_engine
+        self.loader = loader
+        self.every_k = int(every_k_steps)
+        self.spare = int(spare)
+        self.min_world = int(min_world)
+        self.elastic_block = elastic_block
+        self.checkpoint_dir = checkpoint_dir
+        self.straggler_factor = float(straggler_factor)
+        self.clock = clock
+
+        self.world = int(world)
+        self.generation = 0
+        self.engine = self._launch(self.world)
+        self.store = PeerRedundantStore(
+            self.world, spare=min(self.spare, self.world - 1))
+
+        # committed trajectory: step -> loss / (epoch, sample ids).
+        # A rollback TRUNCATES these — what remains is exactly the
+        # trajectory an uninterrupted run commits.
+        self.history: Dict[int, float] = {}
+        self.ledger: Dict[int, Tuple[int, Tuple[int, ...]]] = {}
+
+        self.reconstructions = 0
+        self.disk_restores = 0
+        self.last_rollback_steps = 0
+        self.last_reconstruction_s = 0.0
+        self.straggler_steps = 0
+        self.straggler_ranks: Dict[int, int] = {}
+        self._step_times: List[float] = []
+        self._compile_steps = 1  # steps to exempt from straggler stats
+        self._data_iter = iter(loader)
+
+        self.mirror()  # step-0 snapshot: recoverable from the first step
+
+    # -- generation machinery -------------------------------------------
+    def _launch(self, world: int):
+        fault_point("elastic.generation", generation=self.generation,
+                    world=world)
+        engine = self.make_engine(world)
+        if int(engine.dp_world_size) != world:
+            raise ValueError(
+                f"make_engine({world}) built a dp world of "
+                f"{engine.dp_world_size}")
+        return engine
+
+    def mirror(self) -> None:
+        """One redundancy round: slice the live state per rank, mirror
+        to neighbors, and carry the dataloader position + slice dims so
+        a recovery is self-describing (the dead engine's spec objects
+        are not needed to reassemble)."""
+        payloads, dims = export_rank_payloads(self.engine)
+        shared = {"loader": self.loader.state_dict(), "dims": dims}
+        self.store.snapshot(self.engine.global_steps, payloads, shared)
+        from .. import comm
+
+        # mirrors must be exchanged before the next step may commit —
+        # rides the guarded control-plane barrier (comm.collective
+        # fault point; single-process worlds no-op)
+        comm.barrier("post-mirror")
+
+    def _compatible_world(self, after_loss: int) -> int:
+        """Largest elastic-compatible world <= after_loss (>= min_world)."""
+        valid = None
+        if self.elastic_block is not None:
+            _, valid = compute_elastic_config(
+                {"elasticity": self.elastic_block})
+        w = after_loss
+        while w >= self.min_world:
+            if valid is None or w in valid:
+                return w
+            w -= 1
+        raise UnrecoverableWorldError(
+            [f"no elastic-compatible world in [{self.min_world}, "
+             f"{after_loss}]"])
+
+    def recover(self, lost_ranks: List[int]) -> None:
+        """The preemption path: lose the ranks, reconstruct their
+        shards from peers, reshard onto the surviving world, rewind the
+        loader — all in host memory. Falls back to the newest verified
+        disk checkpoint ONLY when more ranks died than the redundancy
+        degree covers (counted in disk_restores; the chaos gate asserts
+        the counter stays 0)."""
+        t0 = self.clock()
+        before = self.engine.global_steps
+        self.store.lose(lost_ranks)
+        new_world = self._compatible_world(self.world - len(set(lost_ranks)))
+        try:
+            step, payloads, shared = self.store.reconstruct()
+        except UnrecoverableWorldError:
+            if self.checkpoint_dir is None:
+                raise
+            self._disk_fallback(new_world)
+            return
+        dims = shared["dims"]
+        full = {k: assemble_tree({r: payloads[r][k] for r in payloads},
+                                 dims[k])
+                for k in dims}
+        self.generation += 1
+        self.world = new_world
+        self.engine = self._launch(new_world)
+        self._compile_steps = 1
+        reshard_state(self.engine, full, global_steps=step)
+        self.loader.load_state_dict(shared["loader"])
+        self._data_iter = iter(self.loader)
+        # truncate the committed trajectory to the mirror boundary —
+        # the replayed steps recommit with identical sample order
+        self.history = {s: v for s, v in self.history.items() if s <= step}
+        self.ledger = {s: v for s, v in self.ledger.items() if s <= step}
+        self.store = PeerRedundantStore(new_world, spare=min(
+            self.spare, new_world - 1))
+        self.mirror()
+        self.reconstructions += 1
+        self.last_rollback_steps = before - step
+        self.last_reconstruction_s = self.clock() - t0
+        log_dist(
+            f"elastic-trainer: ranks {sorted(set(lost_ranks))} preempted "
+            f"at step {before}; peer-reconstructed step {step} onto "
+            f"world {new_world} (generation {self.generation}) in "
+            f"{self.last_reconstruction_s * 1e3:.1f}ms, no disk restore",
+            ranks=[0])
+
+    def _disk_fallback(self, new_world: int) -> None:
+        """Too many ranks died: the classic resume (load the newest
+        verified tag) — the expensive path peer redundancy avoids."""
+        self.generation += 1
+        self.world = new_world
+        self.engine = self._launch(new_world)
+        self._compile_steps = 1
+        self.engine.load_checkpoint(self.checkpoint_dir)
+        self.disk_restores += 1
+        self.engine.disk_restores = 0  # counted above; the metrics sum both
+        step = self.engine.global_steps
+        self.history = {s: v for s, v in self.history.items() if s <= step}
+        self.ledger = {s: v for s, v in self.ledger.items() if s <= step}
+        self.store = PeerRedundantStore(new_world, spare=min(
+            self.spare, new_world - 1))
+        self.mirror()
+
+    def resize(self, new_world: int) -> None:
+        """Live reshard (regrow when capacity returns, or a graceful
+        shrink ahead of a planned preemption): current state, no
+        rollback, no disk."""
+        import jax
+
+        if new_world == self.world:
+            return
+        host = {"params": jax.device_get(self.engine.state.params)}
+        if self.engine.state.master is not None:
+            host["master"] = jax.device_get(self.engine.state.master)
+        if self.engine.state.opt is not None:
+            host["opt"] = jax.device_get(self.engine.state.opt)
+        step = self.engine.global_steps
+        self.generation += 1
+        self.world = int(new_world)
+        self.engine = self._launch(self.world)
+        self._compile_steps = 1
+        reshard_state(self.engine, host, global_steps=step)
+        self.store = PeerRedundantStore(self.world, spare=min(
+            self.spare, self.world - 1))
+        self.mirror()
+        log_dist(
+            f"elastic-trainer: resharded step {step} onto world "
+            f"{self.world} (generation {self.generation})", ranks=[0])
+
+    # -- the step loop ---------------------------------------------------
+    def _fetch_batch(self, retries: int = 2):
+        """Next batch with bounded retry on transient I/O (the
+        dataloader.fetch fault point raises BEFORE the loader position
+        advances, so a retry re-fetches the same batch)."""
+        for attempt in range(retries + 1):
+            try:
+                batch = next(self._data_iter)
+                return batch, (self.loader.last_batch_epoch,
+                               tuple(self.loader.last_batch_indices))
+            except (InjectedIOError, OSError):
+                if attempt == retries:
+                    raise
+                # the raise closed the generator; re-enter at the (still
+                # unadvanced) persisted position
+                self._data_iter = iter(self.loader)
+        raise AssertionError("unreachable")
+
+    def step(self) -> Optional[Dict[str, float]]:
+        """One committed global step, or None when a preemption was
+        absorbed (recover() rolled back; the caller just keeps
+        stepping)."""
+        batch, sample_meta = self._fetch_batch()
+        t0 = self.clock()
+        try:
+            metrics = self.engine.train_batch(batch)
+        except RankPreemptedError as e:
+            spec = getattr(e, "spec", None)
+            lost = int(spec.value) if spec is not None else 0
+            self.recover([lost])
+            return None
+        except WorldDegradedError as e:
+            self.recover(list(e.failed_ranks))
+            return None
+        wall = (self.clock() - t0) + self.engine.drain_fault_delay()
+        self._note_step_time(wall)
+        step_no = self.engine.global_steps
+        self.history[step_no] = float(metrics["loss"])
+        self.ledger[step_no] = sample_meta
+        if step_no % self.every_k == 0:
+            self.mirror()
+        return metrics
+
+    def run(self, total_steps: int, regrow_at: Optional[int] = None,
+            regrow_to: Optional[int] = None) -> Dict[int, float]:
+        """Step until `total_steps` are committed. regrow_at/regrow_to
+        model preempted capacity returning at a known step (the chaos
+        lane's world-restore half)."""
+        while self.engine.global_steps < total_steps:
+            if (regrow_at is not None
+                    and self.engine.global_steps >= regrow_at
+                    and self.world < (regrow_to or self.world)):
+                self.resize(regrow_to)
+            self.step()
+        return dict(self.history)
+
+    # -- observability ---------------------------------------------------
+    def _note_step_time(self, wall: float) -> None:
+        """Straggler detection on THIS controller's step time (each
+        controller of a multi-host world flags its own rank; the
+        monitor aggregates the fleet view). The first step after every
+        generation launch pays a compile — exempt, not a straggler."""
+        import jax
+        import numpy as np
+
+        if self._compile_steps > 0:
+            self._compile_steps -= 1
+            return
+        self._step_times.append(wall)
+        prior = self._step_times[:-1]
+        if len(prior) >= 3 and wall > self.straggler_factor * float(
+                np.median(prior)):
+            self.straggler_steps += 1
+            rank = int(jax.process_index())
+            self.straggler_ranks[rank] = self.straggler_ranks.get(rank, 0) + 1
+
+    def resilience_metrics(self) -> Dict[str, float]:
+        """Flat float metrics for the monitor feed
+        (monitor.training_resilience_events)."""
+        import numpy as np
+
+        st = self._step_times
+        out = {
+            "generation": float(self.generation),
+            "world": float(self.world),
+            "redundancy_staleness_steps": float(
+                self.store.staleness(self.engine.global_steps)),
+            "mirrors_taken": float(self.store.mirrors_taken),
+            "bytes_mirrored": float(self.store.bytes_mirrored),
+            "reconstructions": float(self.reconstructions),
+            "last_reconstruction_ms": round(
+                self.last_reconstruction_s * 1e3, 3),
+            "last_rollback_steps": float(self.last_rollback_steps),
+            "disk_restores": float(
+                self.disk_restores + self.engine.disk_restores),
+            "straggler_steps": float(self.straggler_steps),
+            "step_time_p50_ms": round(
+                float(np.median(st)) * 1e3, 3) if st else 0.0,
+            "step_time_max_ms": round(max(st) * 1e3, 3) if st else 0.0,
+        }
+        for r, n in sorted(self.straggler_ranks.items()):
+            out[f"rank{r}/straggler_flags"] = float(n)
+        return out

@@ -136,6 +136,25 @@ def serving_events(scheduler, step: int,
             for name, value in sorted(metrics.items())]
 
 
+def training_resilience_events(trainer, step: int,
+                               prefix: str = "train/resilience") -> List[Event]:
+    """Turn an ElasticTrainer's resilience counters
+    (elasticity/trainer.py resilience_metrics) into monitor events —
+    same contract as serving_events:
+
+        monitor.write_events(training_resilience_events(trainer, step))
+
+    Emits the elastic generation id and world size, redundancy
+    staleness (steps since the last peer mirror — the work a recovery
+    right now would replay), mirror/reconstruction counters and the
+    last reconstruction/rollback cost, disk_restores (0 while peer
+    recovery holds), and per-rank step-time straggler flags
+    (`rank<i>/straggler_flags`) with step-time percentiles."""
+    metrics = trainer.resilience_metrics()
+    return [(f"{prefix}/{name}", float(value), step)
+            for name, value in sorted(metrics.items())]
+
+
 class MonitorMaster(Monitor):
     """Fan-out to all configured sinks (ref: monitor/monitor.py:29)."""
 

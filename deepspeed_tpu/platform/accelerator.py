@@ -257,6 +257,91 @@ def bench_device_guard(metric: str, timeout_default: float = 300.0):
     return 0 if timed else 1
 
 
+_MP_PROBE_SRC = """
+import os, sys
+import jax
+jax.config.update("jax_platforms", os.environ.get("DS_MP_PROBE_PLATFORM", "cpu"))
+jax.distributed.initialize(
+    coordinator_address=os.environ["DS_MP_PROBE_ADDR"],
+    num_processes=2, process_id=int(sys.argv[1]),
+    initialization_timeout=30)
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+import numpy as np
+mesh = Mesh(np.array(jax.devices()), ("data",))
+x = jax.device_put(jnp.zeros((2,), jnp.float32),
+                   NamedSharding(mesh, P("data")))
+with mesh:
+    y = jax.jit(lambda v: v + 1)(x)  # the multiprocess jit the e2e lane needs
+jax.block_until_ready(y)
+print("MP-PROBE-OK", flush=True)
+"""
+
+
+def probe_multiprocess_backend(timeout_s: float = 120.0):
+    """Can THIS backend run a 2-OS-process sharded jit? -> (ok, reason).
+
+    The elastic-agent e2e lane (tests/test_elastic_agent.py) needs
+    real multi-controller worlds, which some backends cannot serve —
+    the container jax 0.4.37 CPU backend fails engine init with
+    'Multiprocess computations aren't implemented on the CPU backend'
+    (a known infra limit, NOT a code regression; see the memory note
+    in the repo's history). This probe spawns the minimal 2-process
+    world once and caches the verdict so the lane reports
+    skipped(infra) with the backend's own error instead of a red test
+    somebody re-bisects. Cached per process (the capability cannot
+    change mid-run)."""
+    return _probe_multiprocess_cached(float(timeout_s))
+
+
+@functools.lru_cache(maxsize=None)
+def _probe_multiprocess_cached(timeout_s: float):
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env["DS_MP_PROBE_ADDR"] = f"127.0.0.1:{port}"
+    env.setdefault("DS_MP_PROBE_PLATFORM", "cpu")
+    env["XLA_FLAGS"] = ""  # one device per proc; no forced host devices
+    procs = [
+        subprocess.Popen([sys.executable, "-c", _MP_PROBE_SRC, str(rank)],
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+        for rank in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=timeout_s)
+                outs.append(out or "")
+            except subprocess.TimeoutExpired:
+                p.kill()
+                outs.append("probe timeout")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    if all(p.returncode == 0 for p in procs) and all(
+            "MP-PROBE-OK" in o for o in outs):
+        return True, "multiprocess sharded jit ok"
+    # surface the backend's own words (the INVALID_ARGUMENT line when
+    # present) so the skip reason names the limit, not a guess
+    detail = ""
+    for o in outs:
+        for line in o.splitlines():
+            if "Error" in line or "error" in line or "timeout" in line:
+                detail = line.strip()
+        if detail:
+            break
+    return False, (detail or "multiprocess probe failed "
+                   f"(rcs {[p.returncode for p in procs]})")
+
+
 def probe_devices(timeout: float):
     """Device discovery under a watchdog thread:
     (devices | None, error_message | None, timed_out).

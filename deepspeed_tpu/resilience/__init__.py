@@ -2,8 +2,11 @@
 
 `faults` is the deterministic chaos harness (FaultPlan, fault_point,
 arm/disarm); `health` is the per-replica circuit breaker the serving
-router's auto-failover runs on. Training-side failure detection lives
-in elasticity/agent.py (heartbeats); crash-consistent checkpointing in
+router's auto-failover runs on; `redundancy` is the Gemini-style
+peer-redundant ZeRO shard store behind checkpoint-free elastic
+training (elasticity/trainer.py consumes it; the ds_elastic chaos
+gate proves it). Training-side failure detection lives in
+elasticity/agent.py (heartbeats); crash-consistent checkpointing in
 runtime/checkpoint.py (commit markers + verified-tag fallback) — both
 carry fault points from here."""
 
@@ -15,6 +18,7 @@ from .faults import (
     HandoffError,
     InjectedFault,
     InjectedIOError,
+    RankPreemptedError,
     ReplicaDeadError,
     active_plan,
     arm,
@@ -32,12 +36,20 @@ from .health import (
     FleetHealth,
     ReplicaBreaker,
 )
+from .redundancy import (
+    PeerRedundantStore,
+    RedundancyError,
+    UnrecoverableWorldError,
+    reshard_state,
+)
 
 __all__ = [
     "FaultPlan", "FaultSpec", "FaultAction", "fault_point", "arm",
     "disarm", "armed", "active_plan", "corrupt_file",
     "InjectedFault", "ReplicaDeadError", "HandoffError",
-    "InjectedIOError", "CheckpointCrashError",
+    "InjectedIOError", "CheckpointCrashError", "RankPreemptedError",
     "BreakerConfig", "ReplicaBreaker", "FleetHealth",
     "CLOSED", "OPEN", "HALF_OPEN", "HELD",
+    "PeerRedundantStore", "RedundancyError", "UnrecoverableWorldError",
+    "reshard_state",
 ]

@@ -33,6 +33,37 @@ Fault points registered across the tree (ctx keys in parens):
                                   ``scheduler.fault_delay_s``; virtual-
                                   clock drivers charge it, real drivers
                                   sleep it)
+  engine.step         (rank,      training-step dispatch
+                       step)      (runtime/engine.py _dispatch_step
+                                  entry, BEFORE any state mutates) —
+                                  raise error='preempted' = this rank's
+                                  host is gone mid-run (the elastic
+                                  trainer reconstructs from peer-
+                                  redundant shards); delay = training
+                                  straggler (accrues to
+                                  ``engine.fault_delay_s``)
+  comm.collective     (op,        host-side control-plane collective
+                       group)     (comm/comm.py barrier /
+                                  broadcast_host, inside the
+                                  timeout+retry guard) — raise error=
+                                  'io' = transient failure (bounded
+                                  retry heals it); delay >= the guard
+                                  timeout = deterministic
+                                  CollectiveTimeoutError without a
+                                  real hang
+  dataloader.fetch    (epoch,     batch fetch (runtime/dataloader.py,
+                       index)     BEFORE the loader position advances
+                                  so a retry re-fetches the same
+                                  batch) — raise error='io' =
+                                  transient storage failure
+  elastic.launch      (generation,  supervisor generation launch
+                       world)     (elasticity/agent.py
+                                  _launch_generation) — raise = the
+                                  relaunch itself fails (burned
+                                  generation)
+  elastic.generation  (generation,  in-process generation bump
+                       world)     (elasticity/trainer.py engine
+                                  rebuild on shrink/regrow)
   engine.export_kv    (uid)       KV handoff export (raise/delay)
   engine.import_kv    (uid)       KV handoff import (raise/delay)
   router.probe        (replica)   health-monitor half-open probe
@@ -60,7 +91,7 @@ __all__ = [
     "FaultPlan", "FaultSpec", "FaultAction", "fault_point", "arm",
     "disarm", "armed", "active_plan", "corrupt_file",
     "InjectedFault", "ReplicaDeadError", "HandoffError",
-    "InjectedIOError", "CheckpointCrashError",
+    "InjectedIOError", "CheckpointCrashError", "RankPreemptedError",
 ]
 
 
@@ -84,11 +115,18 @@ class CheckpointCrashError(InjectedFault):
     """Process crash inside the checkpoint commit window."""
 
 
+class RankPreemptedError(InjectedFault):
+    """A training rank's host was preempted mid-run (the VM is gone;
+    its HBM-resident shards with it). The spec's `value` names the
+    preempted logical rank — read it off the raised error's `.spec`."""
+
+
 _ERRORS = {
     "replica_dead": ReplicaDeadError,
     "handoff": HandoffError,
     "io": InjectedIOError,
     "ckpt_crash": CheckpointCrashError,
+    "preempted": RankPreemptedError,
     "generic": InjectedFault,
 }
 
@@ -215,9 +253,13 @@ class FaultPlan:
             if not due:
                 continue
             if spec.kind == "raise":
-                raise _ERRORS[spec.error](
+                err = _ERRORS[spec.error](
                     f"injected {spec.error} at {point} "
                     f"(matching invocation {n}, plan '{self.name}')")
+                # recovery code keys off the spec (e.g. value = the
+                # preempted rank for error='preempted')
+                err.spec = spec
+                raise err
             act = FaultAction(spec.kind, spec.value, spec)
         return act
 

@@ -1,0 +1,321 @@
+"""Peer-redundant ZeRO shards: in-memory checkpoints that turn a
+preemption into a seconds-scale reshard instead of a minutes-scale disk
+restore (docs/fault_tolerance.md training section).
+
+The Gemini (Wang et al., SOSP'23) / Bamboo (Thorpe et al., NSDI'23)
+observation: under ZeRO the optimizer state is already partitioned one
+shard per rank, so every rank can mirror its shard to a neighbor's host
+DRAM every K steps at a cost that is tiny next to the step itself. When
+a world of W loses up to `spare` ranks, the lost shards still exist on
+surviving peers: reconstruction is a host-side concatenation, and
+`reshard_state` lays the assembled arrays onto whatever mesh the
+surviving world builds — NO disk checkpoint is read. Recovery rolls the
+whole world back to the last mirror boundary (at most K-1 steps), and
+the dataloader/RNG state carried in the same snapshot makes the replay
+sample-exact (no loss, no duplication — elasticity/trainer.py owns the
+ledger).
+
+Storage model (honesty contract): `PeerRedundantStore` keeps one
+payload per (holder rank) — a rank's OWN slice plus the slices mirrored
+TO it by its `spare` predecessors-by-stride. `lose(ranks)` deletes
+everything those hosts held, exactly as a preemption would; a
+reconstruction may only consume what survives. The store itself is
+plain host numpy — it outlives the engine whose mesh died.
+
+Slicing contract: `runtime/zero.zero_sharded_dims` names, per leaf, the
+dim that carries the ZeRO axes (-1 = replicated). Rank r of a world of
+W owns [r*d/W, (r+1)*d/W) along that dim — the same partition XLA's
+SPMD sharding uses, so a payload is byte-identical to what rank r's HBM
+actually holds.
+"""
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "RedundancyError", "UnrecoverableWorldError", "PeerRedundantStore",
+    "slice_tree", "assemble_tree", "engine_shard_dims",
+    "export_rank_payloads", "reshard_state",
+]
+
+
+class RedundancyError(RuntimeError):
+    """Peer-redundancy protocol violation (bad world/slice geometry)."""
+
+
+class UnrecoverableWorldError(RedundancyError):
+    """More ranks died than the redundancy degree covers: some shard
+    exists on no surviving host. The caller falls back to the last
+    verified disk checkpoint (the path this module exists to avoid)."""
+
+    def __init__(self, missing_ranks):
+        self.missing_ranks = list(missing_ranks)
+        super().__init__(
+            f"shards of rank(s) {self.missing_ranks} survive on no live "
+            "host; peer reconstruction impossible — disk fallback required"
+        )
+
+
+# ---------------------------------------------------------------------------
+# slice/assemble: the shard <-> full-array geometry
+# ---------------------------------------------------------------------------
+
+def _slice_leaf(x: np.ndarray, dim: int, rank: int, world: int) -> np.ndarray:
+    """Rank r's ZeRO shard of one host leaf (a copy, so the store never
+    aliases live engine buffers)."""
+    if dim < 0:
+        return np.array(x)
+    d = x.shape[dim]
+    if d % world:
+        raise RedundancyError(
+            f"leaf dim {dim} of size {d} does not divide world {world}")
+    c = d // world
+    idx = [slice(None)] * x.ndim
+    idx[dim] = slice(rank * c, (rank + 1) * c)
+    return np.array(x[tuple(idx)])
+
+
+def slice_tree(tree, dims, rank: int, world: int):
+    """Per-leaf ZeRO slices owned by `rank` (dims from
+    zero.zero_sharded_dims; -1 leaves copy whole — replicated state is
+    resident on every rank)."""
+    import jax
+
+    return jax.tree.map(
+        lambda x, d: _slice_leaf(np.asarray(x), int(d), rank, world),
+        tree, dims)
+
+
+def assemble_tree(payloads: Dict[int, Any], dims):
+    """Inverse of slice_tree: full host arrays from a COMPLETE set of
+    rank payloads (0..world-1). Replicated leaves take rank 0's copy;
+    sharded leaves concatenate in rank order along the sharded dim."""
+    import jax
+
+    world = len(payloads)
+    if sorted(payloads) != list(range(world)):
+        raise RedundancyError(
+            f"assemble_tree needs payloads for ranks 0..{world - 1}, "
+            f"got {sorted(payloads)}")
+    leaves = {r: jax.tree.leaves(payloads[r]) for r in payloads}
+    dim_leaves = jax.tree.leaves(dims)
+    out = []
+    for i, d in enumerate(dim_leaves):
+        if int(d) < 0:
+            out.append(leaves[0][i])
+        else:
+            out.append(np.concatenate(
+                [leaves[r][i] for r in range(world)], axis=int(d)))
+    return jax.tree.unflatten(jax.tree.structure(dims), out)
+
+
+# ---------------------------------------------------------------------------
+# the store
+# ---------------------------------------------------------------------------
+
+class PeerRedundantStore:
+    """Per-rank shard snapshots + their neighbor mirrors, all at one
+    consistent step. `spare` is the redundancy degree R: each rank's
+    payload is mirrored to its next `spare` ranks by `stride`, so any
+    loss of <= R ranks (that doesn't wipe a rank AND all its holders)
+    reconstructs."""
+
+    def __init__(self, world: int, spare: int = 1, stride: int = 1):
+        if world < 1:
+            raise RedundancyError(f"world must be >= 1, got {world}")
+        if not (0 <= spare < world):
+            # spare=0 (forced at world 1: a lone rank has no peer) keeps
+            # snapshots local-only — consistent bookkeeping, but any
+            # loss is unrecoverable without the disk fallback
+            raise RedundancyError(
+                f"spare must be in [0, world-1], got {spare} for world "
+                f"{world}")
+        self.world = int(world)
+        self.spare = int(spare)
+        self.stride = int(stride)
+        self.step: Optional[int] = None
+        self.lost: set = set()
+        self._local: Dict[int, Any] = {}
+        # holder -> {owner: payload}: what each host keeps FOR its peers
+        self._mirror: Dict[int, Dict[int, Any]] = {}
+        # replicated snapshot metadata (loader state, slice dims), one
+        # copy per holder — any survivor can provide it
+        self._shared: Dict[int, Any] = {}
+        self.mirrors_taken = 0
+        self.bytes_mirrored = 0
+        self.reconstructions = 0
+        self.last_reconstruction_s = 0.0
+
+    def holders_of(self, owner: int) -> List[int]:
+        return [(owner + i * self.stride) % self.world
+                for i in range(1, self.spare + 1)]
+
+    def snapshot(self, step: int, payloads: Dict[int, Any],
+                 shared: Any = None) -> None:
+        """One consistent mirror round: every rank's slice at `step`,
+        plus its copies on the neighbor holders. Atomic by construction
+        — the previous round is replaced wholesale, never mixed."""
+        import jax
+
+        if sorted(payloads) != list(range(self.world)):
+            raise RedundancyError(
+                f"snapshot needs payloads for ranks 0..{self.world - 1}, "
+                f"got {sorted(payloads)}")
+        self._local = dict(payloads)
+        self._mirror = {r: {} for r in range(self.world)}
+        nbytes = 0
+        for owner, payload in payloads.items():
+            for holder in self.holders_of(owner):
+                self._mirror[holder][owner] = payload
+                nbytes += int(sum(x.nbytes
+                                  for x in jax.tree.leaves(payload)))
+        self._shared = {r: shared for r in range(self.world)}
+        self.step = int(step)
+        self.lost = set()
+        self.mirrors_taken += 1
+        self.bytes_mirrored += nbytes
+
+    def lose(self, ranks) -> None:
+        """A preemption: everything resident on these hosts is gone —
+        their own slice AND the mirrors they held for others."""
+        for f in ranks:
+            self.lost.add(int(f))
+            self._local.pop(int(f), None)
+            self._mirror[int(f)] = {}
+            self._shared.pop(int(f), None)
+
+    def recoverable(self) -> Tuple[bool, List[int]]:
+        """(ok, ranks whose slice survives nowhere)."""
+        missing = []
+        for r in range(self.world):
+            if r in self._local:
+                continue
+            if any(h not in self.lost and r in self._mirror.get(h, {})
+                   for h in self.holders_of(r)):
+                continue
+            missing.append(r)
+        return (not missing), missing
+
+    def reconstruct(self) -> Tuple[int, Dict[int, Any], Any]:
+        """(step, complete rank->payload map, shared metadata) assembled
+        from SURVIVING hosts only. Raises UnrecoverableWorldError when
+        a slice is gone everywhere."""
+        t0 = time.perf_counter()
+        ok, missing = self.recoverable()
+        if not ok:
+            raise UnrecoverableWorldError(missing)
+        if self.step is None:
+            raise RedundancyError("reconstruct before any snapshot")
+        payloads = {}
+        for r in range(self.world):
+            if r in self._local:
+                payloads[r] = self._local[r]
+            else:
+                holder = next(h for h in self.holders_of(r)
+                              if h not in self.lost
+                              and r in self._mirror.get(h, {}))
+                payloads[r] = self._mirror[holder][r]
+        shared = next(iter(self._shared.values())) if self._shared else None
+        self.reconstructions += 1
+        self.last_reconstruction_s = time.perf_counter() - t0
+        return self.step, payloads, shared
+
+    def staleness(self, current_step: int) -> int:
+        """Steps of work a recovery right now would replay (the
+        redundancy-staleness metric in the monitor feed)."""
+        if self.step is None:
+            return int(current_step)
+        return max(0, int(current_step) - self.step)
+
+
+# ---------------------------------------------------------------------------
+# engine glue: extract shard payloads / lay a full state onto a new mesh
+# ---------------------------------------------------------------------------
+
+def engine_shard_dims(engine) -> Dict[str, Any]:
+    """Per-leaf ZeRO-sharded dims for a fused-path engine's state trees
+    (params / master / opt), the slicing contract for its shards. The
+    worker-major 1-bit/0-1-Adam layouts and the host/NVMe offload tiers
+    hold state outside the fused TrainState — not covered here."""
+    import jax
+
+    from ..runtime import zero
+
+    if getattr(engine, "_offload", False) or getattr(engine, "_onebit", False) \
+            or getattr(engine, "_zoadam", False):
+        raise NotImplementedError(
+            "peer redundancy covers the fused ZeRO step; 1-bit/0-1-Adam "
+            "worker layouts and offload tiers keep state outside "
+            "TrainState")
+    shapes = jax.tree.map(lambda p: tuple(p.shape), engine.state.params)
+    leaf_dims = zero.zero_sharded_dims(
+        engine.opt_specs, engine.tp_specs, shapes, engine.mesh)
+    param_dims = zero.zero_sharded_dims(
+        engine.param_specs, engine.tp_specs, shapes, engine.mesh)
+    dims: Dict[str, Any] = {"params": param_dims}
+    if engine.state.master is not None:
+        dims["master"] = leaf_dims
+    if engine.state.opt is not None:
+        dims["opt"] = {k: leaf_dims for k in engine.state.opt}
+    return dims
+
+
+def export_rank_payloads(engine) -> Tuple[Dict[int, Any], Dict[str, Any]]:
+    """One host read of the live state, sliced into every logical
+    rank's payload: (rank -> {'params': ..., 'master': ..., 'opt': ...},
+    dims). The D2H read is the mirror protocol's whole cost — it runs
+    between steps, off the compiled path, every K steps."""
+    import jax
+
+    dims = engine_shard_dims(engine)
+    world = int(engine.dp_world_size)
+    host: Dict[str, Any] = {
+        "params": jax.device_get(engine.state.params)}
+    if "master" in dims:
+        host["master"] = jax.device_get(engine.state.master)
+    if "opt" in dims:
+        host["opt"] = jax.device_get(engine.state.opt)
+    payloads = {
+        r: {k: slice_tree(host[k], dims[k], r, world) for k in dims}
+        for r in range(world)
+    }
+    return payloads, dims
+
+
+def reshard_state(engine, full_state: Dict[str, Any],
+                  global_steps: int) -> None:
+    """Lay a full host state onto `engine`'s (new) mesh — the
+    old_mesh -> new_mesh reshard. The target engine's freshly
+    initialized TrainState provides the destination shardings (derived
+    for ITS world size), so a 4-rank state lands correctly ZeRO-sharded
+    on a 2-rank mesh and back. No disk is touched."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    def put(host_leaf, live_leaf):
+        return jax.device_put(
+            np.asarray(host_leaf).astype(live_leaf.dtype),
+            live_leaf.sharding)
+
+    state = engine.state
+    new_params = jax.tree.map(put, full_state["params"], state.params)
+    new_master = state.master
+    if state.master is not None:
+        if "master" not in full_state:
+            raise RedundancyError(
+                "target engine keeps an fp32 master but the snapshot "
+                "carries none")
+        new_master = jax.tree.map(put, full_state["master"], state.master)
+    new_opt = state.opt
+    if state.opt is not None and "opt" in full_state:
+        new_opt = jax.tree.map(put, full_state["opt"], state.opt)
+    step = jax.device_put(
+        jnp.asarray(int(global_steps), jnp.int32), state.step.sharding)
+    engine.state = dataclasses.replace(
+        state, params=new_params, master=new_master, opt=new_opt,
+        step=step)
+    engine.global_steps = int(global_steps)

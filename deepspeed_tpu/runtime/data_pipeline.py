@@ -130,6 +130,17 @@ class RandomLTDScheduler:
         n -= n % self.step_size
         return int(min(max(n, self.min_tokens), self.max_tokens))
 
+    # checkpointable state: the RNG stream position is the ONLY hidden
+    # state (the schedule itself is a pure function of the step), and it
+    # must survive a generation bump or the resumed run would draw a
+    # different token subset than the dead one — the elastic trainer's
+    # exactly-once contract extends to LTD index draws
+    def get_state(self) -> Dict[str, Any]:
+        return {"bit_generator": self._rng.bit_generator.state}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self._rng.bit_generator.state = state["bit_generator"]
+
     def sample_batch_indices(self, batch_size: int, seq_len: int, keep: int):
         """Sorted per-example keep-indices [B, keep] (the token_sort.cu
         sort: subset preserves original order/causality)."""

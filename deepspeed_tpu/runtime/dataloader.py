@@ -5,11 +5,22 @@ TPU-native analog of the reference dataloader layer
 The engine consumes *global* host batches (it shards them onto the mesh
 itself), so the loader's job is batching/iteration, not device placement.
 Works with any indexable dataset of pytrees (numpy arrays / dicts).
+
+The loader is STATEFUL and checkpointable: (epoch, position) fully
+determine the remaining sample order (the per-epoch permutation is a
+pure function of seed+epoch), so `state_dict()`/`load_state_dict()`
+round-trip a mid-epoch position exactly — the elastic trainer
+(elasticity/trainer.py) carries this state in every peer-redundancy
+snapshot so a preemption replays sample-exact (no loss, no
+duplication). `last_batch_indices`/`last_batch_epoch` expose each
+batch's provenance for the exactly-once ledger.
 """
 
-from typing import Any, Callable, Iterator, Optional, Sequence
+from typing import Any, Callable, Iterator, List, Optional, Sequence
 
 import numpy as np
+
+from ..resilience.faults import fault_point
 
 
 def default_collate(items: Sequence[Any]):
@@ -26,6 +37,10 @@ class DeepSpeedTPUDataLoader:
     comes from the engine config (train_batch_size for the global loop),
     optional shuffling with a deterministic seed per epoch, drop_last
     semantics matching the reference.
+
+    Iteration resumes from the persisted (epoch, position): an iterator
+    abandoned mid-epoch continues where it stopped, and the epoch only
+    advances when its batches are exhausted.
     """
 
     def __init__(
@@ -44,6 +59,9 @@ class DeepSpeedTPUDataLoader:
         self.drop_last = drop_last
         self.collate_fn = collate_fn or default_collate
         self.epoch = 0
+        self._pos = 0  # sample offset inside the current epoch's order
+        self.last_batch_indices: List[int] = []
+        self.last_batch_epoch = 0
         if len(dataset) < batch_size:
             raise ValueError(
                 f"dataset ({len(dataset)}) smaller than one global batch ({batch_size})"
@@ -55,22 +73,54 @@ class DeepSpeedTPUDataLoader:
             n += 1
         return n
 
-    def __iter__(self) -> Iterator[Any]:
+    # -- checkpointable position ----------------------------------------
+    def state_dict(self) -> dict:
+        """(epoch, position): with the seed from config these determine
+        every remaining sample — the whole resumable state."""
+        return {"epoch": self.epoch, "pos": self._pos}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.epoch = int(state["epoch"])
+        self._pos = int(state["pos"])
+
+    def _epoch_order(self) -> np.ndarray:
         idx = np.arange(len(self.dataset))
         if self.shuffle:
             rng = np.random.default_rng(self.seed + self.epoch)
             rng.shuffle(idx)
-        self.epoch += 1
-        for start in range(0, len(idx), self.batch_size):
+        return idx
+
+    def _epoch_limit(self) -> int:
+        """First position past the epoch's last deliverable batch."""
+        n = len(self.dataset)
+        return n - (n % self.batch_size) if self.drop_last else n
+
+    def __iter__(self) -> Iterator[Any]:
+        if self._pos >= self._epoch_limit():
+            # a fully-consumed epoch persisted as (e, end): roll over
+            self.epoch += 1
+            self._pos = 0
+        idx = self._epoch_order()
+        while self._pos < self._epoch_limit():
+            start = self._pos
             chunk = idx[start : start + self.batch_size]
-            if len(chunk) < self.batch_size and self.drop_last:
-                return
+            # chaos fault point BEFORE the position advances: an
+            # injected transient I/O error leaves the loader state
+            # clean, so a bounded retry re-fetches the same batch
+            fault_point("dataloader.fetch", epoch=self.epoch,
+                        index=start // self.batch_size)
+            self._pos = start + len(chunk)
+            self.last_batch_indices = [int(i) for i in chunk]
+            self.last_batch_epoch = self.epoch
             yield self.collate_fn([self.dataset[int(i)] for i in chunk])
+        self.epoch += 1
+        self._pos = 0
 
 
 class RepeatingLoader:
     """Wrap any iterable to restart on StopIteration
-    (ref: runtime/dataloader.py RepeatingLoader)."""
+    (ref: runtime/dataloader.py RepeatingLoader). Delegates the
+    stateful-loader contract to the wrapped loader when present."""
 
     def __init__(self, loader):
         self.loader = loader
@@ -85,3 +135,23 @@ class RepeatingLoader:
         except StopIteration:
             self._iter = iter(self.loader)
             return next(self._iter)
+
+    # -- stateful passthrough -------------------------------------------
+    def state_dict(self) -> dict:
+        return self.loader.state_dict()
+
+    def load_state_dict(self, state: dict) -> None:
+        self.loader.load_state_dict(state)
+        self._iter = iter(self.loader)  # resume from the restored position
+
+    @property
+    def epoch(self):
+        return self.loader.epoch
+
+    @property
+    def last_batch_indices(self):
+        return self.loader.last_batch_indices
+
+    @property
+    def last_batch_epoch(self):
+        return self.loader.last_batch_epoch

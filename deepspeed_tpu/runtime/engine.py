@@ -37,6 +37,7 @@ from ..monitor.monitor import MonitorMaster
 from ..ops.optimizers import Optimizer, build_optimizer
 from ..parallel import sharding as shd
 from ..platform.mesh import build_mesh, data_parallel_size, describe, use_mesh
+from ..resilience.faults import fault_point
 from ..utils.logging import log_dist, logger
 from ..utils.timers import BATCH_TIMER, STEP_TIMER, SynchronizedWallClockTimer, ThroughputTimer
 from . import zero
@@ -398,6 +399,13 @@ class DeepSpeedTPUEngine:
         self.monitor = MonitorMaster(config.monitor)
         self.global_steps = 0
         self._metrics_host: Dict[str, float] = {}
+        # chaos accounting (resilience/faults.py 'engine.step' point):
+        # injected straggler time accrues here for the driver to charge
+        # (virtual clocks) or sleep (real runs); disk_restores counts
+        # load_checkpoint calls — the peer-redundant recovery path
+        # (elasticity/trainer.py) gates on it staying zero
+        self.fault_delay_s = 0.0
+        self.disk_restores = 0
 
         # elastic-agent integration (ref: elasticity/elastic_agent.py:28
         # DSElasticAgent): when launched under run_elastic, beat the
@@ -1543,7 +1551,21 @@ class DeepSpeedTPUEngine:
 
         return jax.tree.map(rs, batch)
 
+    def drain_fault_delay(self) -> float:
+        """Collect and reset injected straggler time (0.0 outside chaos
+        runs) — same contract as ServingScheduler.drain_fault_delay."""
+        d, self.fault_delay_s = self.fault_delay_s, 0.0
+        return d
+
     def _dispatch_step(self, batch) -> Dict[str, Any]:
+        # chaos fault point 'engine.step' fires BEFORE any dispatch: an
+        # injected preemption raises with no state half-mutated (the
+        # last committed TrainState is intact for peer reconstruction);
+        # an injected straggler delay accrues to fault_delay_s
+        act = fault_point("engine.step", rank=jax.process_index(),
+                          step=self.global_steps + 1)
+        if act is not None and act.kind == "delay":
+            self.fault_delay_s += act.value
         if self._offload:
             return self._dispatch_offload_step(batch)
         if self._zoadam:
@@ -1781,6 +1803,7 @@ class DeepSpeedTPUEngine:
         ctx = fanout(load_dir, tag) if fanout is not None \
             else contextlib.nullcontext()
         scratch = None
+        self.disk_restores += 1
         try:
             with ctx:
                 if self.config.checkpoint.load_universal:

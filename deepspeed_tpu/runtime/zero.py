@@ -172,6 +172,23 @@ def _zero_sharded_dim(store_spec: P, gathered_spec: P, rank: int, mesh: Mesh):
     return None
 
 
+def zero_sharded_dims(store_specs, gathered_specs, shapes, mesh: Mesh):
+    """Pytree of per-leaf ZeRO-sharded dim indices (-1 = the leaf is
+    replicated over the zero axes; -1 rather than None because None is
+    an empty subtree to jax pytrees). The shard-slicing contract of the
+    peer-redundancy layer (resilience/redundancy.py): rank r of a world
+    of W owns elements [r*d/W, (r+1)*d/W) along this dim."""
+
+    def dim_of(s, g, shp):
+        d = _zero_sharded_dim(s, g, len(shp), mesh)
+        return -1 if d is None else d
+
+    return jax.tree.map(
+        dim_of, store_specs, gathered_specs, shapes,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
 def make_qwz_gather(store_specs, gathered_specs, shapes, mesh: Mesh):
     """ZeRO++ qwZ: int8-quantized weight all-gather.
 

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""ds-elastic CLI — deterministic training chaos gate: preemption-
+tolerant elastic training (docs/fault_tolerance.md, docs/elasticity.md).
+
+Usage:
+    python scripts/ds_elastic.py                 # committed TRAINCHAOS.json
+    python scripts/ds_elastic.py --plan my.json  # custom plan
+    python scripts/ds_elastic.py --strict        # identical today; kept
+                                                 # for gate-CLI symmetry
+
+The sixth tier-1 pre-test gate next to ds_lint / ds_budget /
+ds_numerics / the serving-fleet smoke / ds_chaos
+(.claude/skills/verify/SKILL.md): runs `bench.py --train-chaos <plan>`
+— one elastic training run on the virtual 8-device CPU mesh executed
+uninterrupted and then under the injected FaultPlan (a mid-run rank
+preemption, transient dataloader/collective I/O faults, a straggler
+window) — and fails unless every gate holds:
+
+  recovered_from_peer_shards       the preempted rank's optimizer-shard
+                                   slice was reconstructed from a
+                                   surviving peer's mirror (Gemini-style
+                                   in-memory checkpoint), world shrunk
+                                   to an elastic-compatible size and
+                                   regrown — run_elastic-class journeys
+                                   with NO generation restart
+  zero_disk_restore                no checkpoint was read anywhere in
+                                   the recovery
+  data_order_ledger_byte_exact     the committed (step -> sample ids)
+                                   ledger is byte-identical to the
+                                   uninterrupted run — every sample
+                                   delivered exactly once (no loss, no
+                                   duplication across the rollback)
+  loss_prefix_bitwise_identical    steps before the preemption match
+                                   the clean run bit for bit
+  loss_trajectory_within_budget    the full trajectory stays within
+                                   the plan's float-reassociation
+                                   budget (the shrunken world re-orders
+                                   the gradient reduction; nothing else
+                                   may move)
+  rollback_within_mirror_cadence   a recovery replays at most
+                                   every_k_steps - 1 committed steps
+  world_restored / straggler_flagged / reconstruction_within_budget
+
+Everything is seeded and the faults fire on exact step counts: a red
+gate is an elastic-training regression, never flake.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--plan", default="default",
+                    help="'default' (the committed TRAINCHAOS.json) or "
+                         "a FaultPlan JSON path with a 'workload' block")
+    ap.add_argument("--strict", action="store_true",
+                    help="accepted for symmetry with the other gates "
+                         "(every training chaos gate is already hard)")
+    args = ap.parse_args(argv)
+
+    import bench
+
+    rc = bench._train_chaos(args.plan)
+    print(json.dumps({"ok": rc == 0, "gate": "ds_elastic",
+                      "plan": args.plan}), file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,3 +52,69 @@ def test_repeating_loader():
     rl = RepeatingLoader(dl)
     batches = [next(rl) for _ in range(5)]  # wraps past 2-batch epochs
     assert batches[0]["tokens"].shape == (8, 4)
+
+
+# ---------------------------------------------------------------------------
+# stateful-loader contract (docs/elasticity.md): save -> restore ->
+# exactly-once delivery, the substrate of the elastic trainer's ledger
+# ---------------------------------------------------------------------------
+
+def _consume(rl, dl, n):
+    out = []
+    for _ in range(n):
+        next(rl)
+        out.append((dl.last_batch_epoch, tuple(dl.last_batch_indices)))
+    return out
+
+
+def test_state_round_trip_mid_epoch():
+    """Restore at a mid-epoch position: the replay delivers exactly the
+    batches consumed after the snapshot — same ids, same order."""
+    d = ToyDataset(20)
+    dl = DeepSpeedTPUDataLoader(d, batch_size=4, shuffle=True, seed=3)
+    rl = RepeatingLoader(dl)
+    _consume(rl, dl, 2)               # park mid-epoch (5 batches/epoch)
+    snap = rl.state_dict()
+    after = _consume(rl, dl, 6)       # crosses into epoch 1
+    rl.load_state_dict(snap)
+    replay = _consume(rl, dl, 6)
+    assert replay == after
+    # exactly-once within each epoch: no id repeats, none skipped
+    epoch0 = [i for e, ids in after for i in ids if e == 0]
+    assert len(epoch0) == len(set(epoch0))
+
+
+def test_state_round_trip_rng_stream():
+    """The shuffled order is a pure function of (seed, epoch): a FRESH
+    loader restored from the snapshot reproduces the same stream — the
+    generation-bump case, where the dead world's loader object is gone
+    and only its state_dict survived in the redundancy snapshot."""
+    make = lambda: DeepSpeedTPUDataLoader(
+        ToyDataset(20), batch_size=4, shuffle=True, seed=7)
+    dl1 = make()
+    rl1 = RepeatingLoader(dl1)
+    _consume(rl1, dl1, 7)             # into epoch 1's shuffle stream
+    snap = rl1.state_dict()
+    want = _consume(rl1, dl1, 5)
+    dl2 = make()                      # a NEW incarnation (new process)
+    rl2 = RepeatingLoader(dl2)
+    rl2.load_state_dict(snap)
+    assert _consume(rl2, dl2, 5) == want
+
+
+def test_state_at_exact_epoch_boundary_rolls_over():
+    dl = DeepSpeedTPUDataLoader(ToyDataset(16), batch_size=8,
+                                shuffle=True, seed=1)
+    list(dl)                          # consume epoch 0 to exhaustion
+    snap = dl.state_dict()
+    assert snap == {"epoch": 1, "pos": 0}
+    dl2 = DeepSpeedTPUDataLoader(ToyDataset(16), batch_size=8,
+                                 shuffle=True, seed=1)
+    dl2.load_state_dict(snap)
+    b_resumed = next(iter(dl2))
+    dl3 = DeepSpeedTPUDataLoader(ToyDataset(16), batch_size=8,
+                                 shuffle=True, seed=1)
+    list(dl3)
+    b_natural = next(iter(dl3))
+    np.testing.assert_array_equal(b_resumed["tokens"],
+                                  b_natural["tokens"])

@@ -33,6 +33,23 @@ TOTAL_STEPS = 6
 KILL_STEP = 3
 
 
+@pytest.fixture(scope="module")
+def multiprocess_backend():
+    """Gate for the e2e journeys that spawn a REAL 2+-OS-process world:
+    some backends (the container jax 0.4.37 CPU backend) cannot jit
+    sharded computations across processes at all ('Multiprocess
+    computations aren't implemented on the CPU backend'). That is an
+    infra limit, not a regression — probe once and report
+    skipped(infra) with the backend's own error so nobody re-bisects
+    a red lane that no code change caused."""
+    from deepspeed_tpu.platform.accelerator import probe_multiprocess_backend
+
+    ok, reason = probe_multiprocess_backend()
+    if not ok:
+        pytest.skip(f"skipped(infra): multiprocess backend unavailable "
+                    f"on this container — {reason}")
+
+
 class TestHeartbeatUnits:
     def test_beat_scan_roundtrip(self, tmp_path):
         hb = Heartbeat(str(tmp_path), rank=2, generation=1)
@@ -137,7 +154,8 @@ def _check_resumed_world(out, num_procs):
     assert g1_steps == list(range(KILL_STEP + 1, TOTAL_STEPS + 1)), g1_steps
 
 
-def test_hard_exit_detect_resize_resume(tmp_path, capsys):
+def test_hard_exit_detect_resize_resume(tmp_path, capsys,
+                                        multiprocess_backend):
     """Rank 1 dies hard at step 3; the agent detects the exit, restarts
     at world-1, and the survivors resume from the step-3 checkpoint and
     finish the run."""
@@ -146,7 +164,8 @@ def test_hard_exit_detect_resize_resume(tmp_path, capsys):
     _check_resumed_world(out, num_procs=2)
 
 
-def test_hang_detect_via_heartbeat(tmp_path, capsys):
+def test_hang_detect_via_heartbeat(tmp_path, capsys,
+                                   multiprocess_backend):
     """Rank 1 wedges (alive, never beats again): only the heartbeat can
     catch this. The agent must declare the world degraded and resume at
     the surviving size."""
@@ -174,7 +193,8 @@ def test_world_size_filter_skips_invalid(tmp_path, capsys):
     assert "restarting at world=2" in err
 
 
-def test_four_proc_kill_resumes_at_three(tmp_path, capsys):
+def test_four_proc_kill_resumes_at_three(tmp_path, capsys,
+                                         multiprocess_backend):
     """VERDICT r4 weak #5: the failure journey in the 4-process world —
     kill one of four controllers mid-run; survivors resume at 3."""
     worker = os.path.join(os.path.dirname(__file__), "_elastic_worker.py")

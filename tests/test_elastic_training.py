@@ -1,0 +1,402 @@
+"""Preemption-tolerant elastic training: peer-redundant ZeRO shards,
+checkpoint-free resharding, the guarded control-plane collectives, and
+the training fault points (docs/fault_tolerance.md training section,
+docs/elasticity.md).
+
+The full journey — injected mid-run rank kill + world shrink + regrow
+with a byte-exact data-order ledger — is additionally gated end-to-end
+by `bench.py --train-chaos` / scripts/ds_elastic.py (tier-1 pre-test
+gate); here the pieces are proven fast and in isolation, plus one
+compact in-process journey.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu.comm as comm
+from deepspeed_tpu.resilience import (
+    FaultPlan,
+    InjectedIOError,
+    PeerRedundantStore,
+    RankPreemptedError,
+    RedundancyError,
+    UnrecoverableWorldError,
+    armed,
+)
+from deepspeed_tpu.resilience.redundancy import (
+    assemble_tree,
+    slice_tree,
+)
+
+
+# ---------------------------------------------------------------------------
+# PeerRedundantStore: the storage-honesty state machine
+# ---------------------------------------------------------------------------
+
+def _payloads(world, step=0):
+    return {r: {"w": np.full((4,), 100 * step + r, np.float32)}
+            for r in range(world)}
+
+
+class TestPeerRedundantStore:
+    def test_snapshot_reconstruct_after_single_loss(self):
+        st = PeerRedundantStore(world=4, spare=1)
+        st.snapshot(6, _payloads(4, step=6), shared={"k": 1})
+        st.lose([2])
+        ok, missing = st.recoverable()
+        assert ok and missing == []
+        step, payloads, shared = st.reconstruct()
+        assert step == 6 and shared == {"k": 1}
+        # rank 2's slice came from its mirror on rank 3
+        np.testing.assert_array_equal(payloads[2]["w"],
+                                      np.full((4,), 602, np.float32))
+
+    def test_losing_rank_and_its_holder_is_unrecoverable(self):
+        st = PeerRedundantStore(world=4, spare=1)
+        st.snapshot(1, _payloads(4))
+        st.lose([2, 3])  # rank 2's only mirror lived on rank 3
+        ok, missing = st.recoverable()
+        assert not ok and missing == [2]
+        with pytest.raises(UnrecoverableWorldError) as ei:
+            st.reconstruct()
+        assert ei.value.missing_ranks == [2]
+
+    def test_spare_two_survives_double_loss(self):
+        st = PeerRedundantStore(world=4, spare=2)
+        st.snapshot(1, _payloads(4))
+        st.lose([2, 3])
+        ok, _ = st.recoverable()
+        assert ok  # rank 2 also mirrors to rank 0, rank 3 to ranks 0+1
+        _, payloads, _ = st.reconstruct()
+        assert sorted(payloads) == [0, 1, 2, 3]
+
+    def test_new_snapshot_clears_losses_and_staleness(self):
+        st = PeerRedundantStore(world=2, spare=1)
+        st.snapshot(4, _payloads(2, step=4))
+        st.lose([1])
+        st.snapshot(6, _payloads(2, step=6))  # the next mirror round
+        assert st.lost == set()
+        assert st.staleness(current_step=7) == 1
+        assert st.staleness(current_step=6) == 0
+
+    def test_world_one_is_local_only(self):
+        st = PeerRedundantStore(world=1, spare=0)
+        st.snapshot(1, _payloads(1))
+        assert st.reconstruct()[0] == 1
+        st.lose([0])
+        assert not st.recoverable()[0]
+
+    def test_bad_geometry_rejected(self):
+        with pytest.raises(RedundancyError):
+            PeerRedundantStore(world=2, spare=2)
+        st = PeerRedundantStore(world=2, spare=1)
+        with pytest.raises(RedundancyError):
+            st.snapshot(1, {0: {}})  # incomplete rank set
+
+
+class TestSliceAssemble:
+    def test_round_trip_mixed_dims(self):
+        tree = {"a": np.arange(8, dtype=np.float32),
+                "b": np.arange(12, dtype=np.float32).reshape(3, 4),
+                "c": np.float32(7.0).reshape(())}
+        dims = {"a": 0, "b": 1, "c": -1}
+        world = 4
+        payloads = {r: slice_tree(tree, dims, r, world)
+                    for r in range(world)}
+        assert payloads[1]["a"].shape == (2,)
+        assert payloads[1]["b"].shape == (3, 1)
+        full = assemble_tree(payloads, dims)
+        np.testing.assert_array_equal(full["a"], tree["a"])
+        np.testing.assert_array_equal(full["b"], tree["b"])
+        np.testing.assert_array_equal(full["c"], tree["c"])
+
+    def test_indivisible_dim_rejected(self):
+        with pytest.raises(RedundancyError):
+            slice_tree({"a": np.arange(6)}, {"a": 0}, 0, 4)
+
+
+# ---------------------------------------------------------------------------
+# guarded control-plane collectives (comm/comm.py)
+# ---------------------------------------------------------------------------
+
+class TestCollectiveGuard:
+    def test_transient_fault_heals_within_retries(self):
+        plan = FaultPlan([{"point": "comm.collective", "kind": "raise",
+                           "error": "io", "at": 1, "times": 2}])
+        with armed(plan) as p:
+            comm.barrier("t-heal")  # two failures, third attempt lands
+        assert len(p.fired) == 2
+
+    def test_retries_exhausted_surfaces(self):
+        plan = FaultPlan([{"point": "comm.collective", "kind": "raise",
+                           "error": "io", "times": -1}])
+        with armed(plan):
+            with pytest.raises(InjectedIOError):
+                comm.barrier("t-dead", retries=1)
+
+    def test_timeout_is_typed_with_op_and_group(self):
+        # injected delay >= the deadline: a deterministic timeout
+        # verdict with NO real hang (the guard never sleeps it)
+        plan = FaultPlan([{"point": "comm.collective", "kind": "delay",
+                           "value": 60.0}])
+        with armed(plan):
+            with pytest.raises(comm.CollectiveTimeoutError) as ei:
+                comm.barrier("t-hang", timeout_s=2.0)
+        assert ei.value.op == "barrier[t-hang]"
+        assert ei.value.replica_group == "world"
+        assert "t-hang" in str(ei.value)
+
+    def test_short_delay_is_slow_but_alive(self):
+        plan = FaultPlan([{"point": "comm.collective", "kind": "delay",
+                           "value": 0.01}])
+        with armed(plan):
+            comm.barrier("t-slow", timeout_s=5.0)  # completes
+
+    def test_broadcast_host_guarded_and_identity_single_process(self):
+        plan = FaultPlan([{"point": "comm.collective", "kind": "raise",
+                           "error": "io",
+                           "where": {"op": "broadcast_host"}, "times": 1}])
+        with armed(plan) as p:
+            assert comm.broadcast_host({"a": 1}) == {"a": 1}
+        assert p.fired  # fired once, healed by the retry
+
+    def test_timeout_env_knob(self, monkeypatch):
+        monkeypatch.setenv("DS_COMM_TIMEOUT_S", "12.5")
+        assert comm.collective_timeout_from_env() == 12.5
+        monkeypatch.setenv("DS_COMM_TIMEOUT_S", "junk")
+        assert comm.collective_timeout_from_env(3.0) == 3.0
+
+
+# ---------------------------------------------------------------------------
+# dataloader fault point (state stays clean across an injected failure)
+# ---------------------------------------------------------------------------
+
+class _Toy:
+    def __init__(self, n=16):
+        self.items = [{"tokens": np.full((4,), i, np.int32)}
+                      for i in range(n)]
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, i):
+        return self.items[i]
+
+
+class TestDataloaderFaultPoint:
+    def test_injected_fetch_error_leaves_position_clean(self):
+        from deepspeed_tpu.runtime.dataloader import (
+            DeepSpeedTPUDataLoader,
+            RepeatingLoader,
+        )
+
+        dl = DeepSpeedTPUDataLoader(_Toy(), batch_size=4, shuffle=True,
+                                    seed=3)
+        rl = RepeatingLoader(dl)
+        first = next(rl)
+        plan = FaultPlan([{"point": "dataloader.fetch", "kind": "raise",
+                           "error": "io", "at": 1, "times": 1}])
+        with armed(plan):
+            state_before = rl.state_dict()
+            with pytest.raises(InjectedIOError):
+                next(rl)
+            # the raise fired BEFORE the position advanced
+            assert rl.state_dict() == state_before
+            retry = next(rl)  # RepeatingLoader re-enters at the position
+        ids = dl.last_batch_indices
+        rl.load_state_dict(state_before)
+        again = next(rl)
+        assert dl.last_batch_indices == ids
+        np.testing.assert_array_equal(retry["tokens"], again["tokens"])
+        assert not np.array_equal(first["tokens"], retry["tokens"])
+
+
+# ---------------------------------------------------------------------------
+# elastic.launch fault point: a failed relaunch burns a generation
+# ---------------------------------------------------------------------------
+
+class TestLaunchFaultPoint:
+    def test_failed_launch_shrinks_and_retries(self, tmp_path, capsys):
+        from deepspeed_tpu.elasticity import run_elastic
+
+        ok = tmp_path / "ok.py"
+        ok.write_text("import sys; sys.exit(0)\n")
+        plan = FaultPlan([{"point": "elastic.launch", "kind": "raise",
+                           "error": "io", "where": {"generation": 0}}])
+        with armed(plan):
+            rc = run_elastic(
+                [sys.executable, str(ok)], num_procs=3,
+                heartbeat_dir=str(tmp_path / "hb"),
+                resume_dir=str(tmp_path),
+                first_beat_timeout_s=0, max_restarts=2, min_procs=1)
+        err = capsys.readouterr().err
+        assert rc == 0
+        assert "launch failed" in err
+        assert "restarting at world=2" in err
+
+
+# ---------------------------------------------------------------------------
+# the compact in-process journey: kill -> peer reshard -> regrow
+# ---------------------------------------------------------------------------
+
+ELASTIC = {"enabled": True, "max_train_batch_size": 8,
+           "micro_batch_sizes": [2, 4], "min_gpus": 1, "max_gpus": 8}
+
+
+def _make_engine(world):
+    import jax
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import transformer as T
+    from deepspeed_tpu.platform.mesh import build_mesh
+
+    mcfg = T.TransformerConfig(vocab_size=64, n_layers=1, n_heads=2,
+                               d_model=32, max_seq=16, variant="llama",
+                               use_flash=False)
+    mesh = build_mesh({"data": world}, devices=jax.devices()[:world])
+    return ds.initialize(
+        {"optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+         "elasticity": dict(ELASTIC),
+         "zero_optimization": {"stage": 1},
+         "seed": 3, "steps_per_print": 10**9},
+        loss_fn=T.make_loss_fn(mcfg),
+        param_init_fn=lambda k: T.init(mcfg, k),
+        param_logical_specs=T.logical_specs(mcfg),
+        mesh=mesh)
+
+
+def _make_loader():
+    from deepspeed_tpu.runtime.dataloader import (
+        DeepSpeedTPUDataLoader,
+        RepeatingLoader,
+    )
+
+    class Tok:
+        def __init__(self, n=24):
+            r = np.random.default_rng(9)
+            self.items = [
+                {"tokens": r.integers(0, 64, (17,)).astype(np.int32)}
+                for _ in range(n)]
+
+        def __len__(self):
+            return len(self.items)
+
+        def __getitem__(self, i):
+            return self.items[i]
+
+    return RepeatingLoader(DeepSpeedTPUDataLoader(
+        Tok(), batch_size=8, shuffle=True, seed=5))
+
+
+class TestElasticTrainerJourney:
+    def test_preempt_reshard_regrow_exactly_once(self):
+        from deepspeed_tpu.elasticity import ElasticTrainer
+        from deepspeed_tpu.monitor.monitor import (
+            training_resilience_events,
+        )
+
+        T_STEPS = 6
+        clean = ElasticTrainer(_make_engine, 2, _make_loader(),
+                               every_k_steps=2,
+                               elastic_block=dict(ELASTIC))
+        clean_hist = clean.run(T_STEPS)
+
+        # rank 1 preempted at the dispatch of step 4 (state at 3,
+        # mirror at 2 -> rollback 1 step); regrow 1 -> 2 at step 5
+        plan = FaultPlan([
+            {"point": "engine.step", "kind": "raise",
+             "error": "preempted", "value": 1, "where": {"step": 4},
+             "times": 1},
+        ])
+        chaos = ElasticTrainer(_make_engine, 2, _make_loader(),
+                               every_k_steps=2,
+                               elastic_block=dict(ELASTIC))
+        with armed(plan) as p:
+            chaos_hist = chaos.run(T_STEPS, regrow_at=5, regrow_to=2)
+        assert p.fired == ["engine.step#1:raise:preempted"]
+
+        # exactly-once committed trajectory + byte-exact sample ledger
+        assert sorted(clean_hist) == list(range(1, T_STEPS + 1))
+        assert sorted(chaos_hist) == list(range(1, T_STEPS + 1))
+        assert json.dumps(sorted(clean.ledger.items())) \
+            == json.dumps(sorted(chaos.ledger.items()))
+        # bitwise before the kill; reassociation-only drift after
+        assert all(clean_hist[s] == chaos_hist[s] for s in (1, 2, 3))
+        for s in range(4, T_STEPS + 1):
+            assert abs(clean_hist[s] - chaos_hist[s]) \
+                <= 1e-3 * abs(clean_hist[s])
+
+        # the recovery was peer-shard, not disk
+        m = chaos.resilience_metrics()
+        assert chaos.reconstructions == 1
+        assert m["disk_restores"] == 0
+        assert chaos.last_rollback_steps == 1  # step 3 -> mirror at 2
+        assert chaos.world == 2 and chaos.generation == 2
+
+        # monitor feed contract: (name, float, step) with the prefix
+        events = training_resilience_events(chaos, step=T_STEPS)
+        names = {n for n, _, _ in events}
+        assert {"train/resilience/generation",
+                "train/resilience/redundancy_staleness_steps",
+                "train/resilience/disk_restores"} <= names
+        assert all(s == T_STEPS and isinstance(v, float)
+                   for _, v, s in events)
+
+    def test_payload_slices_match_device_shards(self):
+        """The honesty check: an exported rank payload is byte-identical
+        to the rank's actual addressable ZeRO shard on the mesh."""
+        from deepspeed_tpu.resilience.redundancy import (
+            engine_shard_dims,
+            export_rank_payloads,
+        )
+
+        eng = _make_engine(2)
+        payloads, dims = export_rank_payloads(eng)
+        # find a genuinely sharded opt leaf and compare with the
+        # device's own addressable shard
+        import jax
+
+        leaf = eng.state.opt["mu"]["embed"]
+        dim = dims["opt"]["mu"]["embed"]
+        assert dim >= 0  # embed (64, 32) shards over data=2
+        for shard in leaf.addressable_shards:
+            r = shard.index[dim].start or 0
+            rank = r // (leaf.shape[dim] // 2)
+            np.testing.assert_array_equal(
+                np.asarray(shard.data),
+                payloads[rank]["opt"]["mu"]["embed"])
+        assert engine_shard_dims(eng).keys() == dims.keys()
+
+    def test_unrecoverable_without_checkpoint_dir_raises(self):
+        from deepspeed_tpu.elasticity import ElasticTrainer
+
+        tr = ElasticTrainer(_make_engine, 2, _make_loader(),
+                            every_k_steps=1,
+                            elastic_block=dict(ELASTIC))
+        tr.store.lose([0, 1])  # both hosts gone: nothing survives
+        with pytest.raises(UnrecoverableWorldError):
+            tr.recover([0, 1])
+
+
+# ---------------------------------------------------------------------------
+# RandomLTD RNG-stream state round trip (data_pipeline satellite)
+# ---------------------------------------------------------------------------
+
+class TestRandomLTDState:
+    def test_rng_stream_round_trip(self):
+        from deepspeed_tpu.runtime.data_pipeline import RandomLTDScheduler
+
+        a = RandomLTDScheduler(min_tokens=8, max_tokens=32,
+                               total_steps=100, step_size=8, seed=7)
+        a.sample_batch_indices(2, 16, 8)  # advance the stream
+        snap = a.get_state()
+        want = a.sample_batch_indices(2, 16, 8)
+        b = RandomLTDScheduler(min_tokens=8, max_tokens=32,
+                               total_steps=100, step_size=8, seed=7)
+        b.set_state(snap)
+        np.testing.assert_array_equal(
+            b.sample_batch_indices(2, 16, 8), want)

@@ -59,6 +59,19 @@ trajectory matches the uninterrupted run (bitwise before the
 preemption, within the plan's reassociation budget after), and
 rollback/reconstruction stay within budget. scripts/ds_elastic.py
 gates this in CI (docs/fault_tolerance.md, docs/elasticity.md).
+
+`python bench.py --sdc-chaos [plan]` (plan = 'default' =
+SDCCHAOS.json, or a path) runs the SILENT-DATA-CORRUPTION lane:
+elastic training and the disaggregated serving fleet, clean and then
+under injected in-memory bit flips (a gradient-path flip the anomaly
+guardian must veto before commit, a peer-mirror flip the digest
+envelope must catch with holder fallover, KV handoff flips discarded
+at import). Exit is non-zero unless every injected flip is detected
+before any state commit, zero poisoned optimizer updates or served
+tokens land (ledger byte-exact, outputs token-identical to clean),
+recovery needs no disk, and a rerun is byte-identical.
+scripts/ds_sdc.py gates this in CI (docs/fault_tolerance.md SDC
+section).
 """
 
 import json
@@ -1079,6 +1092,352 @@ def _train_chaos(plan_arg: str):
     return 0 if all(gates.values()) else 1
 
 
+# ---------------------------------------------------------------------------
+# SDC chaos lane: silent-data-corruption guardian under injected bit flips
+# ---------------------------------------------------------------------------
+
+def _default_sdc_chaos_plan() -> dict:
+    """The CI silent-data-corruption plan (scripts/ds_sdc.py gates on
+    it; the committed SDCCHAOS.json carries this dict plus the
+    expected detection ledger). Three in-memory flip classes, one per
+    registered corrupt point:
+
+    - a gradient-path flip at step 5 ('engine.grads': exponent bits of
+      the step's loss/grad-norm readout AND one updated state leaf) —
+      the guardian's anomaly window must veto the step BEFORE commit
+      and roll back to the last digest-verified peer mirror;
+    - a peer-mirror flip in rank 3's copy of rank 2's shard at the
+      step-8 snapshot ('mirror.payload') — rank 2 is then preempted at
+      step 9, so the recovery MUST hit the corrupted copy, fail its
+      digest, and fall over to the clean holder (rank 0) with zero
+      disk restores;
+    - two KV handoff payload flips on the serving fleet
+      ('handoff.payload') — import-side digest verification must
+      discard them and recompute token-identically.
+
+    `budget` bounds recovery exactly like the training chaos lane
+    (TRAINCHAOS tolerance); `workload` drives both sub-lanes'
+    geometry."""
+    return {
+        "name": "sdc-default",
+        "seed": 0,
+        "budget": {
+            "max_rollback_steps": 2,
+            "max_loss_rel_diff": 1e-3,
+            "max_reconstruction_s": 60.0,
+            "max_disk_restores": 0,
+        },
+        "workload": {
+            "world": 4, "total_steps": 12, "every_k_steps": 2,
+            "spare": 2, "regrow_at": 11, "regrow_to": 4,
+            "serving_requests": 6, "serving_new_tokens": 8,
+            "guardian": {"zscore": 8.0, "window": 16, "warmup": 2,
+                         "persistent_trips": 2},
+        },
+        "faults": [
+            # one silent gradient flip at step 5: detect -> veto ->
+            # verified-mirror rollback -> replay (bitwise clean)
+            {"point": "engine.grads", "kind": "corrupt",
+             "where": {"step": 5}, "at": 1, "times": 1},
+            # rank 3's mirror copy of rank 2's shard flips at the 4th
+            # ARMED snapshot round holding it = step 8 (the step-0 init
+            # mirror runs before arming; armed rounds land at steps
+            # 2/4 then — after the step-5 veto rolls back to 4 — at
+            # 6/8), so the preemption recovery reads the flipped copy
+            {"point": "mirror.payload", "kind": "corrupt",
+             "where": {"holder": 3, "owner": 2}, "at": 4, "times": 1},
+            # rank 2 preempted at step 9: reconstruction must consume
+            # the mirrors, catch the flip, and fall over
+            {"point": "engine.step", "kind": "raise",
+             "error": "preempted", "value": 2, "where": {"step": 9},
+             "at": 1, "times": 1},
+            # serving: the 2nd and 3rd KV handoff imports arrive
+            # bit-flipped
+            {"point": "handoff.payload", "kind": "corrupt",
+             "at": 2, "times": 2},
+        ],
+    }
+
+
+def _sdc_training_lane(plan, wk, jax):
+    """Clean + chaos elastic training runs with the SDC guardian on;
+    returns (clean trainer, chaos trainer, fired log)."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.elasticity import ElasticTrainer
+    from deepspeed_tpu.models import transformer as T
+    from deepspeed_tpu.platform.mesh import build_mesh
+    from deepspeed_tpu.resilience import armed
+    from deepspeed_tpu.runtime.dataloader import (
+        DeepSpeedTPUDataLoader,
+        RepeatingLoader,
+    )
+
+    world, total_steps = int(wk["world"]), int(wk["total_steps"])
+    mcfg = T.TransformerConfig(
+        vocab_size=128, n_layers=2, n_heads=4, d_model=64, max_seq=32,
+        variant="llama", use_flash=False)
+    elastic_block = {
+        "enabled": True, "max_train_batch_size": 16,
+        "micro_batch_sizes": [2, 4], "min_gpus": 1, "max_gpus": 16,
+    }
+
+    def make_engine(w):
+        mesh = build_mesh({"data": w}, devices=jax.devices()[:w])
+        return ds.initialize(
+            {"optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+             "elasticity": dict(elastic_block),
+             "zero_optimization": {"stage": 1},
+             "seed": 7, "steps_per_print": 10**9},
+            loss_fn=T.make_loss_fn(mcfg),
+            param_init_fn=lambda k: T.init(mcfg, k),
+            param_logical_specs=T.logical_specs(mcfg),
+            mesh=mesh)
+
+    class _Toy:
+        def __init__(self, n=64):
+            r = np.random.default_rng(5)
+            self.items = [
+                {"tokens": r.integers(0, 128, (33,)).astype(np.int32)}
+                for _ in range(n)]
+
+        def __len__(self):
+            return len(self.items)
+
+        def __getitem__(self, i):
+            return self.items[i]
+
+    def run_lane(armed_plan):
+        tr = ElasticTrainer(
+            make_engine, world,
+            RepeatingLoader(DeepSpeedTPUDataLoader(
+                _Toy(), batch_size=16, shuffle=True, seed=11)),
+            every_k_steps=int(wk["every_k_steps"]),
+            spare=int(wk.get("spare", 1)),
+            elastic_block=elastic_block,
+            guardian=dict(wk.get("guardian") or
+                          _default_sdc_chaos_plan()["workload"]["guardian"]))
+        if armed_plan is not None:
+            with armed(armed_plan) as p:
+                tr.run(total_steps, regrow_at=wk.get("regrow_at"),
+                       regrow_to=wk.get("regrow_to"))
+            return tr, list(p.fired)
+        tr.run(total_steps)
+        return tr, []
+
+    clean, _ = run_lane(None)
+    chaos, fired = run_lane(plan)
+    return clean, chaos, fired
+
+
+def _sdc_serving_lane(plan, wk, jax):
+    """Clean + chaos disaggregated serving passes; returns
+    (clean outputs, chaos outputs, router metrics, fired log)."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference import ServingRouter, init_inference
+    from deepspeed_tpu.models import transformer as T
+    from deepspeed_tpu.resilience import armed
+
+    mcfg = T.TransformerConfig(
+        vocab_size=128, n_layers=2, n_heads=4, d_model=64, max_seq=64,
+        variant="llama", use_flash=False)
+    params = T.init(mcfg, jax.random.PRNGKey(0))
+
+    def engine():
+        return init_inference(
+            params, mcfg,
+            dict(max_seq_len=64, kv_block_size=8, num_kv_blocks=32,
+                 min_prefill_bucket=8, max_batch_size=8),
+            dtype=jnp.float32)
+
+    rcfg = {"replicas": 2, "mode": "disaggregated",
+            "prefill_replicas": 1, "scheduler": {"warmup": False}}
+    r = np.random.default_rng(plan.seed)
+    n_req = int(wk.get("serving_requests", 6))
+    new_tok = int(wk.get("serving_new_tokens", 8))
+    prompts = [list(r.integers(1, 128, 12)) for _ in range(n_req)]
+
+    def serve(armed_plan):
+        router = ServingRouter([engine(), engine()], dict(rcfg), seed=0)
+        gids = [router.submit(p, max_new_tokens=new_tok)
+                for p in prompts]
+        fired = []
+        if armed_plan is not None:
+            with armed(armed_plan) as p:
+                router.serve()
+            fired = list(p.fired)
+        else:
+            router.serve()
+        outs = [list(router.result(g).output) for g in gids]
+        assert all(router.result(g).done for g in gids)
+        return router, outs, fired
+
+    _, clean_out, _ = serve(None)
+    router, chaos_out, fired = serve(plan)
+    return clean_out, chaos_out, router.metrics(), fired
+
+
+def _sdc_chaos(plan_arg: str, capture=None):
+    """SDC chaos gate (scripts/ds_sdc.py; docs/fault_tolerance.md SDC
+    section): the elastic-training and disaggregated-serving lanes run
+    clean and then under the injected bit-flip plan, and the gate
+    asserts 100% detection of every injected flip (gradient, mirror,
+    handoff) BEFORE any state commit: zero poisoned optimizer updates
+    (loss prefix bitwise-identical through the corrupted-then-replayed
+    steps, ledger byte-exact), zero corrupted served tokens
+    (token-identical outputs), mirror fallover with zero disk
+    restores, and a byte-identical chaos rerun. With `capture`, writes
+    the committed SDCCHAOS.json (plan + expected detection ledger)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_threefry_partitionable", True)
+
+    from deepspeed_tpu.resilience import FaultPlan
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    committed = os.path.join(root, "SDCCHAOS.json")
+    expect = None
+    if plan_arg == "default":
+        if os.path.exists(committed) and capture is None:
+            raw = json.load(open(committed))
+            expect = raw.get("expect")
+        else:
+            raw = _default_sdc_chaos_plan()
+    else:
+        raw = json.load(open(plan_arg))
+        expect = raw.get("expect")
+    plan = FaultPlan.from_dict(raw)
+    budget = {**_default_sdc_chaos_plan()["budget"], **plan.budget}
+    wk = {**_default_sdc_chaos_plan()["workload"],
+          **raw.get("workload", {})}
+    world, total_steps = int(wk["world"]), int(wk["total_steps"])
+
+    # -- training sub-lane (clean, chaos, and a chaos RERUN for the
+    # byte-identical determinism gate) --------------------------------
+    clean, chaos, fired = _sdc_training_lane(plan, wk, jax)
+    plan.reset()
+    _, rerun, rerun_fired = _sdc_training_lane(plan, wk, jax)
+    plan.reset()
+
+    def hist_bytes(tr):
+        return json.dumps(
+            [[s, tr.history[s]] for s in sorted(tr.history)]).encode()
+
+    def ledger_bytes(tr):
+        return json.dumps([[s, tr.ledger[s][0], list(tr.ledger[s][1])]
+                           for s in sorted(tr.ledger)]).encode()
+
+    steps = list(range(1, total_steps + 1))
+    kill_steps = [int(f.where["step"]) for f in plan.faults
+                  if f.point == "engine.step" and f.kind == "raise"
+                  and "step" in f.where]
+    prefix_end = (min(kill_steps) - 1) if kill_steps else total_steps
+    prefix_exact = all(clean.history[s] == chaos.history[s]
+                       for s in range(1, prefix_end + 1))
+    rel = {s: abs(clean.history[s] - chaos.history[s])
+           / max(abs(clean.history[s]), 1e-12) for s in steps}
+    max_rel = max(rel.values()) if rel else 0.0
+    n_grad_flips = sum(1 for f in fired if f.startswith("engine.grads"))
+    n_mirror_flips = sum(1 for f in fired
+                         if f.startswith("mirror.payload"))
+
+    # -- serving sub-lane (clean, chaos, chaos rerun) -----------------
+    clean_out, chaos_out, sm, sfired = _sdc_serving_lane(plan, wk, jax)
+    plan.reset()
+    _, rerun_out, _, rerun_sfired = _sdc_serving_lane(plan, wk, jax)
+    n_handoff_flips = sum(1 for f in sfired
+                          if f.startswith("handoff.payload"))
+
+    detected = {
+        "grad_flips_injected": n_grad_flips,
+        "grad_flips_detected": int(chaos.anomalies_detected),
+        "mirror_flips_injected": n_mirror_flips,
+        "mirror_flips_detected": int(chaos.mirror_integrity_failures),
+        "handoff_flips_injected": n_handoff_flips,
+        "handoff_flips_detected": int(
+            sm["fleet/handoff_integrity_failures"]),
+    }
+    m = chaos.resilience_metrics()
+    gates = {
+        # every injected flip of every class was caught
+        "grad_flip_detected_before_commit": (
+            detected["grad_flips_detected"] >= n_grad_flips > 0
+            and chaos.integrity_rollbacks >= 1),
+        "mirror_flip_detected_with_fallover": (
+            detected["mirror_flips_detected"] >= n_mirror_flips > 0),
+        "handoff_flip_detected": (
+            detected["handoff_flips_detected"] == n_handoff_flips > 0),
+        # no poisoned commit anywhere: the corrupted step's replay is
+        # bitwise identical to the clean run and the sample ledger is
+        # byte-exact (exactly-once across rollback + preemption)
+        "zero_poisoned_updates_committed": (
+            prefix_exact
+            and sorted(chaos.history) == steps
+            and ledger_bytes(clean) == ledger_bytes(chaos)),
+        "zero_corrupted_tokens_served": chaos_out == clean_out,
+        "recovered_without_disk": (
+            m["disk_restores"] <= budget["max_disk_restores"]
+            and chaos.reconstructions >= (1 if kill_steps else 0)),
+        "loss_trajectory_within_budget": max_rel
+        <= budget["max_loss_rel_diff"],
+        "rollback_within_mirror_cadence": chaos.last_rollback_steps
+        <= budget["max_rollback_steps"],
+        "world_restored": chaos.world == world,
+        # same plan + same workload = same flips, same detections,
+        # same trajectory — byte for byte
+        "deterministic_rerun": (
+            hist_bytes(chaos) == hist_bytes(rerun)
+            and ledger_bytes(chaos) == ledger_bytes(rerun)
+            and fired == rerun_fired
+            and chaos_out == rerun_out
+            and sfired == rerun_sfired),
+    }
+    if expect is not None:
+        gates["detection_ledger_matches_baseline"] = all(
+            detected.get(k) == v for k, v in expect.items()
+            if k in detected)
+
+    out = {
+        "metric": "sdc_chaos_detection_rate",
+        "value": 1.0 if all(gates.values()) else 0.0,
+        "unit": "fraction",
+        "vs_baseline": round(max_rel / budget["max_loss_rel_diff"], 6),
+        "plan": {"name": plan.name, "faults": len(plan.faults),
+                 "fired": fired + sfired, "budget": budget,
+                 "workload": {k: v for k, v in wk.items()
+                              if k != "guardian"}},
+        "gates": gates,
+        "detections": detected,
+        "chaos": {
+            "anomalies_detected": int(chaos.anomalies_detected),
+            "integrity_rollbacks": int(chaos.integrity_rollbacks),
+            "mirror_integrity_failures": int(
+                chaos.mirror_integrity_failures),
+            "reconstructions": int(chaos.reconstructions),
+            "disk_restores": int(m["disk_restores"]),
+            "rollback_steps": int(chaos.last_rollback_steps),
+            "handoff_fallbacks": int(sm["fleet/handoff_fallbacks"]),
+            "max_loss_rel_diff": round(max_rel, 9),
+        },
+        "platform": jax.default_backend(),
+    }
+    if capture is not None:
+        snap = dict(raw)
+        snap["expect"] = detected
+        with open(capture, "w") as f:
+            json.dump(snap, f, indent=1, sort_keys=True)
+            f.write("\n")
+        out["captured"] = capture
+    print(json.dumps(out))
+    return 0 if all(gates.values()) else 1
+
+
 def main():
     # backend init can HANG (not fail) when the accelerator runtime or
     # its tunnel is wedged; a bench that never returns is worse than an
@@ -1572,6 +1931,12 @@ if __name__ == "__main__":
         plan = (argv[i + 1] if i + 1 < len(argv)
                 and not argv[i + 1].startswith("-") else "default")
         sys.exit(_train_chaos(plan))
+    if "--sdc-chaos" in sys.argv[1:]:
+        argv = sys.argv[1:]
+        i = argv.index("--sdc-chaos")
+        plan = (argv[i + 1] if i + 1 < len(argv)
+                and not argv[i + 1].startswith("-") else "default")
+        sys.exit(_sdc_chaos(plan))
     if "--serving-sim" in sys.argv[1:]:
         argv = sys.argv[1:]
         n = int(argv[argv.index("--replicas") + 1]) \

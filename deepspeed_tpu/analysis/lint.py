@@ -73,9 +73,11 @@ _HOT_FILES = ("runtime/engine.py", "inference/engine.py",
               "runtime/hybrid_engine.py", "inference/scheduler.py",
               "inference/router.py",
               # resilience primitives live INSIDE the per-step hot
-              # paths (fault points, health observations) — a host
-              # sync added here would tax every dispatch
-              "resilience/faults.py", "resilience/health.py")
+              # paths (fault points, health observations, SDC anomaly
+              # windows) — a host sync added here would tax every
+              # dispatch
+              "resilience/faults.py", "resilience/health.py",
+              "resilience/integrity.py")
 _HOT_FN_PREFIXES = (
     "train_batch", "eval_batch", "_dispatch", "decode", "_decode",
     "generate", "put", "step", "_sample", "prefill", "_prefill",

@@ -210,21 +210,54 @@ def barrier(name: str = "barrier", timeout_s: Optional[float] = None,
 
 
 def broadcast_host(value, src: int = 0, timeout_s: Optional[float] = None,
-                   retries: int = 2):
+                   retries: int = 2, verify: bool = False):
     """Host-side metadata broadcast (ref: comm.py broadcast for small CPU
-    tensors), guarded like `barrier`. Single-host: identity."""
+    tensors), guarded like `barrier`. Single-host: identity.
+
+    verify=True rides a blake2b integrity envelope
+    (resilience/integrity.py tree_digest, carried as a uint8 array so
+    it broadcasts like any other leaf): the source digests the tree it
+    sends, every receiver re-digests the tree that LANDED, and a
+    mismatch — a bit flipped in the transport or either host's DRAM —
+    raises IntegrityError instead of silently entering the control
+    plane (docs/fault_tolerance.md SDC section). Meant for payloads
+    that steer training (elastic resume metadata, mirror bookkeeping),
+    where a silent flip poisons every host at once."""
 
     def do():
         if jax.process_count() == 1:
-            return value
-        from jax.experimental import multihost_utils
+            got = value
+            env = None
+        else:
+            from jax.experimental import multihost_utils
 
-        return multihost_utils.broadcast_one_to_all(
-            value, is_source=get_rank() == src)
+            if verify:
+                from ..resilience.integrity import tree_digest
 
-    return _guarded_collective("broadcast_host", do,
-                               replica_group=f"world(src={src})",
-                               timeout_s=timeout_s, retries=retries)
+                digest = np.frombuffer(
+                    bytes.fromhex(tree_digest(value)), np.uint8)
+                got, env = multihost_utils.broadcast_one_to_all(
+                    (value, digest), is_source=get_rank() == src)
+            else:
+                got = multihost_utils.broadcast_one_to_all(
+                    value, is_source=get_rank() == src)
+                env = None
+        if verify:
+            from ..resilience.integrity import IntegrityError, tree_digest
+
+            want = (bytes(np.asarray(env, np.uint8)).hex()
+                    if env is not None else tree_digest(value))
+            if tree_digest(got) != want:
+                raise IntegrityError(
+                    f"broadcast_host payload from rank {src} failed "
+                    f"digest verification on rank {get_rank()} — "
+                    "corrupted in transport or host DRAM")
+        return got
+
+    return _guarded_collective(
+        "broadcast_host[verified]" if verify else "broadcast_host", do,
+        replica_group=f"world(src={src})",
+        timeout_s=timeout_s, retries=retries)
 
 
 # ---------------------------------------------------------------------------

@@ -115,6 +115,43 @@ class DataTypesConfig(ConfigModel):
         return self
 
 
+class IntegrityConfig(ConfigModel):
+    """Silent-data-corruption guardian (resilience/integrity.py,
+    docs/fault_tolerance.md SDC section). `enabled` turns on (a) the
+    in-graph non-finite gradient guard in the compiled train step —
+    `precision.found_inf_in_grads` over the grad pytree, skipping the
+    optimizer update exactly like the fp16 overflow path (fp16 keeps
+    its own loss-scale-coupled check either way) — and (b) the default
+    EMA z-score anomaly detector the ElasticTrainer builds when no
+    explicit guardian is passed. Off by default: the guard adds
+    branchless selects to the compiled step, and the committed
+    MEMBUDGET/NUMERICS baselines pin the un-guarded canonical
+    programs.
+
+    zscore/window/warmup_steps/rel_floor parameterize the detector
+    (see AnomalyDetector); persistent_trips bounds how many times the
+    guardian may answer the SAME step's anomaly with a verified-mirror
+    rollback before escalating to the disk checkpoint (or raising
+    PersistentAnomalyError without one)."""
+
+    enabled: bool = False
+    zscore: float = 8.0
+    window: int = 16
+    warmup_steps: int = 4
+    rel_floor: float = 0.02
+    persistent_trips: int = 2
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.zscore <= 0 or self.window < 1 or self.warmup_steps < 1:
+            raise ValueError(
+                "integrity needs zscore > 0, window >= 1, "
+                "warmup_steps >= 1")
+        if self.persistent_trips < 1:
+            raise ValueError("integrity.persistent_trips must be >= 1")
+        return self
+
+
 class OptimizerConfig(ConfigModel):
     """ref: runtime/config.py optimizer block → ops/adam etc."""
 
@@ -537,6 +574,7 @@ class DeepSpeedTPUConfig(ConfigModel):
     bf16: BF16Config = Field(default_factory=BF16Config)
     fp16: FP16Config = Field(default_factory=FP16Config)
     data_types: DataTypesConfig = Field(default_factory=DataTypesConfig)
+    integrity: IntegrityConfig = Field(default_factory=IntegrityConfig)
     mesh: MeshConfig = Field(default_factory=MeshConfig)
     activation_checkpointing: ActivationCheckpointingConfig = Field(
         default_factory=ActivationCheckpointingConfig

@@ -40,6 +40,10 @@ from ..resilience.faults import (
     RankPreemptedError,
     fault_point,
 )
+from ..resilience.integrity import (
+    AnomalyDetector,
+    PersistentAnomalyError,
+)
 from ..resilience.redundancy import (
     PeerRedundantStore,
     UnrecoverableWorldError,
@@ -82,6 +86,7 @@ class ElasticTrainer:
         checkpoint_dir: Optional[str] = None,
         straggler_factor: float = 3.0,
         clock=time.perf_counter,
+        guardian=None,
     ):
         self.make_engine = make_engine
         self.loader = loader
@@ -96,8 +101,41 @@ class ElasticTrainer:
         self.world = int(world)
         self.generation = 0
         self.engine = self._launch(self.world)
+        self._past_mirror_integrity = 0  # failures of replaced stores
         self.store = PeerRedundantStore(
             self.world, spare=min(self.spare, self.world - 1))
+
+        # -- SDC guardian (docs/fault_tolerance.md SDC section) --------
+        # guardian: an AnomalyDetector, a dict of its kwargs (plus
+        # 'persistent_trips'), True for defaults, or None to follow the
+        # engine config's integrity block. A trip means the step's
+        # loss/grad-norm readout is not to be trusted: the step is NOT
+        # committed and the world rolls back to the last digest-
+        # verified peer mirror.
+        icfg = getattr(self.engine.config, "integrity", None)
+        self.persistent_trips = int(
+            getattr(icfg, "persistent_trips", 2) or 2)
+        if guardian is None and icfg is not None and icfg.enabled:
+            guardian = {"zscore": icfg.zscore, "window": icfg.window,
+                        "warmup": icfg.warmup_steps,
+                        "rel_floor": icfg.rel_floor,
+                        "persistent_trips": icfg.persistent_trips}
+        if guardian is True:
+            guardian = {}
+        if isinstance(guardian, dict):
+            kw = dict(guardian)
+            self.persistent_trips = int(
+                kw.pop("persistent_trips", self.persistent_trips))
+            guardian = AnomalyDetector(**kw)
+        self.guardian: Optional[AnomalyDetector] = guardian or None
+        self.anomalies_detected = 0
+        self.integrity_rollbacks = 0
+        self.skipped_steps = 0
+        # rollbacks already spent answering an anomaly AT a given step
+        # number — when the same step trips again after a verified
+        # rollback + replay, the corruption is persistent (the mirror
+        # itself is suspect) and the guardian escalates to disk
+        self._anomaly_rollbacks_at: Dict[int, int] = {}
 
         # committed trajectory: step -> loss / (epoch, sample ids).
         # A rollback TRUNCATES these — what remains is exactly the
@@ -116,6 +154,20 @@ class ElasticTrainer:
         self._data_iter = iter(loader)
 
         self.mirror()  # step-0 snapshot: recoverable from the first step
+
+    def _replace_store(self, world: int) -> None:
+        """Fresh PeerRedundantStore for a new world, carrying the old
+        store's digest-mismatch count into the trainer-lifetime
+        `mirror_integrity_failures` metric."""
+        self._past_mirror_integrity += self.store.integrity_failures
+        self.store = PeerRedundantStore(
+            world, spare=min(self.spare, world - 1))
+
+    @property
+    def mirror_integrity_failures(self) -> int:
+        """Digest mismatches seen across every reconstruct this
+        trainer ever ran (monitor.training_resilience_events)."""
+        return self._past_mirror_integrity + self.store.integrity_failures
 
     # -- generation machinery -------------------------------------------
     def _launch(self, world: int):
@@ -191,8 +243,7 @@ class ElasticTrainer:
         # the replayed steps recommit with identical sample order
         self.history = {s: v for s, v in self.history.items() if s <= step}
         self.ledger = {s: v for s, v in self.ledger.items() if s <= step}
-        self.store = PeerRedundantStore(new_world, spare=min(
-            self.spare, new_world - 1))
+        self._replace_store(new_world)
         self.mirror()
         self.reconstructions += 1
         self.last_rollback_steps = before - step
@@ -217,8 +268,7 @@ class ElasticTrainer:
         step = self.engine.global_steps
         self.history = {s: v for s, v in self.history.items() if s <= step}
         self.ledger = {s: v for s, v in self.ledger.items() if s <= step}
-        self.store = PeerRedundantStore(new_world, spare=min(
-            self.spare, new_world - 1))
+        self._replace_store(new_world)
         self.mirror()
 
     def resize(self, new_world: int) -> None:
@@ -240,8 +290,7 @@ class ElasticTrainer:
         self.engine = self._launch(self.world)
         self._compile_steps = 1
         reshard_state(self.engine, host, global_steps=step)
-        self.store = PeerRedundantStore(self.world, spare=min(
-            self.spare, self.world - 1))
+        self._replace_store(self.world)
         self.mirror()
         log_dist(
             f"elastic-trainer: resharded step {step} onto world "
@@ -266,9 +315,13 @@ class ElasticTrainer:
         raise AssertionError("unreachable")
 
     def step(self) -> Optional[Dict[str, float]]:
-        """One committed global step, or None when a preemption was
-        absorbed (recover() rolled back; the caller just keeps
-        stepping)."""
+        """One committed global step, or None when nothing was
+        committed: a preemption was absorbed (recover() rolled back),
+        the compiled step skipped itself on a non-finite gradient
+        (fp16 overflow / the integrity non-finite guard), or the SDC
+        guardian vetoed the step (anomaly -> verified-mirror
+        rollback). In every None case the caller just keeps
+        stepping."""
         batch, sample_meta = self._fetch_batch()
         t0 = self.clock()
         try:
@@ -282,6 +335,28 @@ class ElasticTrainer:
             self.recover(list(e.failed_ranks))
             return None
         wall = (self.clock() - t0) + self.engine.drain_fault_delay()
+        if metrics.get("skipped", 0):
+            # the compiled step found a non-finite gradient and skipped
+            # the update in-graph: device state (and state.step) are
+            # untouched — re-sync the host counter so the next clean
+            # step commits under the SAME step number, keeping the
+            # (step -> sample ids) ledger gap-free. The batch is
+            # consumed (reference overflow semantics); nothing is
+            # committed, and the anomaly window never sees the
+            # non-finite readout.
+            self.engine.global_steps -= 1
+            self.skipped_steps += 1
+            if self.guardian is not None:
+                self.guardian.note_skip()
+            return None
+        if self.guardian is not None:
+            verdict = self.guardian.observe(
+                {"loss": float(metrics["loss"]),
+                 "grad_norm": float(metrics["grad_norm"])})
+            if verdict != "ok":
+                self.anomalies_detected += 1
+                self._integrity_rollback(verdict)
+                return None
         self._note_step_time(wall)
         step_no = self.engine.global_steps
         self.history[step_no] = float(metrics["loss"])
@@ -289,6 +364,58 @@ class ElasticTrainer:
         if step_no % self.every_k == 0:
             self.mirror()
         return metrics
+
+    def _integrity_rollback(self, verdict: str) -> None:
+        """Answer a guardian trip: the just-run (uncommitted) step's
+        readout or update is suspect. Roll the live state back to the
+        last digest-VERIFIED peer mirror (a corrupted holder copy falls
+        over to the next holder — resilience/redundancy.py), rewind the
+        loader to the mirror boundary and replay; nothing the trip
+        tainted ever reaches the history/ledger or a mirror round. A
+        step that trips again after a verified rollback + replay is a
+        persistent corruption (the snapshot itself, or a deterministic
+        flip): escalate to the newest verified disk checkpoint, or
+        raise PersistentAnomalyError without one."""
+        before = self.engine.global_steps  # the vetoed step's number
+        spent = self._anomaly_rollbacks_at.get(before, 0)
+        if spent >= self.persistent_trips:
+            if self.checkpoint_dir is None:
+                raise PersistentAnomalyError(
+                    f"step {before} anomalous ({verdict}) after {spent} "
+                    "verified-mirror rollbacks and no checkpoint_dir to "
+                    "escalate to")
+            log_dist(
+                f"sdc-guardian: step {before} still anomalous after "
+                f"{spent} verified rollbacks; escalating to disk",
+                ranks=[0])
+            self._disk_fallback(self.world)
+            return
+        self._anomaly_rollbacks_at[before] = spent + 1
+        try:
+            step, payloads, shared = self.store.reconstruct()
+        except UnrecoverableWorldError:
+            if self.checkpoint_dir is None:
+                raise
+            self._disk_fallback(self.world)
+            return
+        dims = shared["dims"]
+        full = {k: assemble_tree({r: payloads[r][k] for r in payloads},
+                                 dims[k])
+                for k in dims}
+        # same world, same mesh: lay the verified state straight onto
+        # the live engine (no rebuild, no recompile) and rewind
+        reshard_state(self.engine, full, global_steps=step)
+        self.loader.load_state_dict(shared["loader"])
+        self._data_iter = iter(self.loader)
+        self.history = {s: v for s, v in self.history.items() if s <= step}
+        self.ledger = {s: v for s, v in self.ledger.items() if s <= step}
+        self.integrity_rollbacks += 1
+        self.last_rollback_steps = before - step
+        log_dist(
+            f"sdc-guardian: {verdict} at step {before} "
+            f"(loss/grad_norm={self.guardian.last_trip}); rolled back "
+            f"to verified mirror at step {step} and replaying "
+            f"({before - step} steps)", ranks=[0])
 
     def run(self, total_steps: int, regrow_at: Optional[int] = None,
             regrow_to: Optional[int] = None) -> Dict[int, float]:
@@ -342,6 +469,12 @@ class ElasticTrainer:
             "last_rollback_steps": float(self.last_rollback_steps),
             "disk_restores": float(
                 self.disk_restores + self.engine.disk_restores),
+            # SDC guardian feed (docs/fault_tolerance.md SDC section)
+            "anomalies_detected": float(self.anomalies_detected),
+            "integrity_rollbacks": float(self.integrity_rollbacks),
+            "skipped_steps": float(self.skipped_steps),
+            "mirror_integrity_failures": float(
+                self.mirror_integrity_failures),
             "straggler_steps": float(self.straggler_steps),
             "step_time_p50_ms": round(
                 float(np.median(st)) * 1e3, 3) if st else 0.0,

@@ -34,6 +34,11 @@ from pydantic import Field
 
 from ..config.config import ConfigModel, PrefixCacheConfig
 from ..resilience.faults import fault_point
+from ..resilience.integrity import (
+    HandoffIntegrityError,
+    corrupt_payload,
+    payload_digest,
+)
 from ..models import transformer as T
 from ..utils.logging import log_dist
 from ..utils.sync import serving_readback
@@ -796,7 +801,7 @@ class InferenceEngine:
         idx = self._pad_block_idx(seq.blocks)
         self.recompile_tracker.record("kv_transfer_gather", (idx,))
         k, v = self._kv_gather_fn()(self.cache, self._dev(idx))
-        return {
+        payload = {
             "seen_tokens": int(seq.seen_tokens),
             "n_blocks": nb,
             "token_ids": (list(seq.tokens[:seq.seen_tokens])
@@ -804,6 +809,14 @@ class InferenceEngine:
             "k": serving_readback(k)[:, :nb],
             "v": serving_readback(v)[:, :nb],
         }
+        # integrity envelope (resilience/integrity.py): blake2b over
+        # every field's bytes+dtype+shape, attached at the sender —
+        # import_kv verifies it before a single page is scattered, so
+        # a bit flipped in transit or in the receiver's DRAM falls
+        # back to the token-identical recompute path instead of
+        # serving corrupted KV
+        payload["digest"] = payload_digest(payload)
+        return payload
 
     def import_kv(self, uid: int, payload: Dict[str, Any]) -> None:
         """Adopt a sequence whose KV pages arrive from export_kv() on a
@@ -813,8 +826,24 @@ class InferenceEngine:
         prompts sharing it route here for free). Raises RuntimeError
         when the pool cannot fit the sequence — callers fall back to
         recompute (token-identical: draws key on seed/stream/position,
-        not on which replica runs them)."""
+        not on which replica runs them). Raises HandoffIntegrityError
+        BEFORE any allocation when the payload's digest envelope does
+        not verify (an in-transit/DRAM bit flip) — same fallback."""
         fault_point("engine.import_kv", uid=uid)
+        # chaos point 'handoff.payload': kind='corrupt' flips one bit
+        # in the K/V page stacks of a COPY of the payload (the
+        # in-transit SDC model) — the digest check below must catch it
+        act = fault_point("handoff.payload", uid=uid)
+        if act is not None and act.kind == "corrupt":
+            payload, flips = corrupt_payload(
+                payload, act.seed, act.invocation)
+            log_dist(f"chaos: corrupted KV handoff payload of uid "
+                     f"{uid} ({flips})", ranks=[0])
+        if "digest" in payload and \
+                payload_digest(payload) != payload["digest"]:
+            raise HandoffIntegrityError(
+                f"KV handoff payload of uid {uid} failed digest "
+                "verification — discarding (recompute fallback)")
         n_tok = int(payload["seen_tokens"])
         nb = int(payload["n_blocks"])
         k, v = payload["k"], payload["v"]

@@ -82,6 +82,7 @@ import numpy as np
 from ..config.config import ServingRouterConfig, ServingSchedulerConfig
 from ..resilience.faults import fault_point
 from ..resilience.health import CLOSED, STATE_CODE, BreakerConfig, FleetHealth
+from ..resilience.integrity import HandoffIntegrityError
 from ..utils.logging import log_dist
 from .engine import InferenceEngine
 from .scheduler import FINISHED, Request, ServingScheduler
@@ -188,6 +189,7 @@ class ServingRouter:
             "handoff_fallbacks": 0, "requeued_on_death": 0,
             "auto_failovers": 0, "replica_restores": 0,
             "shed_requests": 0, "handoff_timeouts": 0,
+            "handoff_integrity_failures": 0,
         }
 
         # -- self-healing state ------------------------------------------
@@ -438,7 +440,17 @@ class ServingRouter:
                 d = min(live, key=lambda i: (self._load(i), i))
                 try:
                     self.schedulers[d].adopt(req, payload)
-                except Exception:
+                except Exception as e:
+                    if isinstance(e, HandoffIntegrityError):
+                        # the payload's digest envelope caught an
+                        # in-transit bit flip BEFORE any page was
+                        # scattered: discard it, recompute (token-
+                        # identical — draws key on seed/stream/position)
+                        self.counters["handoff_integrity_failures"] += 1
+                        log_dist(
+                            f"serving router: KV handoff of gid={gid} "
+                            f"failed integrity verification ({e}); "
+                            "recomputing", ranks=[0])
                     self.counters["handoff_fallbacks"] += 1
                     req.handoff = False  # decode locally after recompute
                     self.schedulers[d].requeue(req)

@@ -130,7 +130,15 @@ def serving_events(scheduler, step: int,
     `fleet/replica_restores`, `fleet/shed_requests` (overload
     backpressure), `fleet/handoff_fallbacks`/`fleet/handoff_timeouts`,
     and failover->restore recovery-time percentiles
-    (`fleet/recovery_p50_ms`/`fleet/recovery_p95_ms`)."""
+    (`fleet/recovery_p50_ms`/`fleet/recovery_p95_ms`).
+
+    SDC integrity feed (docs/fault_tolerance.md SDC section):
+    `fleet/handoff_integrity_failures` — KV handoff payloads whose
+    blake2b digest envelope failed verification at import (an
+    in-transit/DRAM bit flip); each is discarded and recomputed
+    token-identically, so a nonzero count with zero output divergence
+    is the detector WORKING, while a rising rate fingers flaky
+    links/hosts."""
     metrics = scheduler.metrics()
     return [(f"{prefix}/{name}", float(value), step)
             for name, value in sorted(metrics.items())]
@@ -149,7 +157,17 @@ def training_resilience_events(trainer, step: int,
     right now would replay), mirror/reconstruction counters and the
     last reconstruction/rollback cost, disk_restores (0 while peer
     recovery holds), and per-rank step-time straggler flags
-    (`rank<i>/straggler_flags`) with step-time percentiles."""
+    (`rank<i>/straggler_flags`) with step-time percentiles.
+
+    SDC guardian feed (docs/fault_tolerance.md SDC section):
+    `anomalies_detected` — steps the EMA z-score window vetoed before
+    commit; `integrity_rollbacks` — verified-mirror rollbacks those
+    vetoes triggered; `skipped_steps` — in-graph non-finite-gradient
+    skips (fp16 overflow / the integrity guard: batch consumed,
+    nothing committed, EMA untouched); `mirror_integrity_failures` —
+    peer-mirror copies whose blake2b digest failed at reconstruct
+    (each fell over to the next holder; a nonzero count with
+    disk_restores still 0 is the fallover WORKING)."""
     metrics = trainer.resilience_metrics()
     return [(f"{prefix}/{name}", float(value), step)
             for name, value in sorted(metrics.items())]

@@ -36,6 +36,18 @@ from .health import (
     FleetHealth,
     ReplicaBreaker,
 )
+from .integrity import (
+    AnomalyDetector,
+    HandoffIntegrityError,
+    IntegrityError,
+    MirrorIntegrityError,
+    PersistentAnomalyError,
+    corrupt_payload,
+    corrupt_tree,
+    flip_bits,
+    payload_digest,
+    tree_digest,
+)
 from .redundancy import (
     PeerRedundantStore,
     RedundancyError,
@@ -52,4 +64,7 @@ __all__ = [
     "CLOSED", "OPEN", "HALF_OPEN", "HELD",
     "PeerRedundantStore", "RedundancyError", "UnrecoverableWorldError",
     "reshard_state",
+    "IntegrityError", "MirrorIntegrityError", "HandoffIntegrityError",
+    "PersistentAnomalyError", "AnomalyDetector", "flip_bits",
+    "corrupt_tree", "corrupt_payload", "tree_digest", "payload_digest",
 ]

@@ -78,6 +78,35 @@ Fault points registered across the tree (ctx keys in parens):
                                   I/O — bounded retry heals it)
   heartbeat.beat      (rank)      kind='skip' suppresses the write (a
                                   wedged-but-alive controller)
+  engine.grads        (rank,      post-step gradient readout + the
+                       step)      just-committed update (runtime/
+                                  engine.py _dispatch_step exit) —
+                                  kind='corrupt' flips an exponent bit
+                                  of the step's grad-norm/loss metrics
+                                  AND of one updated state leaf
+                                  (resilience/integrity.py): the SDC-
+                                  in-the-gradient model the training
+                                  guardian must catch BEFORE commit
+  mirror.payload      (step,      one peer-redundancy mirror entry at
+                       holder,    snapshot time (resilience/
+                       owner)     redundancy.py) — kind='corrupt'
+                                  flips a bit in that holder's copy of
+                                  the owner's shard slice; the digest
+                                  envelope catches it at reconstruct
+                                  and falls over to the next holder
+  handoff.payload     (uid)       KV handoff payload at import
+                                  (inference/engine.py import_kv) —
+                                  kind='corrupt' flips a bit in the
+                                  K/V page stacks in transit; digest
+                                  verification discards the payload
+                                  and the router recomputes
+
+kind='corrupt' payloads: `corrupt_file` flips raw bytes of a file on
+disk (checkpoint bitrot); the three in-memory points above flip bits
+of the leaf's ACTUAL dtype via resilience/integrity.py, keyed on
+(plan seed, matching invocation, leaf path) — same plan + same
+workload = same flips (the FaultAction carries `seed` and
+`invocation` for exactly this).
 """
 
 import contextlib
@@ -167,14 +196,20 @@ class FaultSpec:
 
 
 class FaultAction:
-    """Non-raising verdict of a fault point: kind + value + the spec."""
+    """Non-raising verdict of a fault point: kind + value + the spec,
+    plus the plan `seed` and the 1-based matching `invocation` count —
+    the (seed, invocation) pair keys kind='corrupt' call sites'
+    deterministic bit flips (resilience/integrity.py)."""
 
-    __slots__ = ("kind", "value", "spec")
+    __slots__ = ("kind", "value", "spec", "seed", "invocation")
 
-    def __init__(self, kind: str, value: float, spec: FaultSpec):
+    def __init__(self, kind: str, value: float, spec: FaultSpec,
+                 seed: int = 0, invocation: int = 1):
         self.kind = kind
         self.value = value
         self.spec = spec
+        self.seed = int(seed)
+        self.invocation = int(invocation)
 
     def __repr__(self):  # pragma: no cover - debug aid
         return f"FaultAction({self.kind}, {self.value})"
@@ -260,7 +295,8 @@ class FaultPlan:
                 # preempted rank for error='preempted')
                 err.spec = spec
                 raise err
-            act = FaultAction(spec.kind, spec.value, spec)
+            act = FaultAction(spec.kind, spec.value, spec,
+                              seed=self.seed, invocation=n)
         return act
 
 

@@ -1,0 +1,314 @@
+"""Silent-data-corruption guardian: runtime integrity primitives
+(docs/fault_tolerance.md SDC section).
+
+PRs 7-8 made the fleet survive *loud* failures — crashes, hangs,
+preemptions. A flipped bit in a gradient, a peer-redundancy mirror, or
+a KV handoff payload is silent: it raises nothing, and every state
+commit after it is poisoned. At fleet scale this is the dominant
+unhandled failure class (Dixit et al., "Silent Data Corruptions at
+Scale"; Hochschild et al., "Cores that don't count"). The static
+numerics sanitizer (analysis/numerics.py) pins *declared* dtypes at
+compile time; this module defends the *runtime values*:
+
+- **seeded, dtype-aware bit flips** (`flip_bits` / `corrupt_tree` /
+  `corrupt_payload`): the in-memory payload behind `FaultPlan`
+  kind='corrupt' at the `engine.grads` / `mirror.payload` /
+  `handoff.payload` fault points. Flips are keyed on
+  (plan seed, matching invocation, leaf path) — same plan + same
+  workload = same flips, bit for bit — and flip bits of the leaf's
+  ACTUAL dtype (an f32 exponent bit, a bf16 mantissa bit), not raw
+  file bytes like `faults.corrupt_file`.
+- **integrity envelopes** (`tree_digest` / `payload_digest`): blake2b
+  digests over leaf bytes + dtype + shape + path, attached to
+  `PeerRedundantStore` snapshots and `export_kv` handoff payloads and
+  verified before the data is consumed (`reconstruct` / `import_kv`).
+  A mismatch falls over to the next mirror holder / the
+  token-identical recompute path — never into committed state.
+- **anomaly detection** (`AnomalyDetector`): per-step EMA z-score
+  windows over the training loss and global grad norm, plus a
+  non-finite guard. The elastic trainer consults it BEFORE committing
+  a step to the history/ledger or mirroring it; a trip skips the
+  commit and rolls back to the last digest-verified peer mirror
+  (elasticity/trainer.py), so a corrupted update never lands.
+
+Detection thresholds are z-scores against an exponentially-weighted
+mean/variance: an exponent-class flip moves a value by orders of
+magnitude (z >> threshold), while benign training drift moves it by a
+fraction of the EMA sigma. Mantissa-tail flips below the threshold are
+by construction also below training significance; the digest
+envelopes, which are bit-exact, cover the payload paths where ANY flip
+must be caught.
+"""
+
+import hashlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "IntegrityError", "MirrorIntegrityError", "HandoffIntegrityError",
+    "PersistentAnomalyError", "flip_bits", "corrupt_tree",
+    "corrupt_payload", "tree_digest", "payload_digest",
+    "AnomalyDetector",
+]
+
+
+class IntegrityError(RuntimeError):
+    """A runtime data-integrity violation (digest mismatch or an
+    anomaly the guardian could not recover from)."""
+
+
+class MirrorIntegrityError(IntegrityError):
+    """A peer-redundancy mirror payload failed digest verification."""
+
+
+class HandoffIntegrityError(IntegrityError):
+    """A KV handoff payload failed digest verification at import —
+    callers discard it and take the token-identical recompute path."""
+
+
+class PersistentAnomalyError(IntegrityError):
+    """The anomaly survived a verified-mirror rollback and replay (the
+    mirror itself is suspect, or the corruption is deterministic) and
+    no disk checkpoint is configured to escalate to."""
+
+
+# ---------------------------------------------------------------------------
+# seeded dtype-aware bit flips (the kind='corrupt' in-memory payload)
+# ---------------------------------------------------------------------------
+
+_UINT_OF_ITEMSIZE = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
+# mantissa widths of the float dtypes we flip exponent bits in — the
+# exponent field is [mantissa_bits, nbits-2], sign bit excluded so a
+# flip changes magnitude, not direction
+_MANTISSA_BITS = {"float16": 10, "bfloat16": 7, "float32": 23,
+                  "float64": 52}
+
+
+def _rng_for(seed: int, invocation: int, path: str) -> np.random.Generator:
+    """One deterministic stream per (plan seed, matching invocation,
+    leaf path): the flip schedule is a pure function of the plan and
+    the workload, replica for replica."""
+    h = hashlib.blake2b(
+        f"{int(seed)}:{int(invocation)}:{path}".encode(), digest_size=8)
+    return np.random.default_rng(int.from_bytes(h.digest(), "little"))
+
+
+def flip_bits(arr, seed: int, invocation: int, path: str = "",
+              n_flips: int = 1,
+              bit_class: str = "any") -> Tuple[np.ndarray, List[Tuple[int, int]]]:
+    """Deterministically flip `n_flips` bits of a COPY of `arr`,
+    dtype-aware: bits are flipped in the leaf's actual machine
+    representation (an f32 word, a bf16 half-word), never in a raw
+    byte stream. bit_class='exponent' restricts float flips to the
+    exponent field — the SDC class that moves a value by orders of
+    magnitude (the detectable kind); 'any' draws over the full word
+    (digest-enveloped paths catch every bit). Returns
+    (corrupted copy, [(flat_index, bit)])."""
+    a = np.array(arr)  # copy; preserves dtype incl. ml_dtypes bfloat16
+    if a.size == 0:
+        return a, []
+    rng = _rng_for(seed, invocation, path)
+    flat = a.reshape(-1)
+    uint = flat.view(_UINT_OF_ITEMSIZE[a.dtype.itemsize])
+    nbits = a.dtype.itemsize * 8
+    mant = _MANTISSA_BITS.get(a.dtype.name)
+    log: List[Tuple[int, int]] = []
+    for _ in range(max(1, int(n_flips))):
+        idx = int(rng.integers(0, flat.size))
+        if bit_class == "exponent" and mant is not None:
+            bit = int(rng.integers(mant, nbits - 1))
+        else:
+            bit = int(rng.integers(0, nbits))
+        uint[idx] ^= uint.dtype.type(1 << bit)
+        log.append((idx, bit))
+    return a, log
+
+
+def corrupt_tree(tree, seed: int, invocation: int, leaves: int = 1,
+                 bit_class: str = "any") -> Tuple[Any, List[str]]:
+    """Flip one bit in each of `leaves` deterministically-chosen array
+    leaves of a pytree (a mirror payload, a KV page stack). Leaf choice
+    and bit choice both key on (seed, invocation, leaf path). Returns
+    (new tree — untouched leaves shared, corrupted leaves copies,
+    human-readable flip log)."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    candidates = [i for i, (_, leaf) in enumerate(flat)
+                  if getattr(np.asarray(leaf), "size", 0) > 0]
+    if not candidates:
+        return tree, []
+    rng = _rng_for(seed, invocation, "leaf-choice")
+    chosen = set(
+        candidates[int(i)] for i in rng.choice(
+            len(candidates), size=min(max(1, leaves), len(candidates)),
+            replace=False))
+    out, log = [], []
+    for i, (path, leaf) in enumerate(flat):
+        if i not in chosen:
+            out.append(leaf)
+            continue
+        pstr = jax.tree_util.keystr(path)
+        flipped, flips = flip_bits(
+            np.asarray(leaf), seed, invocation, pstr, bit_class=bit_class)
+        out.append(flipped)
+        log += [f"{pstr}[{idx}]^bit{bit}" for idx, bit in flips]
+    return jax.tree_util.tree_unflatten(treedef, out), log
+
+
+def corrupt_payload(payload: Dict[str, Any], seed: int, invocation: int,
+                    keys: Tuple[str, ...] = ("k", "v"),
+                    ) -> Tuple[Dict[str, Any], List[str]]:
+    """Flip one bit in one of a handoff payload's page-stack arrays
+    (the in-transit / receiver-DRAM SDC model). Shallow copy; only the
+    corrupted array is copied. The attached digest is left as-is — the
+    whole point is that verification must catch the mismatch."""
+    rng = _rng_for(seed, invocation, "payload-key")
+    present = [k for k in keys if k in payload]
+    if not present:
+        return payload, []
+    key = present[int(rng.integers(0, len(present)))]
+    flipped, flips = flip_bits(
+        np.asarray(payload[key]), seed, invocation, key)
+    out = dict(payload)
+    out[key] = flipped
+    return out, [f"{key}[{idx}]^bit{bit}" for idx, bit in flips]
+
+
+# ---------------------------------------------------------------------------
+# integrity envelopes: blake2b digests over leaf bytes+dtype+shape+path
+# ---------------------------------------------------------------------------
+
+def _update_leaf(h, name: str, leaf) -> None:
+    h.update(name.encode())
+    if leaf is None:
+        h.update(b"<none>")
+        return
+    arr = np.asarray(leaf)
+    h.update(str(arr.dtype).encode())
+    h.update(str(arr.shape).encode())
+    h.update(np.ascontiguousarray(arr).tobytes())
+
+
+def tree_digest(tree, digest_size: int = 16) -> str:
+    """blake2b hex digest of a host pytree: every leaf's path, dtype,
+    shape, and bytes. Bit-exact — any single flip anywhere changes the
+    digest. Used for peer-mirror payload envelopes."""
+    import jax
+
+    h = hashlib.blake2b(digest_size=digest_size)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        _update_leaf(h, jax.tree_util.keystr(path), leaf)
+    return h.hexdigest()
+
+
+def payload_digest(payload: Dict[str, Any],
+                   exclude: Tuple[str, ...] = ("digest",),
+                   digest_size: int = 16) -> str:
+    """blake2b hex digest of a flat dict payload (the export_kv
+    handoff envelope): keys in sorted order, the digest field itself
+    excluded so the envelope can ride inside the payload."""
+    h = hashlib.blake2b(digest_size=digest_size)
+    for key in sorted(payload):
+        if key in exclude:
+            continue
+        _update_leaf(h, key, payload[key])
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# the anomaly detector: EMA z-score windows + non-finite guard
+# ---------------------------------------------------------------------------
+
+class AnomalyDetector:
+    """Per-step anomaly detection over scalar training signals (loss,
+    global grad norm).
+
+    Each signal keeps an exponentially-weighted mean and variance
+    (alpha = 2/(window+1)). An observation is anomalous when its
+    |z-score| exceeds `zscore` against sigma_eff =
+    max(EMA sigma, rel_floor * |EMA mean|) — the relative floor keeps
+    near-constant signals (a converged loss) from tripping on noise a
+    thousand times smaller than the value. Non-finite values trip
+    immediately regardless of the window.
+
+    Contract with the caller (elasticity/trainer.py):
+
+    - the first `warmup` observations per signal only feed the window
+      (compile-step values and init transients are exempt — they can
+      never trip);
+    - an anomalous observation is NOT absorbed into the window, so a
+      corrupted step cannot widen sigma and mask the next one;
+    - `note_skip()` records an in-graph skipped step (fp16 overflow /
+      the non-finite gradient guard) without touching the window."""
+
+    def __init__(self, zscore: float = 8.0, window: int = 16,
+                 warmup: int = 4, rel_floor: float = 0.02):
+        if zscore <= 0 or window < 1 or warmup < 1:
+            raise ValueError("zscore > 0, window >= 1, warmup >= 1")
+        self.zscore = float(zscore)
+        self.alpha = 2.0 / (float(window) + 1.0)
+        self.warmup = int(warmup)
+        self.rel_floor = float(rel_floor)
+        self._stats: Dict[str, Tuple[float, float, int]] = {}  # mean, var, n
+        self.observed = 0
+        self.trips = 0
+        self.nonfinite_trips = 0
+        self.consecutive_trips = 0
+        self.skips = 0
+        self.last_trip: Optional[Dict[str, float]] = None
+
+    def _absorb(self, name: str, x: float) -> None:
+        mean, var, n = self._stats.get(name, (x, 0.0, 0))
+        d = x - mean
+        mean += self.alpha * d
+        var = (1.0 - self.alpha) * (var + self.alpha * d * d)
+        self._stats[name] = (mean, var, n + 1)
+
+    def zscores(self, signals: Dict[str, float]) -> Dict[str, float]:
+        out = {}
+        for name, x in signals.items():
+            mean, var, n = self._stats.get(name, (0.0, 0.0, 0))
+            if n < self.warmup:
+                out[name] = 0.0
+                continue
+            sigma = max(var, 0.0) ** 0.5
+            sigma_eff = max(sigma, self.rel_floor * abs(mean), 1e-12)
+            out[name] = abs(float(x) - mean) / sigma_eff
+        return out
+
+    def observe(self, signals: Dict[str, float]) -> str:
+        """Feed one committed-candidate step's signals; returns 'ok',
+        'anomaly' (a z-score trip), or 'nonfinite'."""
+        self.observed += 1
+        vals = {k: float(v) for k, v in signals.items()}
+        if any(not np.isfinite(v) for v in vals.values()):
+            self.trips += 1
+            self.nonfinite_trips += 1
+            self.consecutive_trips += 1
+            self.last_trip = vals
+            return "nonfinite"
+        zs = self.zscores(vals)
+        if any(z > self.zscore for z in zs.values()):
+            self.trips += 1
+            self.consecutive_trips += 1
+            self.last_trip = vals
+            return "anomaly"
+        self.consecutive_trips = 0
+        for name, x in vals.items():
+            self._absorb(name, x)
+        return "ok"
+
+    def note_skip(self) -> None:
+        """An in-graph skipped step (found-inf): counted, window
+        untouched — a skip must not poison the EMA statistics."""
+        self.skips += 1
+
+    def metrics(self) -> Dict[str, float]:
+        return {
+            "anomaly_observed": float(self.observed),
+            "anomaly_trips": float(self.trips),
+            "anomaly_nonfinite_trips": float(self.nonfinite_trips),
+            "anomaly_skips": float(self.skips),
+        }

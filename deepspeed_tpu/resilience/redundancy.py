@@ -34,6 +34,10 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .faults import fault_point
+from .integrity import corrupt_tree, tree_digest
+from ..utils.logging import log_dist
+
 __all__ = [
     "RedundancyError", "UnrecoverableWorldError", "PeerRedundantStore",
     "slice_tree", "assemble_tree", "engine_shard_dims",
@@ -147,6 +151,11 @@ class PeerRedundantStore:
         self.bytes_mirrored = 0
         self.reconstructions = 0
         self.last_reconstruction_s = 0.0
+        # integrity envelope: per-owner blake2b digest of the payload
+        # at snapshot time (tiny; conceptually replicated to every
+        # holder with the shared metadata, so any survivor can verify)
+        self._digests: Dict[int, str] = {}
+        self.integrity_failures = 0  # digest mismatches seen at reconstruct
 
     def holders_of(self, owner: int) -> List[int]:
         return [(owner + i * self.stride) % self.world
@@ -164,11 +173,31 @@ class PeerRedundantStore:
                 f"snapshot needs payloads for ranks 0..{self.world - 1}, "
                 f"got {sorted(payloads)}")
         self._local = dict(payloads)
+        # digests BEFORE mirroring: the envelope certifies the payload
+        # as read from the live state, so any later DRAM flip in a
+        # holder's copy (or the owner's own) is a mismatch
+        self._digests = {owner: tree_digest(payload)
+                         for owner, payload in payloads.items()}
         self._mirror = {r: {} for r in range(self.world)}
         nbytes = 0
         for owner, payload in payloads.items():
             for holder in self.holders_of(owner):
-                self._mirror[holder][owner] = payload
+                mirrored = payload
+                # chaos point: one invocation PER mirror entry, so a
+                # plan's `where` pins exactly (holder, owner) — an
+                # injected flip lands in that holder's copy only (the
+                # corrupt_tree copy never aliases the local payload)
+                act = fault_point("mirror.payload", step=int(step),
+                                  holder=holder, owner=owner)
+                if act is not None and act.kind == "corrupt":
+                    mirrored, flips = corrupt_tree(
+                        payload, act.seed, act.invocation,
+                        bit_class="any")
+                    log_dist(
+                        f"chaos: corrupted mirror copy of rank {owner} "
+                        f"held by rank {holder} at step {step} "
+                        f"({flips})", ranks=[0])
+                self._mirror[holder][owner] = mirrored
                 nbytes += int(sum(x.nbytes
                                   for x in jax.tree.leaves(payload)))
         self._shared = {r: shared for r in range(self.world)}
@@ -198,25 +227,53 @@ class PeerRedundantStore:
             missing.append(r)
         return (not missing), missing
 
-    def reconstruct(self) -> Tuple[int, Dict[int, Any], Any]:
+    def _sources_of(self, r: int):
+        """Surviving (label, payload) candidates for rank r's slice, in
+        preference order: the rank's own copy first, then its holders'
+        mirrors by stride order."""
+        if r in self._local:
+            yield f"local[{r}]", self._local[r]
+        for h in self.holders_of(r):
+            if h not in self.lost and r in self._mirror.get(h, {}):
+                yield f"mirror[{h}]", self._mirror[h][r]
+
+    def reconstruct(self, verify: bool = True
+                    ) -> Tuple[int, Dict[int, Any], Any]:
         """(step, complete rank->payload map, shared metadata) assembled
-        from SURVIVING hosts only. Raises UnrecoverableWorldError when
-        a slice is gone everywhere."""
+        from SURVIVING hosts only — and, with `verify` (the default),
+        only from copies whose blake2b digest matches the snapshot-time
+        envelope: a bit-flipped copy is skipped (counted in
+        `integrity_failures`) and the next holder's mirror is used
+        instead, so a silent DRAM corruption can never be resharded
+        into live state. Raises UnrecoverableWorldError when no
+        (verified) copy of some slice survives."""
         t0 = time.perf_counter()
-        ok, missing = self.recoverable()
-        if not ok:
-            raise UnrecoverableWorldError(missing)
         if self.step is None:
+            ok, missing = self.recoverable()
+            if not ok:
+                raise UnrecoverableWorldError(missing)
             raise RedundancyError("reconstruct before any snapshot")
         payloads = {}
+        missing: List[int] = []
         for r in range(self.world):
-            if r in self._local:
-                payloads[r] = self._local[r]
+            want = self._digests.get(r) if verify else None
+            found = None
+            for label, payload in self._sources_of(r):
+                if want is not None and tree_digest(payload) != want:
+                    self.integrity_failures += 1
+                    log_dist(
+                        f"peer-redundancy: digest mismatch on rank "
+                        f"{r}'s copy at {label} (step {self.step}); "
+                        "falling over to the next holder", ranks=[0])
+                    continue
+                found = payload
+                break
+            if found is None:
+                missing.append(r)
             else:
-                holder = next(h for h in self.holders_of(r)
-                              if h not in self.lost
-                              and r in self._mirror.get(h, {}))
-                payloads[r] = self._mirror[holder][r]
+                payloads[r] = found
+        if missing:
+            raise UnrecoverableWorldError(missing)
         shared = next(iter(self._shared.values())) if self._shared else None
         self.reconstructions += 1
         self.last_reconstruction_s = time.perf_counter() - t0

@@ -47,6 +47,7 @@ from .precision import (
     LossScaleState,
     cast_params,
     clip_grads_by_global_norm,
+    found_inf_in_grads,
     global_grad_norm,
     init_loss_scale,
     update_loss_scale,
@@ -865,6 +866,15 @@ class DeepSpeedTPUEngine:
         fetch_params = self._make_param_fetch()
         finish = self._make_finalizer()
 
+        # runtime non-finite gradient guard (integrity block,
+        # docs/fault_tolerance.md SDC section): outside fp16 a NaN/Inf
+        # gradient would silently poison master + optimizer state —
+        # with integrity.enabled the step skips the update in-graph,
+        # exactly like the fp16 overflow path but without loss-scale
+        # coupling. Off by default: the selects change the canonical
+        # HLO pinned by MEMBUDGET/NUMERICS.
+        nonfinite_guard = (not fp16) and cfg.integrity.enabled
+
         def step_fn(state: TrainState, batch):
             master = (
                 state.master
@@ -881,6 +891,8 @@ class DeepSpeedTPUEngine:
                 # any inf/nan leaf makes the sum-of-squares norm non-finite,
                 # so this single check subsumes a per-leaf isfinite pass
                 found_inf = jnp.logical_not(jnp.isfinite(grad_norm))
+            elif nonfinite_guard:
+                found_inf = found_inf_in_grads(grads)
             else:
                 found_inf = jnp.bool_(False)
             grads = clip_grads_by_global_norm(grads, clip, grad_norm)
@@ -889,7 +901,7 @@ class DeepSpeedTPUEngine:
             lr = schedule(state.step)
             new_master, new_opt = optimizer.update(grads, state.opt, master, lr, new_step)
 
-            if fp16:
+            if fp16 or nonfinite_guard:
                 # skip the update on overflow (ref: fused_optimizer.py step
                 # overflow path) — select is branchless and free on TPU.
                 sel = lambda new, old: jax.tree.map(
@@ -897,8 +909,9 @@ class DeepSpeedTPUEngine:
                 )
                 new_master = sel(new_master, master)
                 new_opt = sel(new_opt, state.opt)
-                new_ls = update_loss_scale(state.loss_scale, found_inf, cfg.fp16)
                 new_step = jnp.where(found_inf, state.step, new_step)
+            if fp16:
+                new_ls = update_loss_scale(state.loss_scale, found_inf, cfg.fp16)
             else:
                 new_ls = state.loss_scale
 
@@ -1566,6 +1579,62 @@ class DeepSpeedTPUEngine:
                           step=self.global_steps + 1)
         if act is not None and act.kind == "delay":
             self.fault_delay_s += act.value
+        metrics = self._dispatch_step_inner(batch)
+        # chaos fault point 'engine.grads' fires AFTER the compiled
+        # step, BEFORE the caller can commit anything: kind='corrupt'
+        # models a silent bit flip in the gradient path by flipping an
+        # exponent bit of the step's grad-norm/loss readout AND of one
+        # just-updated persistent-state leaf (the update that flipped
+        # gradient produced). The training guardian
+        # (elasticity/trainer.py) must catch it through the anomaly
+        # window before the step is committed or mirrored.
+        cact = fault_point("engine.grads", rank=jax.process_index(),
+                           step=self.global_steps + 1)
+        if cact is not None and cact.kind == "corrupt":
+            metrics = self._corrupt_step_outputs(cact, metrics)
+        return metrics
+
+    def _corrupt_step_outputs(self, act, metrics) -> Dict[str, Any]:
+        """The 'engine.grads' kind='corrupt' payload: seeded
+        exponent-class bit flips (resilience/integrity.py) on the
+        step's loss/grad_norm metrics and on one leaf of the
+        just-updated persistent state (master when one exists, else
+        params) — chaos-lane only; never reached disarmed."""
+        from ..resilience import integrity
+
+        out = dict(metrics)
+        for name in ("grad_norm", "loss"):
+            if name in out:
+                host = np.asarray(jax.device_get(out[name]))
+                out[name], _ = integrity.flip_bits(
+                    host, act.seed, act.invocation, f"metrics.{name}",
+                    bit_class="exponent")
+        target = "master" if self.state.master is not None else "params"
+        tree = getattr(self.state, target)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        float_ix = [i for i, (_, leaf) in enumerate(flat)
+                    if jnp.issubdtype(leaf.dtype, jnp.floating)]
+        flips: list = []
+        if float_ix:
+            ix = float_ix[act.invocation % len(float_ix)]
+            path, leaf = flat[ix]
+            host = np.asarray(jax.device_get(leaf))
+            flipped, flips = integrity.flip_bits(
+                host, act.seed, act.invocation,
+                jax.tree_util.keystr(path), bit_class="exponent")
+            leaves = [leaf for _, leaf in flat]
+            leaves[ix] = jax.device_put(
+                flipped.astype(host.dtype), leaf.sharding)
+            self.state = dataclasses.replace(
+                self.state,
+                **{target: jax.tree_util.tree_unflatten(treedef, leaves)})
+        log_dist(
+            f"chaos: injected SDC at step {self.global_steps + 1} — "
+            f"flipped exponent bits in step metrics and {target} "
+            f"({flips})", ranks=[0])
+        return out
+
+    def _dispatch_step_inner(self, batch) -> Dict[str, Any]:
         if self._offload:
             return self._dispatch_offload_step(batch)
         if self._zoadam:

@@ -312,9 +312,20 @@ def _serving_sim():
 # times on a shared noisy host made the ratios flap ±25% run to run —
 # the signal here is control-plane behavior (batching width, routing
 # locality, prefill tokens avoided), which the model prices uniformly
-# across every lane.
-C_DISPATCH, C_TOKEN = 2e-3, 5e-5
-C_XFER, C_BLOCK = 5e-4, 1e-4
+# across every lane. The constants live in inference/pressure.py since
+# PR 10 — the scheduler's SLO admission estimate and this simulator
+# must price work with ONE authority — and are re-exported here LAZILY
+# (importing the package at module scope would import jax before the
+# lanes pin JAX_PLATFORMS=cpu).
+C_DISPATCH = C_TOKEN = C_XFER = C_BLOCK = None
+
+
+def _load_cost_model():
+    global C_DISPATCH, C_TOKEN, C_XFER, C_BLOCK
+    from deepspeed_tpu.inference import pressure as _p
+
+    C_DISPATCH, C_TOKEN = _p.C_DISPATCH, _p.C_TOKEN
+    C_XFER, C_BLOCK = _p.C_XFER, _p.C_BLOCK
 
 
 def _fleet_lane(build_engine, n_replicas, router_cfg, trace, seed=0,
@@ -429,6 +440,7 @@ def _router_sim(n_replicas: int):
     from deepspeed_tpu.inference import init_inference
     from deepspeed_tpu.models import transformer as T
 
+    _load_cost_model()
     mcfg = T.TransformerConfig(
         vocab_size=256, n_layers=2, n_heads=4, d_model=64,
         max_seq=160, variant="llama", use_flash=False)
@@ -758,6 +770,7 @@ def _chaos_sim(n_replicas: int, plan_arg: str):
     from deepspeed_tpu.models import transformer as T
     from deepspeed_tpu.resilience import FaultPlan
 
+    _load_cost_model()
     if plan_arg == "default":
         plan = FaultPlan.from_dict(_default_chaos_plan(n_replicas))
     else:
@@ -1438,6 +1451,316 @@ def _sdc_chaos(plan_arg: str, capture=None):
     return 0 if all(gates.values()) else 1
 
 
+# ---------------------------------------------------------------------------
+# overload lane: the pressure governor under a 4x-capacity burst
+# ---------------------------------------------------------------------------
+
+def _default_overload_plan() -> dict:
+    """The CI overload plan (scripts/ds_overload.py gates on it; the
+    committed OVERLOAD.json carries this dict plus the expected
+    pressure/spill ledger). The workload is a BURST: every request
+    arrives inside a window ~4x shorter than one replica can serve it
+    in, against a KV pool sized so the batch cannot hold — sustained
+    preemption pressure by construction. The pressure governor must
+    (a) climb to RED and answer preemption with spill-to-host instead
+    of flush-and-recompute, (b) resume spilled sequences by block
+    import token-identically, (c) fall back to recompute with zero
+    token loss when the armed 'spill.io' faults kill one spill put and
+    one resume get, and (d) reject the unservable deadline-carrying
+    requests at submit with zero KV blocks touched."""
+    return {
+        "name": "overload-default",
+        "seed": 0,
+        "budget": {},
+        "workload": {
+            # 40 requests, ~50-95 tokens of service each, arriving
+            # 1 ms apart: offered load ~4x the modeled service rate
+            "requests": 40, "burst_interarrival_s": 0.001,
+            "prompt_tokens": [24, 48], "max_new_tokens": [24, 48],
+            # every 4th request is 'interactive': 30 ms TTFT deadline,
+            # unservable once the burst queue builds
+            "deadline_every": 4, "deadline_s": 0.03,
+            # pool sized to force pressure: 20 blocks x 16 tokens
+            # cannot hold 8 concurrent ~60-token sequences growing to
+            # their output budgets — decode growth must preempt
+            "num_kv_blocks": 20, "kv_block_size": 16,
+            "max_batch_size": 8, "max_num_batched_tokens": 64,
+            "pressure": {"enabled": True, "yellow": 0.55, "red": 0.8,
+                         "brownout": 0.97, "spill_host_mb": 64.0},
+            "max_preemptions": 8,
+        },
+        "faults": [
+            # the 2nd spill export is lost mid-put: the victim must
+            # fall back to flush-and-recompute, token-identically
+            {"point": "spill.io", "kind": "raise", "error": "io",
+             "where": {"op": "put"}, "at": 2, "times": 1},
+            # one resume readback dies AFTER the payload left the
+            # tier: same fallback, zero token loss
+            {"point": "spill.io", "kind": "raise", "error": "io",
+             "where": {"op": "get"}, "at": 3, "times": 1},
+        ],
+    }
+
+
+def _overload_lane(build_engine, sched_cfg, trace, plan=None):
+    """Serve one burst trace on a SINGLE scheduler under the virtual
+    clock (the deterministic C_DISPATCH/C_TOKEN cost model — wall time
+    never enters any gated number). Arrivals are delivered once the
+    clock passes them; idle ticks jump the clock to the next arrival.
+    Returns (scheduler, per-request records, fired-fault log)."""
+    from deepspeed_tpu.inference import ServingScheduler
+    from deepspeed_tpu.resilience import armed
+
+    sched = ServingScheduler(build_engine(), sched_cfg, seed=0)
+    n = len(trace)
+
+    def run():
+        vt, i, stalls = 0.0, 0, 0
+        rid_of = {}
+        while i < n or sched.has_work:
+            while i < n and trace[i][0] <= vt:
+                t_arr, prompt, max_new, deadline = trace[i]
+                rid_of[i] = sched.submit(prompt, max_new, stream=i,
+                                         deadline_s=deadline)
+                i += 1
+            steps0 = sched.counters["steps"]
+            toks0 = sched.counters["batched_tokens"]
+            progressed = sched.step()
+            vt += (C_DISPATCH * (sched.counters["steps"] - steps0)
+                   + C_TOKEN * (sched.counters["batched_tokens"] - toks0))
+            if progressed:
+                stalls = 0
+                continue
+            if i < n:
+                vt = max(vt, trace[i][0])
+                continue
+            stalls += 1
+            if stalls > 1000:
+                # the anti-livelock gate: work pending, nothing moving
+                return rid_of, True
+        return rid_of, False
+
+    if plan is not None:
+        with armed(plan) as p:
+            rid_of, livelocked = run()
+            fired = list(p.fired)
+    else:
+        rid_of, livelocked = run()
+        fired = []
+    recs = {}
+    for k, rid in rid_of.items():
+        req = sched.finished.get(rid)
+        recs[k] = {
+            "output": list(req.output) if req else None,
+            "finish_reason": req.finish_reason if req else None,
+            "preemptions": req.preemptions if req else 0,
+        }
+    return sched, recs, fired, livelocked
+
+
+def _overload_sim(plan_arg: str, capture=None):
+    """Overload chaos gate (scripts/ds_overload.py;
+    docs/fault_tolerance.md pressure section): a 4x-capacity burst
+    with the pressure governor + spill tier on, served four times —
+    an UNPRESSURED reference (huge pool, no deadlines), the overload
+    pass, the overload pass with armed spill-path faults, and a rerun
+    of the armed pass — asserting zero livelock (every admitted
+    request finishes), spill->resume token identity vs the unpressured
+    run, recompute fallback with zero token loss under injected spill
+    faults, deadline rejections that touch zero KV blocks, and a
+    byte-identical rerun. With `capture`, writes the committed
+    OVERLOAD.json (plan + measured pressure/spill ledger)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference import RED, init_inference
+    from deepspeed_tpu.models import transformer as T
+    from deepspeed_tpu.resilience import FaultPlan
+
+    _load_cost_model()
+    root = os.path.dirname(os.path.abspath(__file__))
+    committed = os.path.join(root, "OVERLOAD.json")
+    expect = None
+    if plan_arg == "default":
+        if os.path.exists(committed) and capture is None:
+            raw = json.load(open(committed))
+            expect = raw.get("expect")
+        else:
+            raw = _default_overload_plan()
+    else:
+        raw = json.load(open(plan_arg))
+        expect = raw.get("expect")
+    plan = FaultPlan.from_dict(raw)
+    wk = {**_default_overload_plan()["workload"],
+          **raw.get("workload", {})}
+
+    mcfg = T.TransformerConfig(
+        vocab_size=256, n_layers=2, n_heads=4, d_model=64,
+        max_seq=160, variant="llama", use_flash=False)
+    params = T.init(mcfg, jax.random.PRNGKey(0))
+
+    def build_engine(num_blocks):
+        return init_inference(
+            params, mcfg,
+            dict(max_seq_len=128, kv_block_size=int(wk["kv_block_size"]),
+                 num_kv_blocks=num_blocks,
+                 min_prefill_bucket=16,
+                 max_batch_size=int(wk["max_batch_size"])),
+            dtype=jnp.float32)
+
+    # the burst: n requests arriving burst_interarrival_s apart —
+    # offered load ~4x the modeled service rate of one replica
+    rng = np.random.default_rng(plan.seed)
+    n_req = int(wk["requests"])
+    lo_p, hi_p = wk["prompt_tokens"]
+    lo_m, hi_m = wk["max_new_tokens"]
+    every = int(wk["deadline_every"])
+    trace = []
+    for k in range(n_req):
+        prompt = list(rng.integers(0, 256, int(rng.integers(lo_p, hi_p))))
+        max_new = int(rng.integers(lo_m, hi_m))
+        deadline = (float(wk["deadline_s"])
+                    if every > 0 and k % every == every - 1 else None)
+        trace.append((k * float(wk["burst_interarrival_s"]), prompt,
+                      max_new, deadline))
+
+    sched_cfg = {
+        "max_num_batched_tokens": int(wk["max_num_batched_tokens"]),
+        "prefill_chunk": 16,
+        "max_preemptions": int(wk["max_preemptions"]),
+        "pressure": dict(wk["pressure"]),
+    }
+    # reference: a pool deep enough that pressure never exists, no
+    # deadlines — the token-identity oracle (draws key on
+    # seed/stream/position, so pressure must never show in outputs)
+    ref_trace = [(t, p, m, None) for t, p, m, _ in trace]
+    ref_cfg = dict(sched_cfg, pressure={"enabled": False})
+    _, ref_recs, _, ref_lock = _overload_lane(
+        lambda: build_engine(256), ref_cfg, ref_trace)
+
+    nb = int(wk["num_kv_blocks"])
+    clean_s, clean_recs, _, clean_lock = _overload_lane(
+        lambda: build_engine(nb), sched_cfg, trace)
+    plan.reset()
+    armed_s, armed_recs, fired, armed_lock = _overload_lane(
+        lambda: build_engine(nb), sched_cfg, trace, plan=plan)
+    plan.reset()
+    rerun_s, rerun_recs, rerun_fired, rerun_lock = _overload_lane(
+        lambda: build_engine(nb), sched_cfg, trace, plan=plan)
+
+    def completed_match(recs):
+        """Every request that FINISHED serving (not deadline-rejected)
+        must match the unpressured reference token for token."""
+        for k in range(n_req):
+            if recs[k]["finish_reason"] == "deadline":
+                continue
+            if recs[k]["output"] != ref_recs[k]["output"]:
+                return False
+        return True
+
+    def all_admitted_finished(recs):
+        return all(recs[k]["finish_reason"] is not None
+                   for k in range(n_req))
+
+    def rejected_clean(sched, recs):
+        """Deadline rejections consumed nothing: the request carries no
+        output/uid/cache credit, and after the drain every pool block
+        is back (free or parked) — nothing leaked."""
+        rej = [sched.finished[rid] for rid in sched.finished
+               if sched.finished[rid].finish_reason == "deadline"]
+        if not rej:
+            return False
+        alloc = sched.engine.state.allocator
+        return (all(r.uid is None and not r.output and r.n_cached == 0
+                    for r in rej)
+                and alloc.available_blocks == alloc.total_blocks
+                and sched.spill_store.used_bytes == 0)
+
+    def ledger(sched, recs, fired_log):
+        c = sched.counters
+        return {
+            "spills": int(c["spills"]),
+            "spill_resumes": int(c["spill_resumes"]),
+            "spill_fallbacks": int(c["spill_fallbacks"]),
+            "spill_rejects": int(c["spill_rejects"]),
+            "deadline_rejections": int(c["deadline_rejections"]),
+            "preemptions": int(c["preemptions"]),
+            "starvation_protected": int(c["starvation_protected"]),
+            "parked_trimmed": int(
+                sched.governor.counters["parked_trimmed"]),
+            "max_pressure_level": int(sched.governor.max_level),
+            "fired": list(fired_log),
+        }
+
+    clean_led = ledger(clean_s, clean_recs, [])
+    armed_led = ledger(armed_s, armed_recs, fired)
+    rerun_led = ledger(rerun_s, rerun_recs, rerun_fired)
+
+    gates = {
+        # zero livelock: every admitted request finishes in every pass
+        "no_livelock_every_admitted_request_finishes": (
+            not (ref_lock or clean_lock or armed_lock or rerun_lock)
+            and all_admitted_finished(clean_recs)
+            and all_admitted_finished(armed_recs)),
+        # the governor actually exercised the spill path under RED
+        "spill_path_exercised_under_red": (
+            clean_led["max_pressure_level"] >= RED
+            and clean_led["spills"] >= 1
+            and clean_led["spill_resumes"] >= 1),
+        # spilled/resumed outputs == the unpressured run, token for token
+        "spill_resume_token_identical": completed_match(clean_recs),
+        # injected spill faults fell back to recompute, zero token loss
+        "spill_fault_falls_back_to_recompute": (
+            armed_led["spill_fallbacks"] >= 1 and len(fired) >= 1
+            and completed_match(armed_recs)),
+        # SLO admission rejected the unservable deadlines BEFORE any
+        # block allocation, and nothing leaked
+        "deadline_rejects_consume_no_blocks": (
+            clean_led["deadline_rejections"] >= 1
+            and rejected_clean(clean_s, clean_recs)
+            and rejected_clean(armed_s, armed_recs)),
+        # same plan + same trace = same spills, same fallbacks, same
+        # tokens — byte for byte
+        "deterministic_rerun": (
+            json.dumps([armed_recs, armed_led], sort_keys=True)
+            == json.dumps([rerun_recs, rerun_led], sort_keys=True)),
+    }
+    detected = {k: v for k, v in armed_led.items() if k != "fired"}
+    detected["clean_spills"] = clean_led["spills"]
+    detected["clean_spill_resumes"] = clean_led["spill_resumes"]
+    detected["clean_deadline_rejections"] = clean_led[
+        "deadline_rejections"]
+    if expect is not None:
+        gates["ledger_matches_baseline"] = all(
+            detected.get(k) == v for k, v in expect.items()
+            if k in detected)
+
+    out = {
+        "metric": "overload_sim_gates_green",
+        "value": 1.0 if all(gates.values()) else 0.0,
+        "unit": "fraction",
+        "vs_baseline": 1.0,
+        "plan": {"name": plan.name, "faults": len(plan.faults),
+                 "fired": fired,
+                 "workload": {k: v for k, v in wk.items()}},
+        "gates": gates,
+        "ledger": {"clean": {k: v for k, v in clean_led.items()
+                             if k != "fired"},
+                   "armed": detected},
+        "platform": jax.default_backend(),
+    }
+    if capture is not None:
+        snap = dict(raw)
+        snap["expect"] = detected
+        with open(capture, "w") as f:
+            json.dump(snap, f, indent=1, sort_keys=True)
+            f.write("\n")
+        out["captured"] = capture
+    print(json.dumps(out))
+    return 0 if all(gates.values()) else 1
+
+
 def main():
     # backend init can HANG (not fail) when the accelerator runtime or
     # its tunnel is wedged; a bench that never returns is worse than an
@@ -1937,6 +2260,12 @@ if __name__ == "__main__":
         plan = (argv[i + 1] if i + 1 < len(argv)
                 and not argv[i + 1].startswith("-") else "default")
         sys.exit(_sdc_chaos(plan))
+    if "--overload-sim" in sys.argv[1:]:
+        argv = sys.argv[1:]
+        i = argv.index("--overload-sim")
+        plan = (argv[i + 1] if i + 1 < len(argv)
+                and not argv[i + 1].startswith("-") else "default")
+        sys.exit(_overload_sim(plan))
     if "--serving-sim" in sys.argv[1:]:
         argv = sys.argv[1:]
         n = int(argv[argv.index("--replicas") + 1]) \

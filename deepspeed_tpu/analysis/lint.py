@@ -72,6 +72,12 @@ _PRAGMA_RE = re.compile(
 _HOT_FILES = ("runtime/engine.py", "inference/engine.py",
               "runtime/hybrid_engine.py", "inference/scheduler.py",
               "inference/router.py",
+              # the pressure governor + SLO admission estimate run
+              # once per scheduling iteration, and the spill tier sits
+              # on the preemption path — host syncs here tax every
+              # dispatch under exactly the pressure they exist to
+              # relieve
+              "inference/pressure.py",
               # resilience primitives live INSIDE the per-step hot
               # paths (fault points, health observations, SDC anomaly
               # windows) — a host sync added here would tax every
@@ -90,6 +96,11 @@ _HOT_FN_PREFIXES = (
     "fault_point", "_hit", "observe", "probe", "_probe", "due_probe",
     "note_step_result", "poll_health", "restore_replica", "_shed",
     "drain_fault_delay",
+    # pressure governor / spill tier / SLO admission (PR 10): all run
+    # per scheduling iteration or on the preemption path
+    "update", "occupancy", "watermark_scale", "estimate_ttft",
+    "_try_spill", "_resume_from_spill", "_brownout", "_pressure",
+    "_decode_can_take", "_fleet_brownout", "trim_parked",
 )
 _SYNC_CALLS = ("block_until_ready", "device_get")
 # serving_readback: the scheduler loop's one named readback point

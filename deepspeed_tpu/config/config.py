@@ -285,6 +285,66 @@ class PrefixCacheConfig(ConfigModel):
     pool_blocks: int = -1
 
 
+class PressureConfig(ConfigModel):
+    """Memory-pressure governor for the serving scheduler
+    (inference/pressure.py PressureGovernor; docs/fault_tolerance.md
+    pressure section). Off by default: the committed serving baselines
+    (MEMBUDGET / serving-sim / chaos lanes) pin the un-governed control
+    plane, and flush-and-recompute preemption stays the legacy
+    behavior until a deployment opts in.
+
+    Watermarks are LIVE block-pool occupancy fractions (parked
+    prefix-cache blocks are evictable headroom, not pressure), scaled
+    down when the S004 warmup footprint crowds the HBM budget past
+    `static_headroom` (see PressureGovernor.watermark_scale):
+
+      occupancy >= yellow    evict up to yellow_trim_blocks LRU-parked
+                             prefix-cache blocks per iteration
+      occupancy >= red       preemption victims spill their paged KV to
+                             the bounded pinned-host tier (spill_host_mb;
+                             resume = import_kv, recompute on any
+                             failure) instead of discarding it
+      occupancy >= brownout  speculative mode degrades to plain decode,
+                             the prefill chunk shrinks by
+                             brownout_chunk_div, admission caps at
+                             brownout_admit requests per iteration, and
+                             the router engages fleet-wide fair shed
+
+    hysteresis: the margin occupancy must clear a level's entry
+    watermark by before the governor relaxes one level (per update)."""
+
+    enabled: bool = False
+    yellow: float = 0.65
+    red: float = 0.85
+    brownout: float = 0.95
+    hysteresis: float = 0.05
+    static_headroom: float = 0.8
+    yellow_trim_blocks: int = 4
+    spill_enabled: bool = True
+    spill_host_mb: float = 256.0
+    brownout_chunk_div: int = 4
+    brownout_admit: int = 1
+
+    @model_validator(mode="after")
+    def _check(self):
+        if not (0.0 < self.yellow <= self.red <= self.brownout <= 1.0):
+            raise ValueError(
+                "pressure watermarks need 0 < yellow <= red <= "
+                "brownout <= 1")
+        if self.hysteresis < 0 or self.hysteresis >= self.yellow:
+            raise ValueError(
+                "pressure.hysteresis must be in [0, yellow)")
+        if self.static_headroom <= 0 or self.static_headroom > 1:
+            raise ValueError("pressure.static_headroom must be in (0, 1]")
+        if self.yellow_trim_blocks < 0 or self.spill_host_mb < 0:
+            raise ValueError(
+                "yellow_trim_blocks and spill_host_mb must be >= 0")
+        if self.brownout_chunk_div < 1 or self.brownout_admit < 0:
+            raise ValueError(
+                "brownout_chunk_div must be >= 1, brownout_admit >= 0")
+        return self
+
+
 class ServingSchedulerConfig(ConfigModel):
     """Continuous-batching serving scheduler (inference/scheduler.py
     ServingScheduler) — the request-level control plane over the paged
@@ -312,7 +372,20 @@ class ServingSchedulerConfig(ConfigModel):
     hbm_budget_gb: per-device HBM budget the warmup-measured bucket
     footprints are validated against at admit-config time (analysis/
     costmodel S004); 0 = auto from the running chip
-    (platform/accelerator.py hbm_per_device)."""
+    (platform/accelerator.py hbm_per_device).
+    max_preemptions: preemption-starvation bound — a request preempted
+    this many times becomes PROTECTED (never selected as a victim
+    again; the requester yields instead), so every admitted request
+    makes forward progress under sustained pressure. 0 disables the
+    bound (the legacy youngest-first-always policy, which can ping-pong
+    two similar-age requests forever).
+    slo_classes: named SLO classes mapped to TTFT deadlines in modeled
+    seconds (inference/pressure.py cost model) — submit(slo_class=...)
+    resolves a deadline through this table; submit(deadline_s=...)
+    passes one directly. A request whose admission-time TTFT estimate
+    exceeds its deadline is rejected with finish_reason='deadline'
+    BEFORE any KV block is touched.
+    pressure: the memory-pressure governor block (PressureConfig)."""
 
     max_num_batched_tokens: int = 256
     prefill_chunk: int = 32
@@ -321,9 +394,18 @@ class ServingSchedulerConfig(ConfigModel):
     prefill_mode: str = "chunked"
     warmup: bool = True
     hbm_budget_gb: float = 0.0
+    max_preemptions: int = 8
+    slo_classes: Dict[str, float] = Field(default_factory=dict)
+    pressure: PressureConfig = Field(default_factory=PressureConfig)
 
     @model_validator(mode="after")
     def _check(self):
+        if self.max_preemptions < 0:
+            raise ValueError("max_preemptions must be >= 0 (0 = off)")
+        for name, dl in self.slo_classes.items():
+            if dl <= 0:
+                raise ValueError(
+                    f"slo_classes[{name!r}] deadline must be > 0 s")
         if self.admission not in ("fcfs", "skip"):
             raise ValueError(
                 f"unknown admission policy '{self.admission}' "
@@ -384,7 +466,24 @@ class ServingRouterConfig(ConfigModel):
     total waiting queue; over it, submissions shed per shed_policy:
     'fair' sheds the queue-heaviest session's newest waiting request
     (the submitting session itself when it is the heaviest),
-    'reject' always sheds the new request."""
+    'reject' always sheds the new request.
+
+    Pressure integration (inference/pressure.py; active only when the
+    per-replica scheduler's pressure governor is enabled):
+    pressure_routing_weight folds each replica's pressure level into
+    its routing score (normalized level x weight in load units — a RED
+    replica must be much cheaper on every other axis to win a pick,
+    and BROWNOUT replicas are skipped entirely while a calmer replica
+    exists). max_handoff_backlog > 0 bounds each prefill replica's
+    handoff_ready backlog: pump() stops moving sequences to decode
+    replicas that are saturated (batch-full or pressure >= RED),
+    leaving them parked instead of force-recomputing, and routing
+    stops picking prefill replicas already at the backlog bound
+    (counters handoff_backpressure / prefill_backpressure in
+    router.metrics()). brownout_shed engages the fair-shed machinery
+    fleet-wide while EVERY live replica sits at BROWNOUT, even when
+    max_fleet_queue is unbounded (the effective bound becomes the
+    fleet's live batch capacity)."""
 
     replicas: int = 1
     policy: str = "prefix_aware"
@@ -403,11 +502,19 @@ class ServingRouterConfig(ConfigModel):
     handoff_timeout_s: float = 0.0
     max_fleet_queue: int = 0
     shed_policy: str = "fair"
+    pressure_routing_weight: float = 1.0
+    max_handoff_backlog: int = 0
+    brownout_shed: bool = True
     scheduler: ServingSchedulerConfig = Field(
         default_factory=ServingSchedulerConfig)
 
     @model_validator(mode="after")
     def _check(self):
+        if self.pressure_routing_weight < 0:
+            raise ValueError("pressure_routing_weight must be >= 0")
+        if self.max_handoff_backlog < 0:
+            raise ValueError(
+                "max_handoff_backlog must be >= 0 (0 = unbounded)")
         if self.policy not in ("prefix_aware", "round_robin"):
             raise ValueError(
                 f"unknown routing policy '{self.policy}' "

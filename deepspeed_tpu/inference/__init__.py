@@ -4,8 +4,16 @@ from .engine import (
     init_inference,
     init_inference_from_hf,
 )
+from .pressure import (
+    BROWNOUT,
+    GREEN,
+    RED,
+    YELLOW,
+    PressureGovernor,
+)
 from .ragged import (
     BlockedAllocator,
+    KVCacheExhaustedError,
     PrefixMatch,
     SequenceDescriptor,
     StateManager,
@@ -19,9 +27,15 @@ __all__ = [
     "init_inference",
     "init_inference_from_hf",
     "BlockedAllocator",
+    "KVCacheExhaustedError",
     "PrefixMatch",
     "SequenceDescriptor",
     "StateManager",
+    "GREEN",
+    "YELLOW",
+    "RED",
+    "BROWNOUT",
+    "PressureGovernor",
     "Request",
     "RequestShedError",
     "ServingRouter",

@@ -780,6 +780,14 @@ class InferenceEngine:
         idx[:len(blocks)] = blocks
         return idx
 
+    def kv_payload_nbytes(self, n_blocks: int) -> int:
+        """Size in bytes of an export_kv payload's K+V page stacks for
+        a sequence holding `n_blocks` blocks — the spill tier's budget
+        pre-check (scheduler._try_spill), computed WITHOUT paying the
+        compiled gather + readback."""
+        per_page = int(self.cache.k[0][0].nbytes)
+        return 2 * self.cfg.n_layers * n_blocks * per_page
+
     def export_kv(self, uid: int) -> Dict[str, Any]:
         """Serialize one sequence's paged KV for a cross-engine handoff
         (the DistServe/Splitwise prefill->decode transfer): gather its
@@ -797,8 +805,14 @@ class InferenceEngine:
         seq = self.state.get(uid)
         if seq is None:
             raise KeyError(f"unknown sequence uid {uid}")
-        nb = len(seq.blocks)
-        idx = self._pad_block_idx(seq.blocks)
+        # export only the blocks holding WRITTEN KV: a preemption
+        # victim (spill path) reserves blocks for its full recompute
+        # target ahead of writing them, and import_kv's extend
+        # allocates by seen_tokens — the unwritten reservation tail
+        # carries no data and must not ride the payload
+        nb = min(len(seq.blocks),
+                 -(-seq.seen_tokens // self.state.block_size))
+        idx = self._pad_block_idx(seq.blocks[:nb])
         self.recompile_tracker.record("kv_transfer_gather", (idx,))
         k, v = self._kv_gather_fn()(self.cache, self._dev(idx))
         payload = {

@@ -39,6 +39,109 @@ from ..resilience.faults import fault_point
 from ..utils.logging import log_dist, logger
 
 
+class HostKvSpillStore:
+    """Bounded pinned-host spill tier for preempted sequences' paged KV
+    (the serving analog of vLLM's swap space, wired into
+    scheduler._preempt under RED pressure — docs/fault_tolerance.md
+    pressure section).
+
+    Entries are `engine.export_kv` payloads: host numpy K/V page
+    stacks plus the PR-9 blake2b digest envelope, so a bit flipped
+    while the payload sits in host DRAM is caught by `import_kv` at
+    resume and falls back to recompute. The tier is bounded in BYTES
+    (`capacity_bytes`): a put that would overflow is REJECTED (returns
+    False — the caller falls back to flush-and-recompute, the
+    pre-spill behavior) rather than evicting someone else's spilled
+    work, because every resident entry belongs to a request the
+    scheduler WILL resume; unlike a cache there are no cold entries to
+    sacrifice. Chaos point 'spill.io' (ctx: op put|get, key) fires
+    inside both operations so the overload lane can force the
+    fallback paths deterministically.
+
+    Lock-guarded (the R003 shared-mutable class rule): the scheduler
+    is single-threaded today, but the store sits next to io_callback-
+    driven machinery in this file and the accounting must never
+    race."""
+
+    def __init__(self, capacity_bytes: int):
+        self.capacity_bytes = int(capacity_bytes)
+        self._entries: Dict[Any, Dict[str, Any]] = {}
+        self._bytes: Dict[Any, int] = {}
+        self._lock = threading.Lock()
+        self.used_bytes = 0
+        self.peak_bytes = 0
+        self.counters: Dict[str, int] = {
+            "puts": 0, "gets": 0, "rejects": 0, "discards": 0,
+        }
+
+    @staticmethod
+    def payload_nbytes(payload: Dict[str, Any]) -> int:
+        return sum(int(v.nbytes) for v in payload.values()
+                   if isinstance(v, np.ndarray))
+
+    def put(self, key: Any, payload: Dict[str, Any]) -> bool:
+        """Admit one spilled payload. Returns False (nothing stored)
+        when the byte budget cannot take it — the caller recomputes.
+        May raise an InjectedFault from the 'spill.io' chaos point."""
+        fault_point("spill.io", op="put", key=key)
+        nbytes = self.payload_nbytes(payload)
+        with self._lock:
+            if key in self._entries:
+                raise ValueError(f"spill key {key!r} already stored")
+            if self.used_bytes + nbytes > self.capacity_bytes:
+                self.counters["rejects"] += 1
+                return False
+            self._entries[key] = payload
+            self._bytes[key] = nbytes
+            self.used_bytes += nbytes
+            self.peak_bytes = max(self.peak_bytes, self.used_bytes)
+            self.counters["puts"] += 1
+        return True
+
+    def get(self, key: Any):
+        """Pop one spilled payload (None when absent — e.g. a 'skip'
+        fault suppressed the put). May raise an InjectedFault from the
+        'spill.io' chaos point; the entry is dropped first so a failed
+        get never wedges the byte budget."""
+        with self._lock:
+            payload = self._entries.pop(key, None)
+            if payload is not None:
+                self.used_bytes -= self._bytes.pop(key)
+                self.counters["gets"] += 1
+        fault_point("spill.io", op="get", key=key)
+        return payload
+
+    def restore(self, key: Any, payload: Dict[str, Any]) -> None:
+        """Re-insert a payload just popped by get() whose resume could
+        not land (pool transiently full) — no fault point and no put
+        accounting: the entry never logically left the tier."""
+        with self._lock:
+            if key in self._entries:
+                raise ValueError(f"spill key {key!r} already stored")
+            nbytes = self.payload_nbytes(payload)
+            self._entries[key] = payload
+            self._bytes[key] = nbytes
+            self.used_bytes += nbytes
+            self.peak_bytes = max(self.peak_bytes, self.used_bytes)
+
+    def discard(self, key: Any) -> None:
+        """Drop an entry whose request will never resume here (it
+        finished, shed, or moved replicas)."""
+        with self._lock:
+            if self._entries.pop(key, None) is not None:
+                self.used_bytes -= self._bytes.pop(key)
+                self.counters["discards"] += 1
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            s = {f"spill_{k}": float(v) for k, v in self.counters.items()}
+            s["spill_entries"] = float(len(self._entries))
+            s["spill_used_bytes"] = float(self.used_bytes)
+            s["spill_peak_bytes"] = float(self.peak_bytes)
+            s["spill_capacity_bytes"] = float(self.capacity_bytes)
+        return s
+
+
 class NvmeLayerStore:
     """Per-leaf NVMe files + in-flight prefetch state for one engine."""
 

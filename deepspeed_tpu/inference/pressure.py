@@ -1,0 +1,202 @@
+"""Memory-pressure governor + SLO admission cost model for the
+serving stack (docs/fault_tolerance.md pressure section).
+
+Under sustained overload the scheduler's only pre-governor tool was
+youngest-first flush-and-recompute preemption: completed prefill work
+is thrown away, and when arrival rate exceeds capacity the fleet
+livelocks re-prefilling the same prompts. This module adds the two
+missing control loops (vLLM's swap-based preemption and Sarathi-Serve's
+SLO-aware scheduling are the references):
+
+- **PressureGovernor**: tiered watermarks over the `BlockedAllocator`'s
+  LIVE occupancy (blocks pinned by active sequences; parked
+  prefix-cache blocks are evictable and do not count), scaled by the
+  S004 warmup footprints — a config whose static HBM footprint already
+  crowds the per-device budget goes YELLOW/RED on far less KV-pool
+  occupancy. Levels and their actions:
+
+    GREEN     steady state — nothing changes.
+    YELLOW    proactively evict LRU-parked prefix-cache blocks into the
+              free list (the cheapest relief: their contents are
+              recomputable cache, and draining them now keeps the RED
+              machinery from paying eviction churn per allocation).
+    RED       preemption victims SPILL their paged KV to the bounded
+              pinned-host tier (scheduler._preempt ->
+              offload_store.HostKvSpillStore) instead of discarding it;
+              resume is an import_kv donated scatter — token-identical,
+              with recompute as the fallback when the tier is full, the
+              digest mismatches, or an injected 'spill.io' fault fires.
+    BROWNOUT  shed load: speculative mode degrades to plain decode
+              (greedy-exact, so tokens are unchanged), the prefill
+              chunk shrinks, admission is capped per iteration, and the
+              router engages its fair-shed machinery fleet-wide.
+
+  Transitions carry a hysteresis margin so occupancy noise at a
+  watermark does not flap the level (and with it the spill policy)
+  every iteration.
+
+- **Step cost model**: the deterministic per-step constants the PR-6
+  virtual-clock fleet simulator prices dispatches with (one compiled
+  dispatch = C_DISPATCH fixed + C_TOKEN per batched token; a KV handoff
+  = C_XFER + C_BLOCK per block per side). They moved here from bench.py
+  so the scheduler's SLO admission and the simulator price work with
+  ONE authority.
+
+- **estimate_ttft**: queue-depth + cost-model TTFT estimate the
+  scheduler's SLO-aware admission checks a request's deadline against
+  at submit() — an unservable deadline is rejected in O(queue) host
+  arithmetic with `finish_reason="deadline"` BEFORE any KV block is
+  touched, instead of timing out after consuming pool capacity.
+
+Everything here is host-side Python over counters — no device state,
+no wall clocks — so the governor and the admission estimate are
+deterministic under the virtual-clock chaos lanes (bench.py
+--overload-sim, scripts/ds_overload.py).
+"""
+
+from typing import Dict, Optional
+
+__all__ = [
+    "GREEN", "YELLOW", "RED", "BROWNOUT", "LEVEL_NAMES",
+    "PressureGovernor", "estimate_ttft",
+    "C_DISPATCH", "C_TOKEN", "C_XFER", "C_BLOCK",
+]
+
+# pressure levels (ordered: comparisons like `level >= RED` are the API)
+GREEN, YELLOW, RED, BROWNOUT = 0, 1, 2, 3
+LEVEL_NAMES = {GREEN: "green", YELLOW: "yellow", RED: "red",
+               BROWNOUT: "brownout"}
+
+# deterministic per-step cost model (moved from bench.py — the fleet
+# simulator and the SLO admission estimate share one authority): one
+# compiled dispatch costs C_DISPATCH (host build + launch + program
+# fixed cost — a batch-8 decode step measured ~2.3 ms on the CPU lane)
+# plus C_TOKEN per batched token; a KV handoff costs C_XFER fixed plus
+# C_BLOCK per transferred block on each side.
+C_DISPATCH, C_TOKEN = 2e-3, 5e-5
+C_XFER, C_BLOCK = 5e-4, 1e-4
+
+
+class PressureGovernor:
+    """Tiered-watermark pressure controller over one engine's paged KV
+    pool. The serving scheduler calls `update()` once per iteration
+    (before admission); everything else reads `level`.
+
+    cfg: a config.PressureConfig. budget_bytes: the per-device HBM
+    budget the S004 watermark scaling divides the warmed footprint by
+    (0 disables the scaling — CPU test lanes have no meaningful
+    budget)."""
+
+    def __init__(self, cfg, engine, budget_bytes: int = 0):
+        self.cfg = cfg
+        self.engine = engine
+        self.budget_bytes = int(budget_bytes)
+        self.level = GREEN
+        self.counters: Dict[str, int] = {
+            "transitions": 0, "parked_trimmed": 0, "trim_calls": 0,
+            "steps_yellow": 0, "steps_red": 0, "steps_brownout": 0,
+        }
+        self.max_level = GREEN
+
+    # -- inputs ----------------------------------------------------------
+    def occupancy(self) -> float:
+        """LIVE occupancy of the block pool: the fraction pinned by
+        active sequences. Parked prefix-cache blocks are evictable on
+        demand, so they are headroom, not pressure."""
+        alloc = self.engine.state.allocator
+        total = alloc.total_blocks
+        return 1.0 - alloc.available_blocks / total if total else 1.0
+
+    def watermark_scale(self) -> float:
+        """S004 coupling: when the warmed widest decode bucket's static
+        footprint (params + cache + scratch) crowds the per-device HBM
+        budget past `static_headroom`, every watermark scales down by
+        the overshoot (floored at 0.5) — the pool must go defensive
+        earlier because there is no slack HBM behind it."""
+        if self.budget_bytes <= 0:
+            return 1.0
+        fps = getattr(self.engine, "warmup_footprints", {})
+        if not fps:
+            return 1.0
+        peak = max(f["peak_hbm_bytes"] for f in fps.values())
+        overshoot = max(0.0, peak / self.budget_bytes
+                        - self.cfg.static_headroom)
+        return max(0.5, 1.0 - overshoot)
+
+    # -- the control loop ------------------------------------------------
+    def update(self) -> int:
+        """Re-read occupancy, move the level (with hysteresis on the
+        way down), and run the YELLOW relief valve (LRU-parked trim).
+        Returns the new level."""
+        occ = self.occupancy()
+        scale = self.watermark_scale()
+        marks = (self.cfg.yellow * scale, self.cfg.red * scale,
+                 self.cfg.brownout * scale)
+        target = GREEN
+        for lvl, mark in ((YELLOW, marks[0]), (RED, marks[1]),
+                          (BROWNOUT, marks[2])):
+            if occ >= mark:
+                target = lvl
+        if target < self.level:
+            # hysteresis: relax ONE level per update, and only once
+            # occupancy clears the current level's entry watermark by
+            # the margin — a preempt/admit cycle oscillating around a
+            # watermark must not flap the spill policy every iteration
+            entry = marks[self.level - 1]
+            target = (self.level - 1 if occ < entry - self.cfg.hysteresis
+                      else self.level)
+        if target != self.level:
+            self.counters["transitions"] += 1
+            self.level = target
+            self.max_level = max(self.max_level, target)
+        if self.level >= YELLOW:
+            self.counters["steps_yellow"] += 1
+            trimmed = self.engine.state.trim_parked(
+                self.cfg.yellow_trim_blocks)
+            if trimmed:
+                self.counters["trim_calls"] += 1
+                self.counters["parked_trimmed"] += trimmed
+        if self.level >= RED:
+            self.counters["steps_red"] += 1
+        if self.level >= BROWNOUT:
+            self.counters["steps_brownout"] += 1
+        return self.level
+
+    def metrics(self) -> Dict[str, float]:
+        m = {f"pressure_{k}": float(v) for k, v in self.counters.items()}
+        m["pressure_level"] = float(self.level)
+        m["pressure_max_level"] = float(self.max_level)
+        m["pressure_occupancy"] = round(self.occupancy(), 4)
+        return m
+
+
+def estimate_ttft(scheduler, prompt_tokens: int,
+                  level: Optional[int] = None) -> float:
+    """Cost-model TTFT estimate for a prompt submitted RIGHT NOW:
+    every prompt token queued ahead of it (waiting requests' bases plus
+    active sequences' unfinished prefill suffixes) must feed through
+    the per-iteration token budget before its own last chunk runs, and
+    each of those iterations also carries the running decode rows.
+    Pure counter arithmetic — deterministic under virtual clocks.
+
+    level: the governor level to price admission caps at (defaults to
+    the scheduler's governor; BROWNOUT halves effective throughput —
+    admission is capped and the prefill chunk shrunk, so honest
+    estimates must reflect the brownout tax)."""
+    cfg = scheduler.cfg
+    ahead = sum(len(r.base) - r.fed for r in scheduler.waiting)
+    running = 0
+    for r in scheduler.active:
+        if r.state == "prefill":
+            ahead += len(r.base) - r.fed
+        else:
+            running += 1
+    total = ahead + int(prompt_tokens)
+    budget = max(1, cfg.max_num_batched_tokens)
+    iters = -(-total // budget)  # ceil
+    est = iters * C_DISPATCH + (total + iters * running) * C_TOKEN
+    if level is None and scheduler.governor is not None:
+        level = scheduler.governor.level
+    if level is not None and level >= BROWNOUT:
+        est *= 2.0
+    return est

@@ -33,6 +33,15 @@ from typing import Callable, Dict, List, Optional, Tuple, Union
 import numpy as np
 
 
+class KVCacheExhaustedError(RuntimeError):
+    """The paged KV pool cannot satisfy an allocation: zero free AND
+    zero evictable parked blocks left after accounting. Typed (a
+    RuntimeError subclass, so legacy callers keep working) because the
+    serving scheduler's reserve loop must distinguish "pool pressure —
+    preempt and retry" from any other RuntimeError (e.g. the tracked-
+    sequence cap), which it must surface, not answer with preemption."""
+
+
 class BlockedAllocator:
     """Refcounted free-list allocator over the paged KV cache, with an
     LRU pool of retired-but-cached blocks.
@@ -102,7 +111,7 @@ class BlockedAllocator:
         if num_blocks < 0:
             raise ValueError(f"cannot allocate {num_blocks} blocks")
         if num_blocks > self.available_blocks:
-            raise RuntimeError(
+            raise KVCacheExhaustedError(
                 f"KV cache exhausted: requested {num_blocks} blocks, "
                 f"{self.available_blocks} available "
                 f"({len(self._free)} free + {len(self._lru)} cached) "
@@ -139,6 +148,19 @@ class BlockedAllocator:
         self._lru[block] = None  # MRU end
         if 0 <= self._pool_cap < len(self._lru):
             self._free.append(self._evict_lru())
+
+    def trim_parked(self, max_blocks: int) -> int:
+        """Evict up to `max_blocks` LRU-parked blocks into the free
+        list (contents dropped, index keys released via the evict
+        callback) — the pressure governor's YELLOW relief valve:
+        draining cold cache now means allocations under RED pressure
+        find real free blocks instead of paying eviction churn.
+        Returns the number evicted."""
+        n = 0
+        while n < max_blocks and self._lru:
+            self._free.append(self._evict_lru())
+            n += 1
+        return n
 
     def free(self, blocks: List[int]) -> None:
         # validate everything first so a raise mutates nothing
@@ -260,6 +282,12 @@ class StateManager:
     @property
     def indexed_blocks(self) -> int:
         return len(self._index)
+
+    def trim_parked(self, max_blocks: int) -> int:
+        """Evict up to `max_blocks` LRU-parked prefix-cache blocks to
+        the free list (pressure-governor YELLOW action; the allocator's
+        evict callback drops their index keys first)."""
+        return self.allocator.trim_parked(max_blocks)
 
     def can_fit(self, uid: int, new_tokens: int) -> bool:
         seq = self._seqs.get(uid) or SequenceDescriptor(uid=uid)

@@ -85,6 +85,7 @@ from ..resilience.health import CLOSED, STATE_CODE, BreakerConfig, FleetHealth
 from ..resilience.integrity import HandoffIntegrityError
 from ..utils.logging import log_dist
 from .engine import InferenceEngine
+from .pressure import BROWNOUT, GREEN, RED
 from .scheduler import FINISHED, Request, ServingScheduler
 
 __all__ = ["ServingRouter", "ServingRouterConfig", "RequestShedError"]
@@ -190,6 +191,12 @@ class ServingRouter:
             "auto_failovers": 0, "replica_restores": 0,
             "shed_requests": 0, "handoff_timeouts": 0,
             "handoff_integrity_failures": 0,
+            # pressure integration (inference/pressure.py): pump()
+            # sweeps that left handoffs parked because every decode
+            # target was saturated, and prefill picks redirected off a
+            # replica at its handoff-backlog bound
+            "handoff_backpressure": 0, "prefill_backpressure": 0,
+            "brownout_shed_engaged": 0,
         }
 
         # -- self-healing state ------------------------------------------
@@ -253,9 +260,32 @@ class ServingRouter:
             self._sessions[session] = choice
         return choice
 
+    def _pressure(self, i: int) -> int:
+        """Replica i's governor level (GREEN when the governor is off —
+        the default — so pressure never steers an un-governed fleet)."""
+        gov = self.schedulers[i].governor
+        return gov.level if gov is not None else GREEN
+
     def _pick(self, prompt: List[int], session: Any,
               pool: Sequence[int]) -> int:
         live = self._live(pool)
+        # a prefill replica whose handoff backlog sits at the bound is
+        # not accepting more work it cannot move — route around it
+        # while an alternative exists (satellite: handoff backpressure)
+        if self.cfg.max_handoff_backlog > 0:
+            open_ = [i for i in live
+                     if len(self.schedulers[i].handoff_ready)
+                     < self.cfg.max_handoff_backlog]
+            if open_ and len(open_) < len(live):
+                self.counters["prefill_backpressure"] += 1
+            if open_:
+                live = open_
+        # BROWNOUT replicas are skipped entirely while a calmer
+        # replica exists: routing new prompts at a replica already
+        # shedding load only deepens the shed
+        calm = [i for i in live if self._pressure(i) < BROWNOUT]
+        if calm:
+            live = calm
         if len(live) == 1:
             return live[0]
         loads = {i: self._load(i) for i in live}
@@ -280,6 +310,12 @@ class ServingRouter:
             frac = cached / len(prompt)
             cap = max(1, self.schedulers[i].engine.config.max_batch_size)
             score = loads[i] / cap - self.cfg.cache_weight * frac
+            # pressure fold: each governor level costs
+            # pressure_routing_weight/3 normalized-load units, so a RED
+            # replica must win by a lot on cache locality to take a
+            # prompt a GREEN replica could serve
+            score += (self.cfg.pressure_routing_weight
+                      * self._pressure(i) / BROWNOUT)
             # ties break toward the less-loaded, then lower index
             if best_score is None or (score, loads[i], i) < \
                     (best_score, loads[best], best):
@@ -287,30 +323,64 @@ class ServingRouter:
         return best
 
     # -- intake -----------------------------------------------------------
+    def _fleet_brownout(self) -> bool:
+        """True when EVERY live replica's governor sits at BROWNOUT —
+        the whole fleet is shedding, so the router's fair shed engages
+        even with max_fleet_queue unbounded. False when no replica has
+        a governor (pressure off)."""
+        live = [i for i in range(len(self.schedulers))
+                if i not in self.dead]
+        govs = [self.schedulers[i].governor for i in live]
+        if not govs or any(g is None for g in govs):
+            return False
+        return all(g.level >= BROWNOUT for g in govs)
+
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None,
-               session: Any = None) -> int:
+               session: Any = None,
+               deadline_s: Optional[float] = None,
+               slo_class: Optional[str] = None) -> int:
         """Route one request into the fleet; returns a router-global
         request id. In disaggregated mode the request lands on a
         prefill replica and moves to a decode replica at first token
         (pump()); otherwise it lives its whole life where it lands.
         `session` (any hashable) enables affinity pinning. When the
-        fleet queue is at max_fleet_queue, the shed policy runs first:
+        fleet queue is at max_fleet_queue — or every live replica is
+        at BROWNOUT pressure with brownout_shed on (effective bound:
+        the fleet's live batch capacity) — the shed policy runs first:
         either an already-queued request of the queue-heaviest session
         is shed to make room (finish_reason 'shed'), or this submission
-        raises RequestShedError."""
+        raises RequestShedError.
+
+        deadline_s / slo_class ride through to the chosen replica's
+        SLO admission (scheduler.submit): an unservable deadline comes
+        back already FINISHED with finish_reason='deadline' — check
+        result(gid).finish_reason, no exception is raised."""
         prompt = [int(t) for t in prompt]
-        if self.cfg.max_fleet_queue > 0:
-            self._shed_for_room(session)
+        bound = self.cfg.max_fleet_queue
+        if bound == 0 and self.cfg.brownout_shed and self._fleet_brownout():
+            bound = sum(
+                self.schedulers[i].engine.config.max_batch_size
+                for i in range(len(self.schedulers)) if i not in self.dead)
+            self.counters["brownout_shed_engaged"] += 1
+        if bound > 0:
+            self._shed_for_room(session, bound)
         gid = self._next_gid
         self._next_gid += 1
         pool = (self.prefill_idx if self.mode == "disaggregated"
                 else self.decode_idx)
         r = self._route(prompt, session, pool)
         sched = self.schedulers[r]
-        sched.submit(prompt, max_new_tokens, eos_token_id, stream=gid,
-                     handoff=self.mode == "disaggregated")
-        req = sched.waiting[-1]  # submit() appends; single-threaded
+        rid = sched.submit(prompt, max_new_tokens, eos_token_id,
+                           stream=gid,
+                           handoff=self.mode == "disaggregated",
+                           deadline_s=deadline_s, slo_class=slo_class)
+        if rid in sched.finished:
+            # SLO admission rejected it before queueing (finish_reason
+            # 'deadline'): zero KV blocks were touched anywhere
+            req = sched.finished[rid]
+        else:
+            req = sched.waiting[-1]  # submit() appends; single-threaded
         self._reqs[gid] = req
         self._where[gid] = r
         if session is not None:
@@ -327,23 +397,25 @@ class ServingRouter:
         # session-less requests form one anonymous fairness class
         return self._session_of.get(req.stream)
 
-    def _shed_for_room(self, session: Any) -> None:
+    def _shed_for_room(self, session: Any,
+                       bound: Optional[int] = None) -> None:
         """Graceful degradation: called before enqueueing a new request
-        when max_fleet_queue > 0. Under the bound this is a no-op; at
-        the bound, per-session fairness picks the victim — the NEWEST
-        waiting request of the session holding the most queued work.
-        When the submitting session itself is (tied-)heaviest, or
-        shed_policy='reject', the NEW request is the victim
-        (RequestShedError; nothing enqueued)."""
+        when a queue bound is in force (max_fleet_queue, or the fleet
+        batch capacity while every live replica is at BROWNOUT). Under
+        the bound this is a no-op; at the bound, per-session fairness
+        picks the victim — the NEWEST waiting request of the session
+        holding the most queued work. When the submitting session
+        itself is (tied-)heaviest, or shed_policy='reject', the NEW
+        request is the victim (RequestShedError; nothing enqueued)."""
+        bound = self.cfg.max_fleet_queue if bound is None else bound
         waiting = [(i, req) for i, s in enumerate(self.schedulers)
                    if i not in self.dead for req in s.waiting]
-        if len(waiting) < self.cfg.max_fleet_queue:
+        if len(waiting) < bound:
             return
         self.counters["shed_requests"] += 1
         if self.cfg.shed_policy == "reject":
             raise RequestShedError(
-                f"fleet queue at max_fleet_queue="
-                f"{self.cfg.max_fleet_queue}; request rejected")
+                f"fleet queue at its bound ({bound}); request rejected")
         counts: Dict[Any, int] = {}
         for _, req in waiting:
             key = self._session_key(req)
@@ -364,7 +436,7 @@ class ServingRouter:
         victim.finish_t = time.perf_counter()
         self.schedulers[i].finished[victim.rid] = victim
         log_dist(
-            f"serving router: fleet queue at {self.cfg.max_fleet_queue}; "
+            f"serving router: fleet queue at its bound ({bound}); "
             f"shed request gid={victim.stream} of session "
             f"{self._session_key(victim)!r} on replica {i}", ranks=[0])
 
@@ -397,11 +469,21 @@ class ServingRouter:
         moves: List[Dict[str, float]] = []
         if self.mode != "disaggregated":
             return moves
+        backpressured = False
         for p in self.prefill_idx:
             if p in self.dead:
                 continue
             ps = self.schedulers[p]
             while ps.handoff_ready:
+                if self.cfg.max_handoff_backlog > 0 \
+                        and not self._decode_can_take():
+                    # every live decode replica is saturated (batch
+                    # full or pressure >= RED): leave the sequences
+                    # PARKED — their KV is done work; forcing them
+                    # through requeue-for-recompute now would burn the
+                    # prefill the decode fleet cannot absorb anyway
+                    backpressured = True
+                    break
                 req = ps.handoff_ready.popleft()
                 gid = req.stream
                 t0 = time.perf_counter()
@@ -460,7 +542,21 @@ class ServingRouter:
                 self.counters["handoffs"] += 1
                 moves.append({"prefill": p, "decode": d,
                               "export_s": t1 - t0, "import_s": t2 - t1})
+        if backpressured:
+            self.counters["handoff_backpressure"] += 1
         return moves
+
+    def _decode_can_take(self) -> bool:
+        """Is any live decode replica able to absorb a handoff right
+        now (a free batch slot and pressure below RED)?"""
+        for i in self.decode_idx:
+            if i in self.dead:
+                continue
+            s = self.schedulers[i]
+            if len(s.active) < s.engine.config.max_batch_size \
+                    and self._pressure(i) < RED:
+                return True
+        return False
 
     def _requeue_for_recompute(self, req: Request) -> int:
         """The token-identical fallback shared by every failed-handoff
@@ -714,6 +810,16 @@ class ServingRouter:
             self.counters["cache_hit_routes"] / routed if routed else 0.0)
         m["fleet/handoff_p50_ms"] = pct(self._handoff_s, 50)
         m["fleet/handoff_p95_ms"] = pct(self._handoff_s, 95)
+        # pressure/overload aggregates (inference/pressure.py): spills,
+        # resumes, SLO rejections summed over replicas; the fleet's
+        # worst current governor level (0 = green everywhere / off)
+        for key in ("spills", "spill_resumes", "spill_fallbacks",
+                    "deadline_rejections", "starvation_protected"):
+            m[f"fleet/{key}"] = float(sum(
+                s.counters[key] for s in self.schedulers))
+        m["fleet/max_pressure_level"] = float(max(
+            (self._pressure(i) for i in range(len(self.schedulers))
+             if i not in self.dead), default=0))
         m["fleet/recompiles"] = float(sum(
             len(s.engine.recompile_tracker.findings)
             for s in self.schedulers))

@@ -61,9 +61,12 @@ import numpy as np
 
 from ..config.config import ServingSchedulerConfig
 from ..resilience.faults import fault_point
+from ..resilience.integrity import HandoffIntegrityError
 from ..utils.logging import log_dist
 from ..utils.sync import serving_readback
 from .engine import InferenceEngine, _bucket
+from .pressure import BROWNOUT, RED, PressureGovernor, estimate_ttft
+from .ragged import KVCacheExhaustedError
 
 __all__ = ["Request", "ServingScheduler", "ServingSchedulerConfig",
            "SchedulerConfig"]
@@ -103,6 +106,15 @@ class Request:
     # request parks after its FIRST sampled token — KV intact — for the
     # router to transfer to a decode replica, instead of decoding here
     handoff: bool = False
+    # SLO admission (inference/pressure.py): optional TTFT deadline in
+    # modeled seconds; an unservable deadline rejects at submit() with
+    # finish_reason='deadline' before any KV block is touched
+    deadline_s: Optional[float] = None
+    slo_class: Optional[str] = None
+    # preempt-to-host (RED pressure): key of this request's spilled KV
+    # payload in the scheduler's HostKvSpillStore — resume imports the
+    # pages instead of recomputing; None = recompute on re-admission
+    spill_key: Optional[int] = None
 
     @property
     def base(self) -> List[int]:
@@ -175,6 +187,9 @@ class ServingScheduler:
             "steps": 0, "admitted": 0, "finished": 0, "preemptions": 0,
             "batched_tokens": 0, "fused_steps": 0, "chained_steps": 0,
             "wave_prefills": 0, "handoffs": 0, "adopted": 0,
+            "spills": 0, "spill_resumes": 0, "spill_fallbacks": 0,
+            "spill_rejects": 0, "spill_integrity_failures": 0,
+            "deadline_rejections": 0, "starvation_protected": 0,
         }
         self.spec_stats: Dict[str, float] = {
             "steps": 0, "verified_chunks": 0, "draft_tokens": 0,
@@ -201,6 +216,27 @@ class ServingScheduler:
         # footprints vs the per-device HBM budget (analysis/costmodel
         # S004) — logged once here, surfaced via metrics()/monitor
         self.budget_report = self._validate_budget()
+        # memory-pressure governor + pinned-host spill tier
+        # (inference/pressure.py, docs/fault_tolerance.md): opt-in —
+        # with pressure off, preemption stays flush-and-recompute
+        self.governor: Optional[PressureGovernor] = None
+        self.spill_store = None
+        self._spill_seq = 0
+        pcfg = self.cfg.pressure
+        if pcfg.enabled:
+            budget = (int(self.cfg.hbm_budget_gb * 1e9)
+                      if self.cfg.hbm_budget_gb > 0 else 0)
+            if budget == 0 and getattr(engine, "warmup_footprints", {}):
+                from ..platform.accelerator import get_accelerator
+
+                budget = get_accelerator().hbm_per_device()
+            self.governor = PressureGovernor(pcfg, engine,
+                                             budget_bytes=budget)
+            if pcfg.spill_enabled:
+                from .offload_store import HostKvSpillStore
+
+                self.spill_store = HostKvSpillStore(
+                    int(pcfg.spill_host_mb * 2**20))
 
     # -- admit-config budget validation ----------------------------------
     def _validate_budget(self):
@@ -260,14 +296,24 @@ class ServingScheduler:
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None,
                stream: Optional[int] = None,
-               handoff: bool = False) -> int:
+               handoff: bool = False,
+               deadline_s: Optional[float] = None,
+               slo_class: Optional[str] = None) -> int:
         """Queue one request; returns its request id. The stream id
         (default: the rid) keys the request's PRNG stream — generate()
         passes 0..n-1 so a fixed seed reproduces its exact batch.
         handoff=True marks a disaggregated prefill request: it parks in
         handoff_ready after its first sampled token instead of decoding
         here (inference/router.py transfers its KV to a decode
-        replica)."""
+        replica).
+
+        SLO admission: deadline_s (modeled seconds of TTFT slack, the
+        inference/pressure.py cost model's units) or slo_class (a name
+        resolved through config.slo_classes) attaches a deadline; when
+        the queue-depth TTFT estimate already exceeds it, the request
+        is rejected HERE — finish_reason='deadline', done=True, zero KV
+        blocks touched — instead of queueing to time out after
+        consuming pool capacity."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -275,6 +321,13 @@ class ServingScheduler:
             raise ValueError(
                 f"prompt of {len(prompt)} > max_seq_len "
                 f"{self.engine.config.max_seq_len}")
+        deadline = float(deadline_s) if deadline_s is not None else None
+        if deadline is None and slo_class is not None:
+            deadline = self.cfg.slo_classes.get(slo_class)
+            if deadline is None:
+                raise ValueError(
+                    f"unknown slo_class {slo_class!r}; configure it in "
+                    "ServingSchedulerConfig.slo_classes")
         rid = self._next_rid
         self._next_rid += 1
         req = Request(rid=rid, prompt=prompt,
@@ -282,7 +335,16 @@ class ServingScheduler:
                       eos_token_id=eos_token_id,
                       stream=int(stream) if stream is not None else rid,
                       arrival=time.perf_counter(),
-                      handoff=bool(handoff))
+                      handoff=bool(handoff),
+                      deadline_s=deadline, slo_class=slo_class)
+        if deadline is not None \
+                and estimate_ttft(self, len(prompt)) > deadline:
+            req.state = FINISHED
+            req.finish_reason = "deadline"
+            req.finish_t = time.perf_counter()
+            self.finished[rid] = req
+            self.counters["deadline_rejections"] += 1
+            return rid
         if self.scfg.needs_presence:
             pres = np.zeros((self.engine.cfg.vocab_size,), np.uint8)
             toks = np.asarray(prompt, np.int64)
@@ -304,6 +366,9 @@ class ServingScheduler:
         req.pending = None
         req.state = WAITING
         req.preemptions += 1
+        # a spill payload lives in the SOURCE scheduler's host tier —
+        # unreachable from here; this replica recomputes
+        req.spill_key = None
         # a foreign rid may collide with a local one: re-key it so
         # self.finished stays one-entry-per-request
         req.rid = self._next_rid
@@ -356,9 +421,58 @@ class ServingScheduler:
             cand += 1
         return cand
 
+    def _try_spill(self, victim: Request) -> bool:
+        """Preempt-to-host (RED pressure): export the victim's paged KV
+        through the serialized-gather handoff path (digest envelope
+        attached) into the bounded pinned-host tier, so re-admission
+        resumes with an import_kv scatter instead of recomputing the
+        whole prefix. Returns False — the flush-and-recompute fallback
+        — when pressure is below RED, the tier lacks room, or the
+        export/put leg fails (including an injected 'spill.io'
+        fault)."""
+        store = self.spill_store
+        gov = self.governor
+        if store is None or gov is None:
+            return False
+        # the level was set at dispatch START; admission may have
+        # filled the pool since (that is WHY this preemption fired) —
+        # the spill decision reads instantaneous occupancy as well,
+        # so RED-grade pressure inside an iteration still spills
+        if gov.level < RED and \
+                gov.occupancy() < gov.cfg.red * gov.watermark_scale():
+            return False
+        seq = self.engine.state.get(victim.uid)
+        if seq is None or seq.seen_tokens < 1:
+            return False
+        nbytes = self.engine.kv_payload_nbytes(len(seq.blocks))
+        if store.used_bytes + nbytes > store.capacity_bytes:
+            self.counters["spill_rejects"] += 1
+            return False
+        key = self._spill_seq
+        self._spill_seq += 1
+        try:
+            payload = self.engine.export_kv(victim.uid)
+            if not store.put(key, payload):
+                self.counters["spill_rejects"] += 1
+                return False
+        except Exception as e:
+            log_dist(
+                f"serving scheduler: KV spill of rid={victim.rid} "
+                f"failed ({e!r}); falling back to recompute", ranks=[0])
+            self.counters["spill_fallbacks"] += 1
+            return False
+        victim.spill_key = key
+        self.counters["spills"] += 1
+        return True
+
     def _preempt(self, victim: Request) -> None:
-        """Flush the victim's KV blocks and re-queue it for recompute
-        (front of the queue: it has the oldest claim among preempted)."""
+        """Flush the victim's KV blocks and re-queue it (front of the
+        queue: it has the oldest claim among preempted). Under RED
+        pressure with the spill tier on, the pages are exported to host
+        FIRST (spill_key set), so re-admission resumes by block import
+        instead of recompute — token-identical either way, since draws
+        key on (seed, stream, position)."""
+        self._try_spill(victim)
         self.engine.state.flush(victim.uid)
         victim.uid = None
         victim.fed = 0
@@ -373,14 +487,32 @@ class ServingScheduler:
         """Reserve KV room for n more tokens of req, preempting the
         youngest OTHER active sequence under pressure. Returns False
         when req itself was preempted or finished (its row must be
-        dropped from this iteration)."""
+        dropped from this iteration).
+
+        Starvation bound (config.max_preemptions): a request preempted
+        that many times is PROTECTED — skipped in victim selection —
+        so two similar-age requests can no longer ping-pong
+        (preempt + requeue-front) forever under sustained pressure;
+        when every eligible victim is protected, the REQUESTER yields
+        instead, and the protected sequences run to completion."""
+        bound = self.cfg.max_preemptions
         while True:
             try:
                 self.engine.state.extend(req.uid, n)
                 return True
-            except RuntimeError:
-                victim = self.active[-1]
-                if victim is req:
+            except KVCacheExhaustedError:
+                victim = None
+                if self.active[-1] is not req:
+                    # youngest-first among the OTHER active sequences,
+                    # skipping protected ones (preemptions >= bound)
+                    for r in reversed(self.active):
+                        if r is req:
+                            continue
+                        if bound and r.preemptions >= bound:
+                            continue
+                        victim = r
+                        break
+                if victim is None:
                     if len(self.active) == 1:
                         # alone and still does not fit: genuine capacity
                         # exhaustion, not contention — finish truncated
@@ -388,6 +520,9 @@ class ServingScheduler:
                         # this scheduler replaces)
                         self._finish(req, "capacity")
                         return False
+                    if self.active[-1] is not req:
+                        # protection forced the requester to yield
+                        self.counters["starvation_protected"] += 1
                     self._preempt(req)
                     return False
                 self._preempt(victim)
@@ -397,6 +532,11 @@ class ServingScheduler:
         the sequence finishes, not when the batch drains."""
         if req.uid is not None and self.engine.state.get(req.uid) is not None:
             self.engine.flush(req.uid)
+        if req.spill_key is not None and self.spill_store is not None:
+            # a spilled payload whose request retires another way
+            # (shed, length while queued) must not strand host bytes
+            self.spill_store.discard(req.spill_key)
+            req.spill_key = None
         req.uid = None
         req.state = FINISHED
         req.finish_reason = reason
@@ -412,16 +552,121 @@ class ServingScheduler:
                                   / (len(req.output) - 1))
 
     # -- admission -------------------------------------------------------
+    def _resume_from_spill(self, req: Request) -> str:
+        """Re-admit a spilled preemption victim by importing its host-
+        tier KV payload (a donated scatter — no recompute). Returns
+        'resumed' (admitted RUNNING/PREFILL), 'recompute' (payload
+        lost/corrupt/faulted: fall through to normal admission), or
+        'defer' (the pool cannot take the pages right now: the payload
+        is back in the tier and the caller stops admitting — recompute
+        would need the same blocks, so waiting is strictly better)."""
+        key, req.spill_key = req.spill_key, None
+        store = self.spill_store
+        try:
+            payload = store.get(key)
+        except Exception as e:
+            log_dist(
+                f"serving scheduler: spill readback of rid={req.rid} "
+                f"failed ({e!r}); recomputing", ranks=[0])
+            self.counters["spill_fallbacks"] += 1
+            return "recompute"
+        if payload is None:
+            self.counters["spill_fallbacks"] += 1
+            return "recompute"
+        uid = self._alloc_uid()
+        try:
+            self.engine.import_kv(uid, payload)
+        except HandoffIntegrityError as e:
+            # a bit flipped while the payload sat in host DRAM: the
+            # digest envelope catches it BEFORE any page is scattered
+            log_dist(
+                f"serving scheduler: spilled KV of rid={req.rid} "
+                f"failed digest verification ({e}); recomputing",
+                ranks=[0])
+            self.counters["spill_integrity_failures"] += 1
+            self.counters["spill_fallbacks"] += 1
+            return "recompute"
+        except KVCacheExhaustedError:
+            if self.engine.state.get(uid) is not None:
+                self.engine.flush(uid)
+            req.spill_key = key
+            store.restore(key, payload)
+            return "defer"
+        seen = int(payload["seen_tokens"])
+        req.uid = uid
+        req.fed = seen
+        if req.output and seen == len(req.base) - 1:
+            # mid-decode victim: its next draw's input is the pending
+            # (sampled, not-yet-fed) token — exactly where it stopped
+            # (per-step _reserve grows the block table from here)
+            req.pending = req.output[-1]
+            req.state = RUNNING
+        else:
+            # mid-prefill victim: chunked prefill continues at `fed`.
+            # The payload only carried the WRITTEN blocks; re-reserve
+            # room for the rest of the base, as admission would have
+            try:
+                self.engine.state.extend(uid, len(req.base) - seen)
+            except KVCacheExhaustedError:
+                self.engine.flush(uid)
+                req.spill_key = key
+                store.restore(key, payload)
+                return "defer"
+            req.pending = None
+            req.state = PREFILL
+        self.active.append(req)
+        self.counters["admitted"] += 1
+        self.counters["spill_resumes"] += 1
+        return "resumed"
+
+    def _red_admission_gate(self) -> bool:
+        """Under RED pressure NEW admissions pause (the vLLM admission-
+        watermark idea): every block a fresh prompt takes is a block a
+        RUNNING sequence's growth will preempt it for one iteration
+        later — admit-then-evict churn that burns prefill work for
+        zero progress. Preempted requests re-entering (preemptions > 0
+        or a spill to resume) are exempt: they ARE the in-flight work
+        the gate protects. Instantaneous occupancy, not the iteration-
+        start level: admissions themselves move it."""
+        gov = self.governor
+        if gov is None:
+            return False
+        return (gov.level >= RED
+                or gov.occupancy() >= gov.cfg.red * gov.watermark_scale())
+
     def _admit(self) -> None:
         """Admit waiting requests while a slot and (prefix-cache-
         credited) KV room exist. fcfs stops at the first misfit; skip
-        scans past it."""
+        scans past it. Spilled preemption victims resume by block
+        import (_resume_from_spill). Under RED pressure fresh
+        admissions pause (_red_admission_gate); under BROWNOUT
+        admission caps at pressure.brownout_admit per iteration."""
         eng = self.engine
         scanned: List[Request] = []
+        admitted_now = 0
+        cap = (self.cfg.pressure.brownout_admit
+               if self.governor is not None
+               and self.governor.level >= BROWNOUT else -1)
         while self.waiting:
             if len(self.active) >= eng.config.max_batch_size:
                 break
+            if 0 <= cap <= admitted_now:
+                break
             req = self.waiting.popleft()
+            if req.spill_key is not None:
+                outcome = self._resume_from_spill(req)
+                if outcome == "resumed":
+                    admitted_now += 1
+                    continue
+                if outcome == "defer":
+                    self.waiting.appendleft(req)
+                    break
+                # 'recompute': fall through to the normal path below
+            if req.preemptions == 0 and self._red_admission_gate():
+                # fresh work waits out the RED window; preempted
+                # requests re-enter ahead of it (queue front)
+                self.waiting.appendleft(req)
+                break
             base = req.base
             if len(base) > eng.config.max_seq_len:
                 # recompute target overfills the context window —
@@ -431,7 +676,7 @@ class ServingScheduler:
             uid = self._alloc_uid()
             try:
                 _, match = eng.state.extend(uid, len(base), token_ids=base)
-            except RuntimeError:
+            except KVCacheExhaustedError:
                 if not self.active:
                     # alone against an empty pool and still no fit: the
                     # prompt needs more blocks than the cache holds —
@@ -453,6 +698,7 @@ class ServingScheduler:
             req.state = PREFILL
             self.active.append(req)
             self.counters["admitted"] += 1
+            admitted_now += 1
         for req in reversed(scanned):  # preserve arrival order
             self.waiting.appendleft(req)
 
@@ -649,15 +895,28 @@ class ServingScheduler:
             return 0  # pressure: step singly, preempting as needed
         return C
 
+    def _brownout(self) -> bool:
+        return (self.governor is not None
+                and self.governor.level >= BROWNOUT)
+
     def _dispatch(self) -> Optional[_Step]:
         """Build and launch one iteration; returns None when idle.
         Host-side state (commits, next tables) is updated after the
-        async launch, overlapping the device program."""
+        async launch, overlapping the device program. The pressure
+        governor (when enabled) updates FIRST — its level steers this
+        iteration's admission cap, victim policy, and brownout
+        degradations."""
+        if self.governor is not None:
+            self.governor.update()
         self._admit()
         if not self.active:
             return None
         self.counters["steps"] += 1
-        if self._spec:
+        if self._spec and not self._brownout():
+            # BROWNOUT degrades speculation to plain decode: draft rows
+            # burn batch capacity the pool no longer has, and greedy
+            # verification == greedy decode token for token, so the
+            # degradation is output-invisible
             return self._dispatch_spec()
         running = [r for r in self.active if r.state == RUNNING]
         prefill = [r for r in self.active if r.state == PREFILL]
@@ -673,6 +932,11 @@ class ServingScheduler:
                 prefill = [r for r in prefill if r.state == PREFILL]
         budget = self.cfg.max_num_batched_tokens
         row_budget = self.engine.config.max_batch_size
+        pchunk = self.cfg.prefill_chunk
+        if self._brownout():
+            # shrink the prefill chunk: under brownout every reserved
+            # prefill token is pool pressure the decode rows pay for
+            pchunk = max(1, pchunk // self.cfg.pressure.brownout_chunk_div)
         rows: List[Tuple[Request, List[int], bool]] = []
         for req in list(running):  # oldest first; preemption takes youngest
             if budget < 1 or row_budget < 1:
@@ -690,8 +954,7 @@ class ServingScheduler:
             if req.state != PREFILL:
                 continue  # preempted while reserving decode rows
             remaining = req.base[req.fed:]
-            c = min(self.cfg.prefill_chunk, budget, row_budget,
-                    len(remaining))
+            c = min(pchunk, budget, row_budget, len(remaining))
             if c < 1:
                 continue
             chunk = remaining[:c]
@@ -1031,6 +1294,12 @@ class ServingScheduler:
                 f["peak_hbm_bytes"] for f in fps.values()) / 2**20
             for w, f in sorted(fps.items()):
                 m[f"hbm_w{w}_mb"] = f["peak_hbm_bytes"] / 2**20
+        # pressure governor + spill tier (inference/pressure.py;
+        # present only when config.pressure.enabled)
+        if self.governor is not None:
+            m.update(self.governor.metrics())
+        if self.spill_store is not None:
+            m.update(self.spill_store.stats())
         for k, v in self.counters.items():
             m[k] = float(v)
         if self.counters["steps"]:

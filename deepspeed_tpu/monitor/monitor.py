@@ -138,7 +138,28 @@ def serving_events(scheduler, step: int,
     in-transit/DRAM bit flip); each is discarded and recomputed
     token-identically, so a nonzero count with zero output divergence
     is the detector WORKING, while a rising rate fingers flaky
-    links/hosts."""
+    links/hosts.
+
+    Pressure/overload feed (docs/fault_tolerance.md pressure section;
+    present when the per-scheduler governor is enabled): per replica,
+    `pressure_level` (0 green / 1 yellow / 2 red / 3 brownout),
+    `pressure_max_level`, `pressure_occupancy` (live block-pool
+    fraction), `pressure_parked_trimmed` (YELLOW cache evictions),
+    the spill tier's `spill_puts/gets/rejects/discards` +
+    `spill_used_bytes`/`spill_peak_bytes`, and the scheduler counters
+    `spills`/`spill_resumes`/`spill_fallbacks` (preempt-to-host vs
+    recompute fallback — fallbacks are token-identical by
+    construction, so a nonzero count is degradation, not corruption),
+    `spill_integrity_failures` (digest-rejected spill payloads),
+    `deadline_rejections` (SLO admission rejecting unservable
+    deadlines BEFORE any KV block — rising means the fleet is past
+    its latency capacity), and `starvation_protected` (preemption
+    victims saved by the aging bound). Router-level aggregates:
+    `fleet/spills`, `fleet/spill_resumes`, `fleet/spill_fallbacks`,
+    `fleet/deadline_rejections`, `fleet/starvation_protected`,
+    `fleet/max_pressure_level`, plus the backpressure counters
+    `fleet/handoff_backpressure`, `fleet/prefill_backpressure`, and
+    `fleet/brownout_shed_engaged`."""
     metrics = scheduler.metrics()
     return [(f"{prefix}/{name}", float(value), step)
             for name, value in sorted(metrics.items())]

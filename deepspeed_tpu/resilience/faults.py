@@ -76,6 +76,15 @@ Fault points registered across the tree (ctx keys in parens):
                                   flips bytes in one state file)
   offload.io          (what)      NvmeLayerStore aio op (transient
                                   I/O — bounded retry heals it)
+  spill.io            (op, key)   HostKvSpillStore put/get (the
+                                  preempt-to-host KV tier,
+                                  inference/offload_store.py) —
+                                  raise error='io' on op='put' loses
+                                  the spill (victim recomputes),
+                                  on op='get' loses the resume
+                                  payload (same fallback); 'skip' is
+                                  not interpreted (the store's ops
+                                  are not suppressible — use 'raise')
   heartbeat.beat      (rank)      kind='skip' suppresses the write (a
                                   wedged-but-alive controller)
   engine.grads        (rank,      post-step gradient readout + the

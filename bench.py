@@ -287,6 +287,11 @@ def _serving_sim():
             "hbm_per_bucket_mb": {
                 str(w): round(fp["peak_hbm_bytes"] / 2**20, 2)
                 for w, fp in sorted(eng.warmup_footprints.items())},
+            # schedule-aware S009 step-time projection per bucket
+            # (analysis/schedule.py via engine.warmup footprints)
+            "step_time_us_per_bucket": {
+                str(w): round(fp.get("step_time_us", 0.0), 2)
+                for w, fp in sorted(eng.warmup_footprints.items())},
             "budget_findings": len(sched.budget_report.findings),
         },
         "static": {

@@ -11,6 +11,12 @@ Three prongs (see docs/static_analysis.md):
               and baseline regressions (S005), roofline balance (S006).
               Baselines persist to MEMBUDGET.json
               (`python scripts/ds_budget.py --capture / --check`).
+  schedule  — schedule-aware analysis over the same artifacts:
+              exposed-collective time (S007), hierarchy-aware replica-
+              group placement (S008), critical-path step-time
+              projection (S009) — the autotuner's AOT score. Baselines
+              persist to SCHEDULE.json
+              (`python scripts/ds_schedule.py --capture / --check`).
   numerics  — precision-flow analysis over the same artifacts: low-
               precision accumulation (N001), fp32 master-weight
               integrity (N002), loss-scale coverage (N003),
@@ -39,6 +45,15 @@ from .costmodel import (
     load_baseline,
     roofline,
     save_baseline,
+)
+from .schedule import (
+    PodTopology,
+    ScheduleAnalysis,
+    analyze_compiled,
+    analyze_schedule,
+    check_exposed_comm,
+    check_hierarchy_placement,
+    check_step_time,
 )
 from .numerics import (
     check_accumulation_dtypes,
@@ -71,6 +86,13 @@ __all__ = [
     "load_baseline",
     "roofline",
     "save_baseline",
+    "PodTopology",
+    "ScheduleAnalysis",
+    "analyze_compiled",
+    "analyze_schedule",
+    "check_exposed_comm",
+    "check_hierarchy_placement",
+    "check_step_time",
     "check_accumulation_dtypes",
     "check_loss_scale",
     "check_master_integrity",

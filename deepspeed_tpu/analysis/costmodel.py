@@ -57,9 +57,12 @@ __all__ = [
 ]
 
 # Effective per-chip ICI bandwidth (bytes/s) for the ring-collective
-# projection — the link constant scripts/ici_projection.py models v5p
-# with (conservative ~100 GB/s-class effective per chip).
-ICI_GBPS = 100e9
+# projection — re-exported from the single link-table authority
+# (platform/accelerator.LINKS, shared with scripts/ici_projection.py
+# and analysis/schedule.py; tests assert no local re-declaration).
+from ..platform.accelerator import LINKS as _LINKS
+
+ICI_GBPS = _LINKS["ici_bytes_per_s"]
 
 _NUM_PARTITIONS_RE = re.compile(r"num_partitions=(\d+)")
 
@@ -82,6 +85,14 @@ class CostReport:
         default_factory=dict)  # {op: {count, bytes}}
     n_devices: int = 1
     estimated: bool = False       # memory_analysis unavailable: args only
+    # schedule-aware projection (analysis/schedule.py S007-S009): the
+    # critical-path step time — serial roofline leg + EXPOSED comm only
+    # — and its summary ledger. The autotuner's AOT score reads
+    # step_time_s; the full ScheduleAnalysis rides the non-field
+    # `_schedule` attribute for the checks.
+    step_time_s: float = 0.0
+    exposed_comm_s: float = 0.0
+    schedule: Optional[Dict[str, Any]] = None
 
     @property
     def peak_hbm_bytes(self) -> int:
@@ -201,6 +212,29 @@ def build_cost_report(compiled: Any, label: str = "program",
         slot["count"] += 1
         slot["bytes"] += c["bytes"]
     rep.collectives = agg
+    # schedule-aware step-time projection (S007-S009 input + the
+    # autotuner's AOT score); never fatal — a backend without
+    # cost_analysis still gets the comm-only schedule ledger
+    try:
+        from ..platform.accelerator import get_accelerator
+        from .schedule import analyze_schedule
+
+        try:
+            acc = get_accelerator()
+            peak, hbm = acc.peak_flops(), acc.hbm_bandwidth()
+        except Exception:
+            peak, hbm = 1.0, 1.0
+        sched = analyze_schedule(
+            text, flops=rep.flops, bytes_accessed=rep.bytes_accessed,
+            peak_flops=peak, hbm_bandwidth=hbm, n_devices=n_devices,
+            label=label)
+    except Exception:
+        sched = None
+    if sched is not None:
+        rep.step_time_s = sched.step_time_s
+        rep.exposed_comm_s = sched.exposed_s
+        rep.schedule = sched.to_dict()
+        rep._schedule = sched
     return rep
 
 

@@ -34,6 +34,13 @@ Rules
         `.astype(float)`/`.astype("float64")` (widening through the
         python type). The static companion to the numerics
         sanitizer's N001 (analysis/numerics.py)
+  R007  a collective call (`psum`/`all_gather`/`ppermute`/
+        `psum_scatter`/`pmean`/`all_to_all`) inside a Python-level
+        `for`/`while` loop in a jit-root body — tracing unrolls the
+        loop into N separate collectives, the volume-blowup class the
+        cost model's S005 only catches post-compile. Carry the loop
+        into `lax.scan`/`lax.fori_loop` (one collective in the
+        compiled body) or annotate a deliberately unrolled ring
 
 Pragma: `# ds-lint: ok` suppresses every rule on that line (or the line
 below a standalone pragma comment); `# ds-lint: ok R002 <reason>`
@@ -61,6 +68,8 @@ RULES = {
     "R006": "precision-policy drift (float64 mention, dtype-less "
             "jnp.zeros/ones/arange, astype(float)) inside a jitted "
             "body",
+    "R007": "collective call inside a Python-level for/while loop in "
+            "a jitted body (unrolls to N collectives)",
 }
 
 _PRAGMA_RE = re.compile(
@@ -410,6 +419,47 @@ def _check_r006(ctx: _Ctx, root: ast.AST, callbacks: Set[ast.AST]) -> None:
 
 
 # ----------------------------------------------------------------------
+# R007: collectives inside Python-level loops in jit bodies
+# ----------------------------------------------------------------------
+
+# the Python-callable collective surface (jax.lax.* and the comm/
+# wrappers share these names): each call traced inside an unrolled
+# Python loop becomes its OWN collective instruction in the compiled
+# program — N x the volume, N x the latency floor
+_R007_COLLECTIVES = ("psum", "all_gather", "ppermute", "psum_scatter",
+                     "pmean", "pmax", "pmin", "all_to_all")
+
+
+def _check_r007(ctx: _Ctx, root: ast.AST, callbacks: Set[ast.AST]) -> None:
+    skip: Set[ast.AST] = set()
+    for cb in callbacks:
+        skip.update(ast.walk(cb))
+    for loop in ast.walk(root):
+        if loop in skip or not isinstance(loop, (ast.For, ast.While)):
+            continue
+        for node in ast.walk(loop):
+            if node in skip or not isinstance(node, ast.Call):
+                continue
+            callee = _dotted(node.func)
+            if callee.split(".")[-1] not in _R007_COLLECTIVES:
+                continue
+            ctx.emit(
+                "R007", node,
+                f"{callee}() inside a Python-level "
+                f"{'for' if isinstance(loop, ast.For) else 'while'} "
+                "loop in a jitted body — tracing unrolls the loop, so "
+                "the compiled program carries one collective PER "
+                "iteration (the unrolled-N volume blowup S005 only "
+                "catches post-compile)",
+                "carry the loop into lax.scan / lax.fori_loop so the "
+                "compiled body holds ONE collective, or annotate a "
+                "deliberately unrolled ring with "
+                "`# ds-lint: ok R007 <why>`",
+                severity="warning",
+            )
+
+
+# ----------------------------------------------------------------------
 # R002: hot-path host syncs
 # ----------------------------------------------------------------------
 
@@ -634,6 +684,7 @@ def lint_source(source: str, relpath: str) -> Tuple[List[Finding],
         _check_r001(ctx, root, callbacks)
         _check_r005(ctx, root, callbacks)
         _check_r006(ctx, root, callbacks)
+        _check_r007(ctx, root, callbacks)
     _check_r002(ctx, tree)
     _check_r003(ctx, tree)
     _check_r004(ctx, tree)

@@ -91,9 +91,16 @@ class Autotuner:
     # ------------------------------------------------------------------
     def _apply_candidate(self, cand: Dict[str, Any]) -> Dict[str, Any]:
         cfg = json.loads(json.dumps(self.base_config))
-        cfg.setdefault("zero_optimization", {})["stage"] = cand["zero_stage"]
-        cfg["train_micro_batch_size_per_gpu"] = cand["micro_batch_size"]
-        cfg.pop("train_batch_size", None)
+        if cand.get("zero_stage") is not None:
+            cfg.setdefault("zero_optimization", {})["stage"] = \
+                cand["zero_stage"]
+        if cand.get("micro_batch_size") is not None:
+            cfg["train_micro_batch_size_per_gpu"] = cand["micro_batch_size"]
+            cfg.pop("train_batch_size", None)
+        if cand.get("mesh") is not None:
+            cfg["mesh"] = dict(cand["mesh"])
+        if cand.get("gas") is not None:
+            cfg["gradient_accumulation_steps"] = cand["gas"]
         if cand.get("remat") is not None:
             cfg["activation_checkpointing"] = {
                 "partition_activations": False,
@@ -104,6 +111,157 @@ class Autotuner:
                 "device": cand["offload_optimizer"]
             }
         return cfg
+
+    # ------------------------------------------------------------------
+    # AOT scoring (analysis/schedule.py S009): rank configs by the
+    # critical-path step-time projection of their COMPILED step — no
+    # step executes. The reference pays a profiling job per candidate;
+    # the trial-execution path above pays a compile + timed steps; this
+    # pays a compile only, so the whole (mesh, microbatch x gas, zero
+    # stage) space is scoreable from the 8-device CPU mesh and only the
+    # top-k candidates ever run.
+    # ------------------------------------------------------------------
+    def _aot_key(self, cand: Dict[str, Any]) -> str:
+        """Canonical tie-break key: the top-k trial list must be
+        deterministic across runs regardless of dict ordering."""
+        return json.dumps(
+            {k: v for k, v in cand.items() if not k.startswith("aot_")},
+            sort_keys=True, default=str)
+
+    def aot_score(self, cand: Dict[str, Any],
+                  target_devices: Optional[int] = None,
+                  hbm_budget_bytes: Optional[int] = None,
+                  ) -> Dict[str, Any]:
+        """Statically score ONE candidate: compile its train step
+        (engine.sanitize — compile-time only) and read the S009
+        step-time projection off the attached CostReport. Returns the
+        candidate extended with aot_ok / aot_samples_per_sec /
+        aot_step_time_s / aot_exposed_comm_s (or aot_error).
+        Infeasible candidates — failed compile, or an S004
+        over-budget finding at the target — score 0."""
+        import deepspeed_tpu as ds
+
+        exp = dict(cand)
+        try:
+            engine = ds.initialize(
+                self._apply_candidate(cand),
+                loss_fn=self.loss_fn,
+                param_init_fn=self.param_init_fn,
+                param_logical_specs=self.param_logical_specs,
+            )
+            batch = self.make_batch(engine.config.train_batch_size)
+            rep = engine.sanitize(
+                batch, hbm_budget_bytes=hbm_budget_bytes,
+                target_devices=target_devices)
+            cost = rep.cost
+            over_budget = any(
+                f.rule == "S004" and f.severity == "error"
+                for f in rep.findings)
+            if cost is None or cost.step_time_s <= 0:
+                exp.update({"aot_ok": False, "aot_samples_per_sec": 0.0,
+                            "aot_error": "no cost artifacts on this "
+                                         "backend"})
+            else:
+                exp.update({
+                    "aot_ok": not over_budget,
+                    "aot_step_time_s": cost.step_time_s,
+                    "aot_exposed_comm_s": cost.exposed_comm_s,
+                    "aot_peak_hbm_bytes": cost.peak_hbm_bytes,
+                    "aot_samples_per_sec": (
+                        0.0 if over_budget else
+                        engine.config.train_batch_size
+                        / cost.step_time_s),
+                })
+                if over_budget:
+                    exp["aot_error"] = "S004 over budget at target"
+        except Exception as e:  # infeasible shape / bad combo
+            exp.update({"aot_ok": False, "aot_samples_per_sec": 0.0,
+                        "aot_error": f"{type(e).__name__}: {e}"})
+        return exp
+
+    def aot_rank(self, candidates: Sequence[Dict[str, Any]],
+                 target_devices: Optional[int] = None,
+                 hbm_budget_bytes: Optional[int] = None,
+                 ) -> List[Dict[str, Any]]:
+        """Score every candidate AOT and return them ranked: feasible
+        candidates by descending projected samples/sec, ties and
+        infeasibles in canonical-key order (deterministic)."""
+        scored = [self.aot_score(c, target_devices=target_devices,
+                                 hbm_budget_bytes=hbm_budget_bytes)
+                  for c in candidates]
+        scored.sort(key=lambda e: (-e.get("aot_samples_per_sec", 0.0),
+                                   self._aot_key(e)))
+        for e in scored:
+            log_dist(f"autotune aot: {e}", ranks=[0])
+        return scored
+
+    def tune_aot(
+        self,
+        candidates: Optional[Sequence[Dict[str, Any]]] = None,
+        zero_stages: Sequence[int] = (2, 3),
+        micro_batch_sizes: Sequence[int] = (1, 2),
+        mesh_shapes: Optional[Sequence[Dict[str, int]]] = None,
+        gas_values: Optional[Sequence[int]] = None,
+        top_k: int = 3,
+        steps: int = 3,
+        trial: bool = True,
+        target_devices: Optional[int] = None,
+        hbm_budget_bytes: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """AOT-first search: enumerate (zero stage x micro-batch x mesh
+        x gas) candidates (or take them verbatim), rank them all by the
+        S009 projection without executing a step, then trial-execute
+        only the top_k (trial=False skips even that and returns the
+        best projected config). Returns the tuned config dict; the
+        ranked ledger (including infeasibles) lands in
+        <results_dir>/exps.jsonl like every other strategy."""
+        if self.make_batch is None:
+            raise ValueError("Autotuner needs make_batch to generate step data")
+        if candidates is None:
+            meshes = list(mesh_shapes) if mesh_shapes else [None]
+            gases = list(gas_values) if gas_values else [None]
+            candidates = [
+                {"zero_stage": st, "micro_batch_size": mb,
+                 **({"mesh": m} if m is not None else {}),
+                 **({"gas": g} if g is not None else {})}
+                for st in zero_stages for mb in micro_batch_sizes
+                for m in meshes for g in gases
+            ]
+        ranked = self.aot_rank(candidates, target_devices=target_devices,
+                               hbm_budget_bytes=hbm_budget_bytes)
+        self.results.extend({"phase": "aot", **e} for e in ranked)
+        top = [e for e in ranked if e.get("aot_ok")][: max(1, top_k)]
+        if not top:
+            self._flush_results()
+            raise RuntimeError(
+                f"AOT scoring found no feasible config; see "
+                f"{self.results_dir}")
+        if not trial:
+            self._flush_results()
+            best = top[0]
+            log_dist(
+                f"autotune aot best (no trial): {self._aot_key(best)} "
+                f"({best['aot_samples_per_sec']:.1f} projected "
+                "samples/s)", ranks=[0])
+            return self._apply_candidate(best)
+        best = None
+        for cand in top:
+            exp = self._run_exp(
+                {k: v for k, v in cand.items()
+                 if not k.startswith("aot_")}, steps)
+            if exp.get("ok") and (
+                    best is None
+                    or exp["samples_per_sec"] > best["samples_per_sec"]):
+                best = dict(exp)
+        self._flush_results()
+        if best is None:
+            raise RuntimeError(
+                f"every AOT top-{top_k} candidate failed trial "
+                f"execution; see {self.results_dir}")
+        log_dist(
+            f"autotune aot best: {self._aot_key(best)} "
+            f"({best['samples_per_sec']:.1f} samples/s)", ranks=[0])
+        return self._apply_candidate(best)
 
     def _run_exp(self, cand: Dict[str, Any], steps: int) -> Dict[str, Any]:
         exp = dict(cand)

@@ -1311,6 +1311,12 @@ class InferenceEngine:
                         "arg_bytes": float(rep.arg_bytes),
                         "temp_bytes": float(rep.temp_bytes),
                         "comm_bytes": float(rep.comm_bytes),
+                        # schedule-aware S009 projection per bucket
+                        # (analysis/schedule.py): the AOT step-time the
+                        # ds_schedule gate pins for the decode buckets
+                        "step_time_us": float(rep.step_time_s * 1e6),
+                        "exposed_comm_us": float(
+                            rep.exposed_comm_s * 1e6),
                     }
         dt = _time.perf_counter() - t0
         fp = self.warmup_footprints

@@ -16,6 +16,21 @@ from typing import List, Optional
 import jax
 import numpy as np
 
+# --- interconnect link table: THE single authority ---------------------
+#
+# Effective per-chip bandwidths (bytes/s) for the two interconnect tiers
+# a pod topology exposes: ICI within a slice (the v5p-class conservative
+# ~100 GB/s effective figure scripts/ici_projection.py models ring
+# collectives with) and DCN across slices (50 Gbit/s-class effective per
+# chip). Every consumer — analysis/costmodel.py's `ICI_GBPS` re-export,
+# analysis/schedule.py's S007-S009 leg costs, scripts/ici_projection.py
+# — imports THIS table; a drift test (tests/test_schedule.py) fails if
+# any of them re-declares the constant locally.
+LINKS = {
+    "ici_bytes_per_s": 100e9,
+    "dcn_bytes_per_s": 6.25e9,
+}
+
 
 class Accelerator:
     """Device management / memory stats / dtype support for one platform."""
@@ -164,6 +179,18 @@ class Accelerator:
             if key in kind:
                 return val
         return 100e9  # nominal host-memory class; keeps ratios finite
+
+    def ici_bandwidth(self, index: int = 0) -> float:
+        """Effective per-chip intra-slice (ICI) bandwidth in bytes/s —
+        the roofline/schedule comm leg within one slice (LINKS is the
+        single authority)."""
+        return LINKS["ici_bytes_per_s"]
+
+    def dcn_bandwidth(self, index: int = 0) -> float:
+        """Effective per-chip cross-slice (DCN) bandwidth in bytes/s —
+        the tier a replica group pays when it straddles slices
+        (analysis/schedule.py S008)."""
+        return LINKS["dcn_bytes_per_s"]
 
     def random_seed(self, seed: int):
         return jax.random.PRNGKey(seed)

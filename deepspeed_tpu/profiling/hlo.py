@@ -50,6 +50,33 @@ _SHAPE_RE = re.compile(
 _GROUPS_EXPLICIT_RE = re.compile(r"replica_groups=\{\{(?P<first>[\d,]+)\}")
 # `replica_groups=[4,2]<=[8]` (iota form): 4 groups of 2
 _GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(?P<n>\d+),(?P<size>\d+)\]")
+# full iota form incl. the generator dims and optional transpose:
+# `replica_groups=[4,2]<=[2,4]T(1,0)`
+_GROUPS_IOTA_FULL_RE = re.compile(
+    r"replica_groups=\[(?P<n>\d+),(?P<size>\d+)\]"
+    r"<=\[(?P<dims>[\d,]+)\](?:T\((?P<perm>[\d,]+)\))?")
+_GROUPS_ALL_EXPLICIT_RE = re.compile(
+    r"replica_groups=\{(?P<body>\{[\d,]*\}(?:,\{[\d,]*\})*)\}")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{(?P<body>[^}]*(?:\},\{[^}]*)*)\}")
+
+
+_ASYNC_CALLS_RE = re.compile(
+    r"(?:" + "|".join(c for c in _COLLECTIVES if c.endswith("-start"))
+    + r")\([^\n]*?calls=%?(?P<comp>[\w.\-]+)")
+
+
+def _async_wrapped_spans(hlo_text: str) -> List[Tuple[int, int]]:
+    """Text spans of computations wrapped by a counted `-start` op
+    (async sugar printed with its body): collectives inside them must
+    not be counted again next to the start site."""
+    spans = []
+    for m in _ASYNC_CALLS_RE.finditer(hlo_text):
+        h = re.search(r"^\s*%?" + re.escape(m.group("comp"))
+                      + r"\b[^\n=]*\{\s*$", hlo_text, re.M)
+        if h is not None:
+            end = hlo_text.find("\n}", h.end())
+            spans.append((h.end(), end if end != -1 else len(hlo_text)))
+    return spans
 
 
 def _shape_bytes(dtype: str, dims: str) -> int:
@@ -73,6 +100,73 @@ def _group_size(tail: str) -> int:
     return 0
 
 
+def _iota_group_list(n: int, size: int, dims: List[int],
+                     perm: Optional[List[int]]) -> List[List[int]]:
+    """Expand the iota replica-group form to explicit member lists:
+    iota over prod(dims), reshaped to `dims`, transposed by `perm` when
+    present, then reshaped to n groups of `size`."""
+    total = 1
+    for d in dims:
+        total *= d
+    vals = list(range(total))
+    if perm and list(perm) != list(range(len(dims))):
+        strides = [0] * len(dims)
+        s = 1
+        for i in range(len(dims) - 1, -1, -1):
+            strides[i] = s
+            s *= dims[i]
+        tdims = [dims[p] for p in perm]
+        out: List[int] = []
+        idx = [0] * len(tdims)
+        for _ in range(total):
+            out.append(sum(idx[j] * strides[perm[j]]
+                           for j in range(len(perm))))
+            for j in range(len(tdims) - 1, -1, -1):
+                idx[j] += 1
+                if idx[j] < tdims[j]:
+                    break
+                idx[j] = 0
+        vals = out
+    return [vals[i * size:(i + 1) * size] for i in range(n)]
+
+
+def parse_replica_groups(tail: str) -> List[List[int]]:
+    """FULL replica-group member lists of one collective's attribute
+    tail ([] = unstated / flat world `{}`): explicit `{{0,1},{2,3}}`
+    and iota `[n,size]<=[dims](T(perm))` forms both expand to explicit
+    device-id lists — the input the hierarchy-placement check (S008)
+    maps onto slice boundaries."""
+    m = _GROUPS_ALL_EXPLICIT_RE.search(tail)
+    if m is not None:
+        return [[int(x) for x in g.split(",") if x.strip()]
+                for g in re.findall(r"\{([\d,]*)\}", m.group("body"))
+                if g.strip()]
+    m = _GROUPS_IOTA_FULL_RE.search(tail)
+    if m is not None:
+        dims = [int(d) for d in m.group("dims").split(",")]
+        perm = ([int(p) for p in m.group("perm").split(",")]
+                if m.group("perm") else None)
+        return _iota_group_list(int(m.group("n")), int(m.group("size")),
+                                dims, perm)
+    m = _GROUPS_IOTA_RE.search(tail)
+    if m is not None:  # bare [n,size] with no generator: contiguous iota
+        return _iota_group_list(int(m.group("n")), int(m.group("size")),
+                                [int(m.group("n")) * int(m.group("size"))],
+                                None)
+    return []
+
+
+def parse_source_target_pairs(tail: str) -> List[Tuple[int, int]]:
+    """(src, dst) device-id pairs of a collective-permute's attribute
+    tail ([] when unstated)."""
+    m = _PAIRS_RE.search(tail)
+    if m is None:
+        return []
+    return [(int(a), int(b))
+            for a, b in re.findall(r"\{(\d+),(\d+)\}",
+                                   "{" + m.group("body") + "}")]
+
+
 def parse_hlo_collectives(hlo_text: str) -> List[Dict]:
     """Every collective instruction in the HLO with its payload bytes.
 
@@ -84,9 +178,20 @@ def parse_hlo_collectives(hlo_text: str) -> List[Dict]:
     Each record additionally carries the operand payload (`operand_bytes`,
     summed over the shapes inside the call parens) and the replica-group
     size (`group_size`, 0 when unstated/flat) — the inputs the costmodel's
-    per-link volume math needs."""
+    per-link volume math needs.
+
+    Async pairs count ONCE: `-done` ops never match (the op alternation
+    requires an opening paren right after the collective kind), and when
+    a `-start` op carries a `calls=` computation (async sugar printed
+    alongside its wrapped body) the body's inner collective is skipped —
+    only the start site contributes bytes. A collective inside a fusion
+    or while-loop body has no start site and IS attributed (once, like
+    every other instruction — trip counts are not statically known)."""
+    skip_spans = _async_wrapped_spans(hlo_text)
     out = []
     for m in _INSTR_RE.finditer(hlo_text):
+        if any(lo <= m.start() < hi for lo, hi in skip_spans):
+            continue  # body of an already-counted async -start wrapper
         is_start = m.group("op").endswith("-start")
         op = m.group("op").replace("-start", "")
         result = m.group("result")
@@ -374,6 +479,92 @@ def compiled_cost_stats(compiled) -> Optional[Dict[str, float]]:
         "flops": float(ca.get("flops", 0.0) or 0.0),
         "bytes_accessed": float(ca.get("bytes accessed", 0.0) or 0.0),
     }
+
+
+# --- computation/DAG extraction (analysis/schedule.py consumer) --------
+#
+# The schedule analyzer needs more than flat per-collective totals: it
+# needs each computation's instruction SEQUENCE (post-scheduling HLO
+# text order IS the schedule — compiled modules print
+# `is_scheduled=true`), def-use edges to find a collective's first
+# consumer, and async start/done pairing. Parsed per computation so
+# collectives inside fusion bodies and while-loop bodies keep their own
+# schedule context.
+
+_GENERIC_INSTR_RE = re.compile(
+    r"^\s+(?P<root>ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*"
+    r"(?P<result>\((?:[^()]|\([^()]*\))*\)"
+    r"|[a-z][a-z0-9]*(?:\[[^\]]*\])?)"
+    r"\S*\s+(?P<op>[\w\-]+)\((?P<tail>.*)$")
+
+
+def _operand_region(tail: str) -> str:
+    """The operand list of one instruction tail (text up to the paren
+    that closes the call, balancing nested shape tuples)."""
+    depth = 1
+    for i, ch in enumerate(tail):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return tail[:i]
+    return tail
+
+
+def parse_hlo_computations(hlo_text: str,
+                           ) -> Tuple[Dict[str, List[Dict]], Optional[str]]:
+    """({computation name: [instruction records in schedule order]},
+    entry computation name or None).
+
+    Each record: {name, op, result (raw result string), nbytes (summed
+    over result shapes), operands ([referenced %names]), attrs (text
+    after the operand list — replica_groups etc. live here), called
+    ([computation names via calls=/to_apply=/body=/condition=]),
+    root (bool)}."""
+    comps: Dict[str, List[Dict]] = {}
+    entry: Optional[str] = None
+    cur: Optional[List[Dict]] = None
+    for line in hlo_text.splitlines():
+        stripped = line.strip()
+        if cur is None:
+            if (stripped.endswith("{") and " = " not in line
+                    and not stripped.startswith("HloModule")):
+                head = stripped[:-1].strip()
+                is_entry = head.startswith("ENTRY")
+                if is_entry:
+                    head = head[len("ENTRY"):].strip()
+                name = head.split("(")[0].split()[0].lstrip("%") if head \
+                    else ""
+                if name:
+                    cur = comps.setdefault(name, [])
+                    if is_entry:
+                        entry = name
+            continue
+        if stripped.startswith("}"):
+            cur = None
+            continue
+        m = _GENERIC_INSTR_RE.match(line)
+        if m is None:
+            continue
+        tail = m.group("tail")
+        region = _operand_region(tail)
+        attrs = tail[len(region):]
+        nbytes = sum(
+            _shape_bytes(s.group("dtype"), s.group("dims") or "")
+            for s in _SHAPE_RE.finditer(m.group("result")))
+        cur.append({
+            "name": m.group("name"),
+            "op": m.group("op"),
+            "result": m.group("result"),
+            "nbytes": nbytes,
+            "operands": re.findall(r"%([\w.\-]+)", region),
+            "attrs": attrs,
+            "called": re.findall(
+                r"(?:calls|to_apply|body|condition)=%?([\w.\-]+)", attrs),
+            "root": m.group("root") is not None,
+        })
+    return comps, entry
 
 
 def collective_volumes(compiled) -> Dict[str, Dict[str, float]]:

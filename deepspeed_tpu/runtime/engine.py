@@ -1199,16 +1199,24 @@ class DeepSpeedTPUEngine:
     # static verification (analysis/sanitizer.py + analysis/costmodel.py)
     # ------------------------------------------------------------------
     def _cost_checks(self, compiled, label, hbm_budget_bytes=None,
-                     target_devices=None):
+                     target_devices=None, target_topology=None):
         """(CostReport | None, [SanitizerReport]) for one compiled step:
         S004 per-device HBM budget (projectable to a larger mesh), S005
         collective volume vs the live sharded state, S006 roofline (a
-        train step must never compile comm-bound)."""
+        train step must never compile comm-bound), S007 exposed
+        collective time, S009 critical-path step-time — and, when a
+        PodTopology is declared, S008 hierarchy placement of every
+        replica group."""
         from ..analysis.costmodel import (
             build_cost_report,
             check_collective_volume,
             check_hbm_budget,
             check_roofline,
+        )
+        from ..analysis.schedule import (
+            check_exposed_comm,
+            check_hierarchy_placement,
+            check_step_time,
         )
         from ..platform.accelerator import get_accelerator
 
@@ -1232,6 +1240,16 @@ class DeepSpeedTPUEngine:
                            hbm_bandwidth=acc.hbm_bandwidth(),
                            expect="compute", comm_only=True, label=label),
         ]
+        sched = getattr(cost, "_schedule", None)
+        if sched is not None:
+            checks.append(check_exposed_comm(sched, label=label))
+            checks.append(check_step_time(sched, label=label))
+            if target_topology is not None:
+                checks.append(check_hierarchy_placement(
+                    sched, target_topology,
+                    target_devices=(
+                        [target_devices] if target_devices else None),
+                    label=label))
         return cost, checks
 
     def _compressed_kind(self) -> Optional[str]:
@@ -1312,26 +1330,34 @@ class DeepSpeedTPUEngine:
             compiled_text=compiled.as_text(), label=label))
         return reports
 
-    def sanitize(self, batch, hbm_budget_bytes=None, target_devices=None):
+    def sanitize(self, batch, hbm_budget_bytes=None, target_devices=None,
+                 target_topology=None):
         """Statically verify this engine's compiled step against an
         example host batch: (a) every donated TrainState buffer aliases
         an output (S001), (b) the derived ZeRO/TP param specs survive
         SPMD partitioning (S002), (c) recompile hazards observed so far
         (S003), (d) the step's static cost model — peak HBM vs the
         per-device budget (S004), collective volume vs the live sharded
-        state (S005), roofline balance (S006), (e) the numerics
-        sanitizer — accumulation dtypes vs the declared precision
-        policy (N001), fp32 master/optimizer-state integrity (N002),
-        loss-scale coverage (N003), and on the 1-bit/0-1-Adam/qgZ
-        compressed programs the quantized-collective sanity check
-        (N004). Compile-time only — no step executes, no state
-        mutates. Returns analysis.SanitizerReport with `.cost`
-        attached; `report.ok` gates CI.
+        state (S005), roofline balance (S006), (e) the schedule
+        analyzer — exposed collective time (S007), critical-path
+        step-time projection (S009), and with a declared
+        `target_topology` the hierarchy placement of every replica
+        group (S008), (f) the numerics sanitizer — accumulation dtypes
+        vs the declared precision policy (N001), fp32
+        master/optimizer-state integrity (N002), loss-scale coverage
+        (N003), and on the 1-bit/0-1-Adam/qgZ compressed programs the
+        quantized-collective sanity check (N004). Compile-time only —
+        no step executes, no state mutates. Returns
+        analysis.SanitizerReport with `.cost` attached; `report.ok`
+        gates CI.
 
         hbm_budget_bytes: per-device budget (default: the running
         chip's HBM from platform/accelerator.py). target_devices:
         project the footprint to a mesh of this size — catches the
-        replicated-residency term that OOMs at scale."""
+        replicated-residency term that OOMs at scale.
+        target_topology: analysis.schedule.PodTopology describing the
+        slice layout the program is destined for — collectives whose
+        replica groups straddle its DCN boundary surface as S008."""
         import warnings
 
         from ..analysis.report import merge_reports
@@ -1382,7 +1408,7 @@ class DeepSpeedTPUEngine:
                     compiled_g = lowered_g.compile()
                 cost, cost_checks = self._cost_checks(
                     compiled_g, "grad_step", hbm_budget_bytes,
-                    target_devices)
+                    target_devices, target_topology)
                 reports.extend(cost_checks)
                 reports.append(self._numerics_checks(
                     compiled_g, lowered_g, "grad_step", donated=False))
@@ -1418,7 +1444,8 @@ class DeepSpeedTPUEngine:
                 argname="state.params", label="train_step",
             )
         cost, cost_checks = self._cost_checks(
-            compiled, "train_step", hbm_budget_bytes, target_devices)
+            compiled, "train_step", hbm_budget_bytes, target_devices,
+            target_topology)
         num = self._numerics_checks(
             compiled, lowered, "train_step",
             master=self.state.master if self._use_master else None,

@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""ds-schedule CLI — schedule-aware step-time gate (SCHEDULE.json).
+
+Usage:
+    python scripts/ds_schedule.py --capture          # write the baseline
+    python scripts/ds_schedule.py --check            # exit 1 on regression
+    python scripts/ds_schedule.py --check --strict   # warnings also fail
+
+The tier-1 pre-test companion to ds_lint/ds_budget/ds_numerics (see
+.claude/skills/verify/SKILL.md): a PR that serializes a collective the
+schedule used to hide (new S007 exposure), lets the critical path go
+comm-dominated (S009), or drifts the step-time projection beyond the
+committed tolerance fails here before pytest ever runs. Canonical
+programs — compiled on the virtual 8-device CPU mesh, no step executed
+(same pair as ds_budget):
+
+  train_step        the zero-3 + TP fused training step
+  serving_decode_w8 the width-8 paged-KV decode program
+
+Everything is compile-time static analysis: the schedule ledger comes
+from the post-scheduling HLO text (profiling/hlo.py
+parse_hlo_computations) and the leg costs from the shared
+platform/accelerator.LINKS authority, so the gate runs anywhere
+without an accelerator and its numbers are deterministic per jax
+version.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+# the virtual 8-device CPU mesh must exist BEFORE jax initializes
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_PATH = os.path.join(_REPO, "SCHEDULE.json")
+STEP_TIME_TOLERANCE = 0.10   # relative drift that fails --check
+MIN_EXPOSED_US = 50.0        # reporting floor for exposure findings
+
+
+def build_schedules():
+    """{name: (CostReport, ScheduleAnalysis)} for the canonical
+    programs — the SAME compiled artifacts ds_budget gates, reusing its
+    builder so the two baselines can never describe different
+    programs."""
+    from ds_budget import build_reports
+
+    reports, _live = build_reports()
+    out = {}
+    for name, rep in reports.items():
+        sched = getattr(rep, "_schedule", None)
+        if sched is not None:
+            out[name] = (rep, sched)
+    return out
+
+
+def _entry(sched):
+    d = sched.to_dict()
+    return {
+        "step_time_us": round(d["step_time_us"], 3),
+        "exposed_us": round(d["exposed_us"], 3),
+        "compute_us": round(d["compute_us"], 3),
+        "comm_us": round(d["comm_us"], 3),
+        "n_collectives": d["n_collectives"],
+        "n_async": d["n_async"],
+        "n_sync": d["n_sync"],
+    }
+
+
+def capture(path: str) -> int:
+    import jax
+
+    schedules = build_schedules()
+    if not schedules:
+        print(json.dumps({"error": "no schedule artifacts available on "
+                                   "this backend; baseline not written"}))
+        return 1
+    doc = {
+        "schema": 1,
+        "platform": jax.default_backend(),
+        "device_count": jax.device_count(),
+        "jax_version": jax.__version__,
+        "tolerances": {
+            # relative step-time drift that fails --check; exposure
+            # regressions additionally get a MIN_EXPOSED_US absolute
+            # floor so near-zero baselines don't amplify noise
+            "step_time_tolerance": STEP_TIME_TOLERANCE,
+            "min_exposed_us": MIN_EXPOSED_US,
+        },
+        "programs": {name: _entry(sched)
+                     for name, (_rep, sched) in schedules.items()},
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    print(json.dumps({
+        "captured": path,
+        "programs": {n: p["step_time_us"]
+                     for n, p in doc["programs"].items()},
+    }))
+    return 0
+
+
+def check(path: str, strict: bool) -> int:
+    from deepspeed_tpu.analysis.schedule import (
+        check_exposed_comm,
+        check_step_time,
+    )
+
+    if not os.path.exists(path):
+        print(json.dumps({
+            "error": f"no baseline at {path}; run --capture first"}))
+        return 1
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            base = json.load(fh)
+    except (OSError, ValueError) as e:
+        print(json.dumps({"error": f"unreadable baseline {path}: {e}"}))
+        return 1
+    tols = base.get("tolerances", {})
+    tol = float(tols.get("step_time_tolerance", STEP_TIME_TOLERANCE))
+    floor = float(tols.get("min_exposed_us", MIN_EXPOSED_US))
+
+    schedules = build_schedules()
+    findings = []
+    summary = {}
+    for name, (_rep, sched) in schedules.items():
+        entry = base.get("programs", {}).get(name)
+        if entry is None:
+            findings.append({
+                "rule": "S009", "severity": "warning", "program": name,
+                "message": f"no baseline entry for {name}; re-capture"})
+            continue
+        checks = [
+            check_exposed_comm(sched, baseline=entry,
+                               min_exposed_us=floor, tolerance=tol,
+                               label=name),
+            check_step_time(sched, baseline=entry, tolerance=tol,
+                            min_exposed_us=floor, label=name),
+        ]
+        for c in checks:
+            findings.extend(
+                {"rule": f.rule, "severity": f.severity, "program": name,
+                 "message": f.message}
+                for f in c.findings)
+        if sched.n_collectives != entry.get("n_collectives",
+                                            sched.n_collectives):
+            findings.append({
+                "rule": "S007", "severity": "warning", "program": name,
+                "message": (
+                    f"collective count changed: {sched.n_collectives} "
+                    f"vs baseline {entry.get('n_collectives')} — the "
+                    "schedule ledger is stale; re-capture if intended")})
+        summary[name] = {
+            "step_time_us": round(sched.step_time_s * 1e6, 3),
+            "baseline_step_time_us": entry.get("step_time_us"),
+            "exposed_us": round(sched.exposed_s * 1e6, 3),
+            "baseline_exposed_us": entry.get("exposed_us"),
+            "n_collectives": sched.n_collectives,
+        }
+    for name in base.get("programs", {}):
+        if name not in schedules:
+            findings.append({
+                "rule": "S009", "severity": "warning", "program": name,
+                "message": f"baseline program {name} was not rebuilt "
+                           "(backend without schedule artifacts?)"})
+    errors = [f for f in findings if f["severity"] == "error"]
+    failed = bool(errors) or (strict and bool(findings))
+    print(json.dumps({"ok": not failed, "findings": findings,
+                      "programs": summary}))
+    return 1 if failed else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--capture", action="store_true",
+                    help="compile the canonical programs and write the "
+                         "schedule baseline")
+    ap.add_argument("--check", action="store_true",
+                    help="recompile and compare against the baseline; "
+                         "exit 1 on any error-severity finding")
+    ap.add_argument("--strict", action="store_true",
+                    help="with --check: warnings also fail")
+    ap.add_argument("--baseline", default=DEFAULT_PATH,
+                    help=f"baseline path (default {DEFAULT_PATH})")
+    args = ap.parse_args(argv)
+    if args.capture == args.check:
+        ap.error("pass exactly one of --capture / --check")
+    if args.capture:
+        return capture(args.baseline)
+    return check(args.baseline, strict=args.strict)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

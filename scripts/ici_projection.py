@@ -71,12 +71,14 @@ def main():
         vocab_size=32000, n_layers=80, n_heads=64, n_kv_heads=8,
         d_model=8192, d_ff=28672, max_seq=4096, variant="llama",
         use_flash=False)
-    from deepspeed_tpu.analysis.costmodel import ICI_GBPS
+    from deepspeed_tpu.platform.accelerator import LINKS
 
     param_scale = T.param_count(cfg70) / T.param_count(cfg)
     ring_scale = (255 / 256) / (1 / 2)  # 1.99x upper bound
     proj_bytes = total_mb * 1e6 * param_scale * ring_scale
-    ici_gbps = ICI_GBPS  # the shared link constant (analysis/costmodel)
+    # the single link-table authority (platform/accelerator.LINKS —
+    # shared with analysis/costmodel.ICI_GBPS and analysis/schedule)
+    ici_gbps = LINKS["ici_bytes_per_s"]
     out = {
         "mesh": "zero=2 x model=4 (virtual, 8 devices)",
         "slice_layers": L_SLICE,

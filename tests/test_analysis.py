@@ -714,6 +714,102 @@ class TestLintR006:
         assert not found and len(suppressed) == 1
 
 
+class TestLintR007:
+    def test_psum_in_for_loop_fires(self):
+        src = """
+        import jax
+        @jax.jit
+        def f(xs):
+            total = 0.0
+            for x in xs:
+                total = total + jax.lax.psum(x, "data")
+            return total
+        """
+        found, _ = _findings(src)
+        assert [f.rule for f in found] == ["R007"]
+        assert "unrolls the loop" in found[0].message
+        assert found[0].severity == "warning"
+
+    def test_all_gather_in_while_loop_fires(self):
+        src = """
+        import jax
+        from jax import lax
+        @jax.jit
+        def f(x):
+            i = 0
+            while i < 4:
+                x = lax.all_gather(x, "model")
+                i += 1
+            return x
+        """
+        found, _ = _findings(src)
+        assert [f.rule for f in found] == ["R007"]
+
+    def test_comm_wrapper_names_fire_too(self):
+        """The comm/ wrappers share the lax collective names — the
+        unrolled-volume class does not care which module spelled it."""
+        src = """
+        import jax
+        from deepspeed_tpu import comm
+        @jax.jit
+        def f(xs):
+            out = []
+            for x in xs:
+                out.append(comm.psum_scatter(x, "data"))
+            return out
+        """
+        found, _ = _findings(src)
+        assert [f.rule for f in found] == ["R007"]
+
+    def test_scan_and_fori_loop_are_clean(self):
+        """The carried-loop forms compile ONE collective in the body —
+        exactly the fix the rule suggests."""
+        src = """
+        import jax
+        from jax import lax
+        @jax.jit
+        def f(x):
+            def body(c, _):
+                return c + lax.psum(c, "data"), None
+            y, _ = lax.scan(body, x, None, length=4)
+            return lax.fori_loop(0, 4, lambda i, c: c * 2, y)
+        """
+        found, _ = _findings(src)
+        assert not found
+
+    def test_collective_outside_loop_is_clean(self):
+        src = """
+        import jax
+        @jax.jit
+        def f(x):
+            return jax.lax.psum(x, "data")
+        """
+        found, _ = _findings(src)
+        assert not found
+
+    def test_loop_outside_jit_is_clean(self):
+        src = """
+        import jax
+        def host(xs):
+            return [jax.lax.psum(x, "data") for x in xs]
+        """
+        found, _ = _findings(src)
+        assert not found
+
+    def test_pragma_suppresses(self):
+        src = """
+        import jax
+        @jax.jit
+        def f(xs):
+            total = 0.0
+            for x in xs:
+                total = total + jax.lax.psum(x, "data")  # ds-lint: ok R007 2-hop unrolled ring, bounded by mesh axis
+            return total
+        """
+        found, suppressed = _findings(src)
+        assert not found and len(suppressed) == 1
+
+
 class TestMergeReports:
     def _f(self, rule, path="p"):
         from deepspeed_tpu.analysis import Finding

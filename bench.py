@@ -282,6 +282,11 @@ def _serving_sim():
             "new_signatures_after_warmup": int(new_sigs),
             "prefix_cache_hits": int(
                 eng.prefix_cache_stats()["lookup_hits"]),
+            # KV-pool residency (engine.prefix_cache_stats): resident
+            # bytes/token + quantized-vs-bf16 pool flag per lane
+            "kv_bytes_per_token": int(
+                eng.prefix_cache_stats()["kv_bytes_per_token"]),
+            "kv_pool_quantized": bool(eng.cache.quantized),
             # warmup-time static footprint per decode bucket (analysis/
             # costmodel via engine.warmup) — the S004 admission inputs
             "hbm_per_bucket_mb": {
@@ -423,6 +428,11 @@ def _fleet_lane(build_engine, n_replicas, router_cfg, trace, seed=0,
         "handoff_p50_ms": round(fleet["fleet/handoff_p50_ms"], 2),
         "preemptions": int(sum(s.counters["preemptions"]
                                for s in router.schedulers)),
+        # KV-pool residency (engine.kv_bytes_per_token): resident
+        # bytes/token and whether the pool is int8-quantized — the
+        # capacity lever docs/paged_attention.md describes
+        "kv_bytes_per_token": int(engines[0].kv_bytes_per_token()),
+        "kv_pool_quantized": bool(engines[0].cache.quantized),
     }
 
 
@@ -566,6 +576,8 @@ def _router_sim(n_replicas: int):
                 "handoffs": r["handoffs"],
                 "handoff_p50_ms": r["handoff_p50_ms"],
                 "preemptions": r["preemptions"],
+                "kv_bytes_per_token": r["kv_bytes_per_token"],
+                "kv_pool_quantized": r["kv_pool_quantized"],
             } for name, r in res.items()},
         "platform": jax.default_backend(),
     }

@@ -1,6 +1,7 @@
 from .engine import (
     InferenceConfig,
     InferenceEngine,
+    KvCacheDtypeError,
     init_inference,
     init_inference_from_hf,
 )
@@ -24,6 +25,7 @@ from .scheduler import Request, ServingScheduler, ServingSchedulerConfig
 __all__ = [
     "InferenceConfig",
     "InferenceEngine",
+    "KvCacheDtypeError",
     "init_inference",
     "init_inference_from_hf",
     "BlockedAllocator",

@@ -62,6 +62,17 @@ class InferenceConfig(ConfigModel):
     num_kv_blocks: int = 512          # total paged-cache blocks
     min_prefill_bucket: int = 64
     tp_size: int = 1                  # tensor-parallel degree
+    # KV-cache residency dtype: 'auto' = the engine compute dtype;
+    # 'int8' = per-block quantized pools (int8 codes + [bs, KV] f32
+    # scale tiles per block; docs/paged_attention.md) — ~2x (bf16) /
+    # ~4x (f32) more resident tokens per HBM byte, and export/spill
+    # payloads shrink by the same factor
+    kv_cache_dtype: str = "auto"
+    # decode attention implementation: 'auto' = Pallas kernels on TPU,
+    # the XLA gather oracle elsewhere; 'pallas' forces the fused
+    # kernels (interpret mode off-TPU — the CPU test/gate lane);
+    # 'xla' forces the oracle
+    decode_impl: str = "auto"
     # automatic prefix caching (config/config.py PrefixCacheConfig):
     # hash-matched block reuse + COW tails in the ragged control plane
     prefix_cache: PrefixCacheConfig = Field(default_factory=PrefixCacheConfig)
@@ -69,6 +80,16 @@ class InferenceConfig(ConfigModel):
     @property
     def blocks_per_seq(self) -> int:
         return -(-self.max_seq_len // self.kv_block_size)
+
+
+class KvCacheDtypeError(ValueError):
+    """KV pages cannot move between engines whose cache dtypes differ:
+    an int8 payload's codes+scales mean nothing to a bf16 pool and vice
+    versa, and silently dequantizing would break the token-identity
+    contract of the recompute fallback. Typed (a ValueError subclass)
+    so the router's fleet-construction check and direct import_kv
+    callers can reject mixed-dtype fleets explicitly — mirroring the
+    heterogeneous-fleet geometry rejection."""
 
 
 def _bucket(n: int, lo: int) -> int:
@@ -339,11 +360,24 @@ class InferenceEngine:
         # write+attend RMWs every decode row's newest block, so padding
         # rows need a target that can never alias a live sequence
         self.pad_block = self.config.num_kv_blocks
+        if self.config.kv_cache_dtype not in ("auto", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be 'auto' or 'int8' "
+                f"(got {self.config.kv_cache_dtype!r})")
+        if self.config.decode_impl not in ("auto", "pallas", "xla"):
+            raise ValueError(
+                f"decode_impl must be 'auto', 'pallas' or 'xla' "
+                f"(got {self.config.decode_impl!r})")
+        self.kv_quant = self.config.kv_cache_dtype == "int8"
         self.cache = M.init_cache(
             model_config, self.config.num_kv_blocks + 1,
             self.config.kv_block_size, dtype, mesh=self.mesh,
+            kv_quant=self.kv_quant,
         )
-        self._use_kernel = jax.default_backend() == "tpu"
+        self._use_kernel = (
+            self.config.decode_impl == "pallas"
+            or (self.config.decode_impl == "auto"
+                and jax.default_backend() == "tpu"))
         self._prefill_batch_fns: Dict[Tuple[int, int], Any] = {}
         # keyed (batch_width, unique_rows)
         self._decode_fns: Dict[Tuple[int, bool], Any] = {}
@@ -359,9 +393,13 @@ class InferenceEngine:
         # ({width: {peak_hbm_bytes, ...}} — analysis/costmodel.py)
         self.warmup_footprints: Dict[int, Dict[str, float]] = {}
         kv_bytes = sum(x.nbytes for x in self.cache.k + self.cache.v)
+        if self.kv_quant:
+            kv_bytes += sum(x.nbytes
+                            for x in self.cache.k_scale + self.cache.v_scale)
         log_dist(
             f"inference engine: {self.config.num_kv_blocks} KV blocks x "
-            f"{self.config.kv_block_size} tokens ({kv_bytes/2**30:.2f} GiB cache), "
+            f"{self.config.kv_block_size} tokens ({kv_bytes/2**30:.2f} GiB "
+            f"{'int8' if self.kv_quant else str(dtype.__name__ if hasattr(dtype, '__name__') else dtype)} cache), "
             f"max_batch {self.config.max_batch_size}",
             ranks=[0],
         )
@@ -727,9 +765,15 @@ class InferenceEngine:
         scalars, so the first copy pays the only compile)."""
         if self._cow_fn is None:
             def cp(cache, s, d):
+                # scale tiles are part of the page: a quantized COW
+                # clones them with their codes
                 return M.PagedCache(
                     k=[ck.at[d].set(ck[s]) for ck in cache.k],
                     v=[cv.at[d].set(cv[s]) for cv in cache.v],
+                    k_scale=(None if cache.k_scale is None else
+                             [ks.at[d].set(ks[s]) for ks in cache.k_scale]),
+                    v_scale=(None if cache.v_scale is None else
+                             [vs.at[d].set(vs[s]) for vs in cache.v_scale]),
                 )
 
             # donated: cache aliases the returned PagedCache (in-place
@@ -738,11 +782,34 @@ class InferenceEngine:
         self.cache = self._cow_fn(self.cache, jnp.int32(src),
                                   jnp.int32(dst))
 
+    def kv_bytes_per_token(self) -> int:
+        """Resident KV bytes one token costs across all layers — codes
+        (+ per-block scale tiles when quantized). The capacity number
+        the ds_budget gate pins the int8/bf16 ratio on (>= 1.8x)."""
+        per_tok = 0
+        for l in range(self.cfg.n_layers):
+            # one token slot of one block: [KV, D] in the pool dtype
+            per_tok += 2 * self.cache.k[l][0, 0].nbytes
+            if self.cache.quantized:
+                per_tok += 2 * self.cache.k_scale[l][0, 0].nbytes
+        return per_tok
+
     def prefix_cache_stats(self) -> Dict[str, float]:
         """Per-engine prefix-cache counters: lookup hits/misses,
         cached-token ratio, LRU evictions, COW copies (ragged.py
-        StateManager.cache_stats)."""
-        return self.state.cache_stats()
+        StateManager.cache_stats) — plus the KV-pool residency
+        numbers: kv_bytes_per_token (codes + scale tiles),
+        kv_pool_bytes (whole resident pool incl. the scratch block),
+        and kv_quantized (1.0 on the int8 pools)."""
+        s = self.state.cache_stats()
+        pool = sum(x.nbytes for x in self.cache.k + self.cache.v)
+        if self.cache.quantized:
+            pool += sum(x.nbytes
+                        for x in self.cache.k_scale + self.cache.v_scale)
+        s["kv_bytes_per_token"] = float(self.kv_bytes_per_token())
+        s["kv_pool_bytes"] = float(pool)
+        s["kv_quantized"] = 1.0 if self.cache.quantized else 0.0
+        return s
 
     # -- paged-KV block transfer (prefill/decode disaggregation) ---------
     def _kv_gather_fn(self):
@@ -752,8 +819,13 @@ class InferenceEngine:
         sequence length."""
         if self._kv_gather is None:
             def gather(cache, idx):
-                return (jnp.stack([ck[idx] for ck in cache.k]),
-                        jnp.stack([cv[idx] for cv in cache.v]))
+                out = (jnp.stack([ck[idx] for ck in cache.k]),
+                       jnp.stack([cv[idx] for cv in cache.v]))
+                if cache.k_scale is not None:
+                    # quantized pages travel with their scale tiles
+                    out += (jnp.stack([ks[idx] for ks in cache.k_scale]),
+                            jnp.stack([vs[idx] for vs in cache.v_scale]))
+                return out
 
             self._kv_gather = jax.jit(gather)
         return self._kv_gather
@@ -763,11 +835,26 @@ class InferenceEngine:
         (cache, idx, k, v) -> cache with rows idx overwritten. Pad rows
         land on the reserved scratch block (never a live page)."""
         if self._kv_scatter is None:
-            def scatter(cache, idx, k, v):
-                return M.PagedCache(
-                    k=[ck.at[idx].set(k[l]) for l, ck in enumerate(cache.k)],
-                    v=[cv.at[idx].set(v[l]) for l, cv in enumerate(cache.v)],
-                )
+            if self.kv_quant:
+                def scatter(cache, idx, k, v, ks, vs):
+                    return M.PagedCache(
+                        k=[ck.at[idx].set(k[l])
+                           for l, ck in enumerate(cache.k)],
+                        v=[cv.at[idx].set(v[l])
+                           for l, cv in enumerate(cache.v)],
+                        k_scale=[p.at[idx].set(ks[l])
+                                 for l, p in enumerate(cache.k_scale)],
+                        v_scale=[p.at[idx].set(vs[l])
+                                 for l, p in enumerate(cache.v_scale)],
+                    )
+            else:
+                def scatter(cache, idx, k, v):
+                    return M.PagedCache(
+                        k=[ck.at[idx].set(k[l])
+                           for l, ck in enumerate(cache.k)],
+                        v=[cv.at[idx].set(v[l])
+                           for l, cv in enumerate(cache.v)],
+                    )
 
             # donated: the live cache aliases the returned one (an
             # in-place page write, no second cache allocation)
@@ -781,11 +868,16 @@ class InferenceEngine:
         return idx
 
     def kv_payload_nbytes(self, n_blocks: int) -> int:
-        """Size in bytes of an export_kv payload's K+V page stacks for
-        a sequence holding `n_blocks` blocks — the spill tier's budget
-        pre-check (scheduler._try_spill), computed WITHOUT paying the
-        compiled gather + readback."""
+        """Size in bytes of an export_kv payload's K+V page stacks —
+        codes plus, for quantized pools, the per-block scale tiles —
+        for a sequence holding `n_blocks` blocks: the spill tier's
+        budget pre-check (scheduler._try_spill), computed WITHOUT
+        paying the compiled gather + readback. A quantized pool's
+        payload is ~2x (bf16) / ~4x (f32) smaller, so the same
+        pinned-host spill budget parks that many more victims."""
         per_page = int(self.cache.k[0][0].nbytes)
+        if self.cache.quantized:
+            per_page += int(self.cache.k_scale[0][0].nbytes)
         return 2 * self.cfg.n_layers * n_blocks * per_page
 
     def export_kv(self, uid: int) -> Dict[str, Any]:
@@ -814,21 +906,33 @@ class InferenceEngine:
                  -(-seq.seen_tokens // self.state.block_size))
         idx = self._pad_block_idx(seq.blocks[:nb])
         self.recompile_tracker.record("kv_transfer_gather", (idx,))
-        k, v = self._kv_gather_fn()(self.cache, self._dev(idx))
+        gathered = self._kv_gather_fn()(self.cache, self._dev(idx))
+        k, v = gathered[0], gathered[1]
         payload = {
             "seen_tokens": int(seq.seen_tokens),
             "n_blocks": nb,
+            # the receiver must lay the pages into a dtype-identical
+            # pool (import_kv rejects mixed-dtype fleets typed)
+            "kv_dtype": str(self.cache.k[0].dtype),
             "token_ids": (list(seq.tokens[:seq.seen_tokens])
                           if seq.tokens_valid else None),
             "k": serving_readback(k)[:, :nb],
             "v": serving_readback(v)[:, :nb],
         }
+        if self.cache.quantized:
+            # per-block scale tiles ship WITH their code pages — and
+            # under the digest below, so a flipped scale byte is caught
+            # exactly like a flipped code byte
+            ks, vs = gathered[2], gathered[3]
+            payload["k_scale"] = serving_readback(ks)[:, :nb]
+            payload["v_scale"] = serving_readback(vs)[:, :nb]
         # integrity envelope (resilience/integrity.py): blake2b over
-        # every field's bytes+dtype+shape, attached at the sender —
-        # import_kv verifies it before a single page is scattered, so
-        # a bit flipped in transit or in the receiver's DRAM falls
-        # back to the token-identical recompute path instead of
-        # serving corrupted KV
+        # every field's bytes+dtype+shape (sorted keys — the quantized
+        # payload's scale tensors are covered too), attached at the
+        # sender — import_kv verifies it before a single page is
+        # scattered, so a bit flipped in transit or in the receiver's
+        # DRAM falls back to the token-identical recompute path
+        # instead of serving corrupted KV
         payload["digest"] = payload_digest(payload)
         return payload
 
@@ -858,6 +962,18 @@ class InferenceEngine:
             raise HandoffIntegrityError(
                 f"KV handoff payload of uid {uid} failed digest "
                 "verification — discarding (recompute fallback)")
+        own_dtype = str(self.cache.k[0].dtype)
+        sent_dtype = payload.get("kv_dtype", own_dtype)
+        if sent_dtype != own_dtype:
+            # typed BEFORE any allocation (mirrors the heterogeneous-
+            # fleet geometry rejection): a quantized payload cannot
+            # land in a full-precision pool — the caller's recompute
+            # fallback stays token-identical, silent dequantization
+            # would not
+            raise KvCacheDtypeError(
+                f"KV payload of uid {uid} carries {sent_dtype} pages but "
+                f"this engine's pool is {own_dtype} — mixed-kv-dtype "
+                "fleets are rejected; recompute the sequence instead")
         n_tok = int(payload["seen_tokens"])
         nb = int(payload["n_blocks"])
         k, v = payload["k"], payload["v"]
@@ -867,6 +983,11 @@ class InferenceEngine:
                 f"KV payload geometry {k.shape} does not match this "
                 f"engine's cache pages {(self.cfg.n_layers, nb) + want} — "
                 "disaggregated replicas must be model/geometry-identical")
+        if self.kv_quant and ("k_scale" not in payload
+                              or "v_scale" not in payload):
+            raise KvCacheDtypeError(
+                f"int8 KV payload of uid {uid} is missing its per-block "
+                "scale tensors — refusing to scatter scaleless codes")
         seq = self.state.extend(uid, n_tok)  # may raise: pool exhausted
         assert len(seq.blocks) == nb, (len(seq.blocks), nb)
         idx = self._pad_block_idx(seq.blocks)
@@ -875,9 +996,15 @@ class InferenceEngine:
         kp = np.zeros((k.shape[0], B) + tuple(k.shape[2:]), dt)
         vp = np.zeros_like(kp)
         kp[:, :nb], vp[:, :nb] = k, v
+        args = [self._dev(kp), self._dev(vp)]
+        if self.kv_quant:
+            ksp = np.ones((k.shape[0], B) + tuple(k.shape[2:4]), np.float32)
+            vsp = np.ones_like(ksp)
+            ksp[:, :nb], vsp[:, :nb] = payload["k_scale"], payload["v_scale"]
+            args += [self._dev(ksp), self._dev(vsp)]
         self.recompile_tracker.record("kv_transfer_scatter", (idx,))
         self.cache = self._kv_scatter_fn()(
-            self.cache, self._dev(idx), self._dev(kp), self._dev(vp))
+            self.cache, self._dev(idx), *args)
         self.state.commit(uid, n_tok, token_ids=payload["token_ids"])
 
     def warmup_kv_transfer(self) -> None:
@@ -887,10 +1014,10 @@ class InferenceEngine:
         contract warmup() gives the decode grid)."""
         idx = self._pad_block_idx([])
         self.recompile_tracker.record("kv_transfer_gather", (idx,))
-        k, v = self._kv_gather_fn()(self.cache, self._dev(idx))
+        gathered = self._kv_gather_fn()(self.cache, self._dev(idx))
         self.recompile_tracker.record("kv_transfer_scatter", (idx,))
         self.cache = self._kv_scatter_fn()(
-            self.cache, self._dev(idx), k, v)
+            self.cache, self._dev(idx), *gathered)
 
     # -- scheduling queries (ref: engine_v2.py query:158/can_schedule:184)
     def query(self, uid: int) -> Dict[str, Any]:

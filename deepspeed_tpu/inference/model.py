@@ -48,6 +48,8 @@ from ..ops.pallas.paged_attention import (
     paged_decode_attention_xla,
     paged_decode_fused,
     paged_kv_write,
+    paged_scale_write,
+    quantize_kv_rows,
     supports_fused_v2,
 )
 from .quantization import ChannelQuantWeight, channel_quantize
@@ -285,10 +287,17 @@ def _shard_map_kernel(fn, mesh: Mesh, in_specs, out_specs):
 
 
 class PagedCache(NamedTuple):
-    """Per-layer lists (length n_layers) of [NBLK, bs, KV, D] arrays."""
+    """Per-layer lists (length n_layers) of [NBLK, bs, KV, D] arrays.
+
+    int8-quantized caches (kv_quant) additionally carry per-layer
+    [NBLK, bs, KV] f32 scale-tile pools: block i's codes dequantize by
+    k_scale[i] — the scales are part of the page, so every path that
+    moves pages (COW, export/import, spill) moves them together."""
 
     k: List[jnp.ndarray]
     v: List[jnp.ndarray]
+    k_scale: Optional[List[jnp.ndarray]] = None
+    v_scale: Optional[List[jnp.ndarray]] = None
 
     @property
     def block_size(self) -> int:
@@ -298,19 +307,38 @@ class PagedCache(NamedTuple):
     def num_blocks(self) -> int:
         return self.k[0].shape[0]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
 
 def init_cache(
     cfg: T.TransformerConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16,
-    mesh: Optional[Mesh] = None,
+    mesh: Optional[Mesh] = None, kv_quant: bool = False,
 ) -> PagedCache:
+    """kv_quant=True allocates int8 code pools + f32 per-block scale
+    tiles instead of `dtype` pools — half (vs bf16) or a quarter (vs
+    f32) the resident KV bytes plus KV*8 scale bytes per token."""
     KV, D, L = cfg.kv_heads, cfg.head_dim, cfg.n_layers
     shape = (num_blocks, block_size, KV, D)
+    if kv_quant:
+        dtype = jnp.int8
     if mesh is not None:
         sharding = NamedSharding(mesh, cache_pspec(mesh, KV))
         mk = lambda: jax.device_put(jnp.zeros(shape, dtype), sharding)
+        sc_sharding = NamedSharding(
+            mesh, P(*cache_pspec(mesh, KV)[:3]))  # scales shard with KV
+        mks = lambda: jax.device_put(
+            jnp.ones(shape[:3], jnp.float32), sc_sharding)
     else:
         mk = lambda: jnp.zeros(shape, dtype)
-    return PagedCache(k=[mk() for _ in range(L)], v=[mk() for _ in range(L)])
+        mks = lambda: jnp.ones(shape[:3], jnp.float32)
+    if not kv_quant:
+        return PagedCache(k=[mk() for _ in range(L)],
+                          v=[mk() for _ in range(L)])
+    return PagedCache(
+        k=[mk() for _ in range(L)], v=[mk() for _ in range(L)],
+        k_scale=[mks() for _ in range(L)], v_scale=[mks() for _ in range(L)])
 
 
 def _rope_at(x, positions, cfg: T.TransformerConfig):
@@ -381,6 +409,43 @@ def _write_kv_xla(cache_k, cache_v, k_new, v_new, flat_idx):
     ck = cache_k.reshape(NBLK * bs, KV, D).at[idx].set(k_new, mode="drop")
     cv = cache_v.reshape(NBLK * bs, KV, D).at[idx].set(v_new, mode="drop")
     return ck.reshape(NBLK, bs, KV, D), cv.reshape(NBLK, bs, KV, D)
+
+
+def _write_scales_xla(k_scale, v_scale, ks_new, vs_new, flat_idx):
+    """jnp scatter of [T, KV] per-row quant scales into the
+    [NBLK, bs, KV] scale pools (oracle + TP-degenerate fallback for
+    paged_scale_write; same -1-drops contract as _write_kv_xla)."""
+    NBLK, bs, KV = k_scale.shape
+    idx = jnp.where(flat_idx < 0, NBLK * bs, flat_idx)
+    ks = k_scale.reshape(NBLK * bs, KV).at[idx].set(ks_new, mode="drop")
+    vs = v_scale.reshape(NBLK * bs, KV).at[idx].set(vs_new, mode="drop")
+    return ks.reshape(NBLK, bs, KV), vs.reshape(NBLK, bs, KV)
+
+
+def _write_kv_quant(cache_k, cache_v, k_scale, v_scale, k_new, v_new,
+                    flat_idx, mesh=None):
+    """Quantize [T, KV, D] new rows (quantize_kv_rows — THE rounding
+    authority, shared with the fused kernel) and write codes + scale
+    rows into the int8 pools. Codes ride the same Pallas RMW path as
+    bf16 (_write_kv is dtype-generic); scales ride paged_scale_write
+    (or the XLA scatter on the degenerate TP layout)."""
+    qk, ks, qv, vs = quantize_kv_rows(k_new, v_new)
+    ck, cv = _write_kv(cache_k, cache_v, qk, qv, flat_idx, mesh)
+    KV = cache_k.shape[2]
+    tp = _tp_size(mesh)
+    if tp > 1 and KV % tp == 0:
+        sp = P(None, None, "model")
+        new = P(None, "model")
+        cks, cvs = _shard_map_kernel(
+            paged_scale_write, mesh,
+            in_specs=(sp, sp, new, new, P(None)),
+            out_specs=(sp, sp),
+        )(k_scale, v_scale, ks, vs, flat_idx)
+    elif tp > 1:
+        cks, cvs = _write_scales_xla(k_scale, v_scale, ks, vs, flat_idx)
+    else:
+        cks, cvs = paged_scale_write(k_scale, v_scale, ks, vs, flat_idx)
+    return ck, cv, cks, cvs
 
 
 def _sparsity(cfg: T.TransformerConfig):
@@ -557,20 +622,28 @@ def _mlp(h, lp, cfg: T.TransformerConfig):
 
 def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None,
                       allowed_slots=None, window: int = 0, mesh=None,
-                      k_new=None, v_new=None, slots=None, alibi=None):
+                      k_new=None, v_new=None, slots=None, alibi=None,
+                      k_scale=None, v_scale=None):
     """k_new/v_new/slots non-None selects the FUSED write+attend kernel
     (single-token decode rows; ck/cv are the PRE-write arenas and the
     returned (att, ck, cv) includes the in-kernel RMW).
 
     alibi: optional [H] per-head slopes (Bloom-class) — every path below
-    biases scores by slope_h * key_pos (exact per single query row)."""
+    biases scores by slope_h * key_pos (exact per single query row).
+
+    k_scale/v_scale non-None selects the int8-KV paths: ck/cv hold int8
+    codes, the per-block scale tiles ride every branch next to their
+    code pools, and fused mode additionally returns the updated scale
+    pools (att, ck, cv, cks, cvs). The quantized fused path runs the
+    (S, NB)-grid kernel — the v2 manual-DMA kernel stays bf16-only."""
     fused = k_new is not None
+    quant = k_scale is not None
     if allowed_slots is not None and use_kernel and _tp_size(mesh) <= 1:
         # block-sparse serving on the Pallas kernels: the layout rides
         # in as a per-slot bitmap. Fused+v2 skips pruned slots' DMA
         # entirely; the (S, NB)-grid kernel clamps them to a resident
         # tile (still no fresh DMA, but a grid step each).
-        if fused and supports_fused_v2(q.shape[-1]):
+        if fused and not quant and supports_fused_v2(q.shape[-1]):
             return paged_decode_fused(q, ck, cv, table, ctx,
                                       k_new, v_new, slots, window=window,
                                       allowed_slots=allowed_slots,
@@ -578,7 +651,8 @@ def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None,
         return paged_decode_attention(q, ck, cv, table, ctx, window=window,
                                       allowed_slots=allowed_slots,
                                       k_new=k_new, v_new=v_new, slots=slots,
-                                      alibi_slopes=alibi)
+                                      alibi_slopes=alibi,
+                                      k_scale=k_scale, v_scale=v_scale)
     if allowed is not None:
         # layout finer than the cache blocks (or TP mesh): XLA path with
         # the per-position mask. (window is passed through for
@@ -587,7 +661,8 @@ def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None,
         assert not fused
         return paged_decode_attention_xla(q, ck, cv, table, ctx,
                                           allowed=allowed, window=window,
-                                          alibi_slopes=alibi)
+                                          alibi_slopes=alibi,
+                                          k_scale=k_scale, v_scale=v_scale)
     tp = _tp_size(mesh)
     H, KV = q.shape[1], ck.shape[2]
     if tp > 1 and H % tp == 0 and KV % tp == 0:
@@ -597,6 +672,26 @@ def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None,
                      else paged_decode_attention_xla, window=window)
         qs = P(None, "model", None)
         kv = P(None, None, "model", None)
+        sp = P(None, None, "model")  # scale tiles shard with the heads
+        if quant:
+            if alibi is not None:
+                wrapped = (lambda q_, k_, v_, t_, c_, ks_, vs_, ab_:
+                           fn(q_, k_, v_, t_, c_, k_scale=ks_, v_scale=vs_,
+                              alibi_slopes=ab_))
+                return _shard_map_kernel(
+                    wrapped, mesh,
+                    in_specs=(qs, kv, kv, P(None, None), P(None), sp, sp,
+                              P("model")),
+                    out_specs=qs,
+                )(q, ck, cv, table, ctx, k_scale, v_scale,
+                  jnp.asarray(alibi, jnp.float32))
+            wrapped = (lambda q_, k_, v_, t_, c_, ks_, vs_:
+                       fn(q_, k_, v_, t_, c_, k_scale=ks_, v_scale=vs_))
+            return _shard_map_kernel(
+                wrapped, mesh,
+                in_specs=(qs, kv, kv, P(None, None), P(None), sp, sp),
+                out_specs=qs,
+            )(q, ck, cv, table, ctx, k_scale, v_scale)
         if alibi is not None:
             # slopes shard with the heads (each device biases its own)
             wrapped = (lambda q_, k_, v_, t_, c_, ab_:
@@ -612,7 +707,7 @@ def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None,
             out_specs=qs,
         )(q, ck, cv, table, ctx)
     if use_kernel and tp <= 1:
-        if fused and supports_fused_v2(q.shape[-1]):
+        if fused and not quant and supports_fused_v2(q.shape[-1]):
             # per-sequence grid + manual block DMA: the dense decode hot
             # path (live blocks only, 2KB row writes instead of 256KB
             # block RMW through the output pipeline)
@@ -621,12 +716,14 @@ def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None,
                                       alibi_slopes=alibi)
         return paged_decode_attention(q, ck, cv, table, ctx, window=window,
                                       k_new=k_new, v_new=v_new, slots=slots,
-                                      alibi_slopes=alibi)
+                                      alibi_slopes=alibi,
+                                      k_scale=k_scale, v_scale=v_scale)
     # under a TP mesh with non-divisible heads, the XLA path lets SPMD
     # partition freely (a raw pallas_call over sharded operands cannot)
     assert not fused
     return paged_decode_attention_xla(q, ck, cv, table, ctx, window=window,
-                                      alibi_slopes=alibi)
+                                      alibi_slopes=alibi,
+                                      k_scale=k_scale, v_scale=v_scale)
 
 
 # ---------------------------------------------------------------------------
@@ -693,6 +790,7 @@ def decode_step(
         unique_rows and use_kernel and _tp_size(mesh) <= 1
         and allowed is None
     )
+    quant = cache.quantized
 
     # per-row flat slot: each row has its own table; padding rows
     # scatter to -1 which mode="drop" discards
@@ -703,6 +801,7 @@ def decode_step(
     flat_idx = jnp.where(valid, flat_idx, jnp.int32(-1))
 
     new_k, new_v = [], []
+    new_ks, new_vs = [], []  # quantized caches: per-block scale pools
     x_hist = []  # layer outputs; fetch l is barriered on output l-2
     for li, lp in enumerate(params["layers"]):
         if fetch_layer is not None:
@@ -729,25 +828,49 @@ def decode_step(
         k = _cons(k, mesh, None, "model", None)
         v = _cons(v, mesh, None, "model", None)
 
-        ck_in, cv_in = cache.k[len(new_k)], cache.v[len(new_k)]
+        li_c = len(new_k)
+        ck_in, cv_in = cache.k[li_c], cache.v[li_c]
+        cks = cvs = None
         if fuse_write:
-            att, ck, cv = _decode_attention(
-                q, ck_in, cv_in, tables, ctx_lens, use_kernel,
-                allowed_slots=allowed_slots,
-                window=cfg.window_for_layer(li),
-                mesh=mesh, k_new=k, v_new=v, slots=flat_idx, alibi=alibi,
-            )
+            if quant:
+                att, ck, cv, cks, cvs = _decode_attention(
+                    q, ck_in, cv_in, tables, ctx_lens, use_kernel,
+                    allowed_slots=allowed_slots,
+                    window=cfg.window_for_layer(li),
+                    mesh=mesh, k_new=k, v_new=v, slots=flat_idx,
+                    alibi=alibi, k_scale=cache.k_scale[li_c],
+                    v_scale=cache.v_scale[li_c],
+                )
+            else:
+                att, ck, cv = _decode_attention(
+                    q, ck_in, cv_in, tables, ctx_lens, use_kernel,
+                    allowed_slots=allowed_slots,
+                    window=cfg.window_for_layer(li),
+                    mesh=mesh, k_new=k, v_new=v, slots=flat_idx,
+                    alibi=alibi,
+                )
         else:
-            ck, cv = _write_kv(ck_in, cv_in, k, v, flat_idx, mesh)
+            if quant:
+                ck, cv, cks, cvs = _write_kv_quant(
+                    ck_in, cv_in, cache.k_scale[li_c], cache.v_scale[li_c],
+                    k, v, flat_idx, mesh)
+                cks = _cons(cks, mesh, None, None, "model")
+                cvs = _cons(cvs, mesh, None, None, "model")
+            else:
+                ck, cv = _write_kv(ck_in, cv_in, k, v, flat_idx, mesh)
             ck = _cons(ck, mesh, None, None, "model", None)
             cv = _cons(cv, mesh, None, None, "model", None)
             att = _decode_attention(q, ck, cv, tables, ctx_lens, use_kernel,
                                     allowed=allowed,
                                     allowed_slots=allowed_slots,
                                     window=cfg.window_for_layer(li),
-                                    mesh=mesh, alibi=alibi)
+                                    mesh=mesh, alibi=alibi,
+                                    k_scale=cks, v_scale=cvs)
         new_k.append(ck)
         new_v.append(cv)
+        if quant:
+            new_ks.append(cks)
+            new_vs.append(cvs)
         out = _wmm("shd,hde->se", att, lp["wo"])
         if "bo" in lp:
             out = out + lp["bo"].astype(x.dtype)
@@ -766,6 +889,9 @@ def decode_step(
     x = T._norm(x, params["ln_f_scale"], params.get("ln_f_bias"), cfg)
     logits = _lm_logits(x, params, cfg)
     logits = _cons(logits, mesh, None, None)
+    if quant:
+        return logits, PagedCache(k=new_k, v=new_v,
+                                  k_scale=new_ks, v_scale=new_vs)
     return logits, PagedCache(k=new_k, v=new_v)
 
 
@@ -895,7 +1021,9 @@ def prefill_batch(
         jnp.int32(-1),
     ).reshape(B * Tp)
 
+    quant = cache.quantized
     new_k, new_v = [], []
+    new_ks, new_vs = [], []  # quantized caches: per-block scale pools
     x_hist = []  # layer outputs; fetch l is barriered on output l-2
     for li, lp in enumerate(params["layers"]):
         if fetch_layer is not None:
@@ -925,9 +1053,20 @@ def prefill_batch(
 
         KVh, Dh = k.shape[2], k.shape[3]
         l = len(new_k)
-        ck, cv = _write_kv(cache.k[l], cache.v[l],
-                           k.reshape(B * Tp, KVh, Dh),
-                           v.reshape(B * Tp, KVh, Dh), flat_idx, mesh)
+        if quant:
+            # the prompt's in-flight attention below stays full
+            # precision (it never reads the cache); only the RESIDENT
+            # copy quantizes — later decode steps read these codes
+            ck, cv, cks, cvs = _write_kv_quant(
+                cache.k[l], cache.v[l], cache.k_scale[l], cache.v_scale[l],
+                k.reshape(B * Tp, KVh, Dh),
+                v.reshape(B * Tp, KVh, Dh), flat_idx, mesh)
+            new_ks.append(_cons(cks, mesh, None, None, "model"))
+            new_vs.append(_cons(cvs, mesh, None, None, "model"))
+        else:
+            ck, cv = _write_kv(cache.k[l], cache.v[l],
+                               k.reshape(B * Tp, KVh, Dh),
+                               v.reshape(B * Tp, KVh, Dh), flat_idx, mesh)
         ck = _cons(ck, mesh, None, None, "model", None)
         cv = _cons(cv, mesh, None, None, "model", None)
         new_k.append(ck)
@@ -994,4 +1133,7 @@ def prefill_batch(
     x_last = T._norm(x_last, params["ln_f_scale"], params.get("ln_f_bias"), cfg)
     logits = _lm_logits(x_last, params, cfg)
     logits = _cons(logits, mesh, None, None)
+    if quant:
+        return logits, PagedCache(k=new_k, v=new_v,
+                                  k_scale=new_ks, v_scale=new_vs)
     return logits, PagedCache(k=new_k, v=new_v)

@@ -215,7 +215,20 @@ class ServingRouter:
 
     @staticmethod
     def _check_homogeneous(engines: Sequence[InferenceEngine]) -> None:
+        from .engine import KvCacheDtypeError
+
         ref = engines[0]
+        # KV dtype first, with its own typed error: a fleet mixing an
+        # int8-quantized pool with a full-precision one can never move
+        # pages (and a silent dequant at import would break the
+        # recompute fallback's token-identity contract), so it is
+        # rejected at construction, not at the first handoff
+        for i, e in enumerate(engines[1:], 1):
+            if str(e.cache.k[0].dtype) != str(ref.cache.k[0].dtype):
+                raise KvCacheDtypeError(
+                    f"replica {i} KV pool dtype {e.cache.k[0].dtype} != "
+                    f"replica 0 {ref.cache.k[0].dtype} — mixed-kv-dtype "
+                    "fleets are rejected (set kv_cache_dtype uniformly)")
         want = (ref.config.kv_block_size, ref.config.blocks_per_seq,
                 ref.cfg.n_layers, ref.cache.k[0].shape[1:],
                 ref.cache.k[0].dtype)

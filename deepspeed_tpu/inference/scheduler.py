@@ -1286,6 +1286,12 @@ class ServingScheduler:
             "budget_findings": float(
                 len(getattr(self, "budget_report").findings)
                 if getattr(self, "budget_report", None) else 0),
+            # KV-pool residency (engine.kv_bytes_per_token): bytes one
+            # resident token costs, and whether the pool is the int8
+            # per-block quantized layout (docs/paged_attention.md)
+            "kv_bytes_per_token": float(self.engine.kv_bytes_per_token()),
+            "kv_pool_quantized": (
+                1.0 if self.engine.cache.quantized else 0.0),
         }
         # warmup-measured static footprint per decode bucket (costmodel)
         fps = getattr(self.engine, "warmup_footprints", {})

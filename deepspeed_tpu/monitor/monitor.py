@@ -112,8 +112,12 @@ def serving_events(scheduler, step: int,
 
     For a scheduler: host-timed TTFT/TPOT percentiles (ms), queue
     depth, active sequences, admitted/finished/preempted request
-    counts, batched tokens per iteration, and the engine's recompile-
-    finding count (inference/scheduler.py metrics()). For a router
+    counts, batched tokens per iteration, the engine's recompile-
+    finding count (inference/scheduler.py metrics()), and the KV-pool
+    residency pair `kv_bytes_per_token` / `kv_pool_quantized` — the
+    resident bytes one token costs (codes + per-block scale tiles on
+    int8 pools; docs/paged_attention.md) and whether the pool is the
+    quantized layout. For a router
     (inference/router.py): every replica's scheduler metrics under
     `prefix`/replica<i>/<name> plus fleet aggregates under
     `prefix`/fleet/<name> — fleet TTFT/TPOT percentiles, cache-hit

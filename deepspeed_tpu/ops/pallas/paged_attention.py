@@ -17,6 +17,11 @@ dim. "Block i of sequence s" lives at cache[table[s, i]]; pages beyond a
 sequence's context are never streamed — the index map clamps the slot to
 the last needed block so pruned steps revisit a resident tile (no DMA),
 mirroring the causal clamp in flash_attention.py.
+
+int8 per-block KV quantization (docs/paged_attention.md): pools may
+hold int8 codes with a per-block [block_size, KV] f32 scale tile
+riding the same index maps — dequant fuses into the attention inner
+loop and the fused write+attend mode quantizes new rows in-kernel.
 """
 
 import functools
@@ -40,6 +45,61 @@ def _arena_block(idx, n_blocks: int):
 
 
 # ---------------------------------------------------------------------------
+# int8 per-block KV quantization
+#
+# One scale per (token slot, KV head), stored in per-block scale tiles
+# [num_blocks, block_size, KV] riding alongside the int8 code pools —
+# "block i's scales" live at k_scale[i], so a block and its scales move
+# together through every path that moves pages (COW copies, export/
+# import handoffs, spill-to-host). Dequantization is FUSED into the
+# attention inner loop (codes stream from HBM at half the bf16 bytes;
+# the f32 multiply is VPU work the MXU wait hides), and quantization of
+# a decode step's new rows happens inside the fused write+attend kernel.
+# ---------------------------------------------------------------------------
+
+KV_QUANT_MAX = 127.0
+# the scale is amax * (1/127), spelled as a MULTIPLY in both the XLA
+# and the in-kernel quantizer: XLA strength-reduces a divide-by-
+# constant to this multiply in some programs but not others, and the
+# resulting 1-ULP scale skew would break the codes-are-identical
+# contract between the fused and separate write paths
+_KV_QUANT_INV = 1.0 / 127.0
+
+
+def quantize_kv_rows(k, v):
+    """Quantize new KV rows [T, KV, D] -> int8 codes + per-(row, head)
+    f32 scales ([T, KV]). THE rounding authority: the in-kernel
+    quantizer in _decode_kernel uses the same formula, so a token's
+    codes are identical whether it entered through prefill's separate
+    write, the chunked-continuation write, or the fused write+attend
+    kernel — token identity across those paths depends on it."""
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    ks = jnp.max(jnp.abs(kf), axis=-1) * jnp.float32(_KV_QUANT_INV)
+    vs = jnp.max(jnp.abs(vf), axis=-1) * jnp.float32(_KV_QUANT_INV)
+    ks = jnp.where(ks > 0, ks, jnp.float32(1.0))
+    vs = jnp.where(vs > 0, vs, jnp.float32(1.0))
+    qk = jnp.clip(jnp.round(kf / ks[..., None]),
+                  -KV_QUANT_MAX, KV_QUANT_MAX).astype(jnp.int8)
+    qv = jnp.clip(jnp.round(vf / vs[..., None]),
+                  -KV_QUANT_MAX, KV_QUANT_MAX).astype(jnp.int8)
+    return qk, ks, qv, vs
+
+
+def _quant_row_kernel(row, compute_dtype):
+    """In-kernel quantize of one [KV, D] row (must mirror
+    quantize_kv_rows bit for bit); returns (codes int8, scale [KV] f32,
+    dequantized row in compute_dtype)."""
+    rf = row.astype(jnp.float32)
+    sc = jnp.max(jnp.abs(rf), axis=-1) * jnp.float32(_KV_QUANT_INV)
+    sc = jnp.where(sc > 0, sc, jnp.float32(1.0))
+    q = jnp.clip(jnp.round(rf / sc[:, None]),
+                 -KV_QUANT_MAX, KV_QUANT_MAX).astype(jnp.int8)
+    deq = (q.astype(jnp.float32) * sc[:, None]).astype(compute_dtype)
+    return q, sc, deq
+
+
+# ---------------------------------------------------------------------------
 # decode attention
 # ---------------------------------------------------------------------------
 
@@ -55,23 +115,36 @@ def _decode_kernel(
     # write+attend; all -1 sentinel when not fused)
     q_ref, *rest,
     block_size: int, scale: float, n_kv: int, gp: int, window: int,
-    sparse: bool, fused: bool, alibi: bool,
+    sparse: bool, fused: bool, alibi: bool, quant: bool,
 ):
-    # [KV, Gp] ALiBi slopes ride as the LAST input when alibi is on
+    # positional ref layout (mirrors paged_decode_attention's arg
+    # order): q, [kn, vn], k, v, [ks, vs], [ab] | o, [ck, cv,
+    # [cks, cvs]] | acc, m, l scratch. quant adds the per-block scale
+    # tiles next to their code pools on BOTH sides.
+    i = 0
+    kn_ref = vn_ref = ck_out = cv_out = None
+    ks_ref = vs_ref = cks_out = cvs_out = None
     ab_ref = None
     if fused:
-        if alibi:
-            (kn_ref, vn_ref, k_ref, v_ref, ab_ref,
-             o_ref, ck_out, cv_out, acc_sc, m_sc, l_sc) = rest
-        else:
-            (kn_ref, vn_ref, k_ref, v_ref,
-             o_ref, ck_out, cv_out, acc_sc, m_sc, l_sc) = rest
-    else:
-        if alibi:
-            k_ref, v_ref, ab_ref, o_ref, acc_sc, m_sc, l_sc = rest
-        else:
-            k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc = rest
-        kn_ref = vn_ref = ck_out = cv_out = None
+        kn_ref, vn_ref = rest[i], rest[i + 1]
+        i += 2
+    k_ref, v_ref = rest[i], rest[i + 1]
+    i += 2
+    if quant:
+        ks_ref, vs_ref = rest[i], rest[i + 1]
+        i += 2
+    if alibi:  # [KV, Gp] ALiBi slopes ride as the LAST input
+        ab_ref = rest[i]
+        i += 1
+    o_ref = rest[i]
+    i += 1
+    if fused:
+        ck_out, cv_out = rest[i], rest[i + 1]
+        i += 2
+        if quant:
+            cks_out, cvs_out = rest[i], rest[i + 1]
+            i += 2
+    acc_sc, m_sc, l_sc = rest[i:i + 3]
     s = pl.program_id(0)
     j = pl.program_id(1)  # table slot (sequential; window-relative)
     nb = pl.num_programs(1)
@@ -105,6 +178,14 @@ def _decode_kernel(
     def _compute():
         k = k_ref[0]  # (bs, KV, D)
         v = v_ref[0]
+        if quant:
+            # dequant fused into the attention inner loop: int8 codes
+            # stream from HBM, the per-(slot, head) scale tile rides in
+            # the same BlockSpec index map as its code block
+            k = (k.astype(jnp.float32)
+                 * ks_ref[0][..., None]).astype(q_ref.dtype)
+            v = (v.astype(jnp.float32)
+                 * vs_ref[0][..., None]).astype(q_ref.dtype)
         cols = j_abs * block_size + jax.lax.broadcasted_iota(
             jnp.int32, (gp, block_size), 1
         )
@@ -139,6 +220,16 @@ def _decode_kernel(
 
     if fused:
         slot = slot_ref[s]
+        if quant:
+            # quantize the new row ONCE (codes/scales shared by the
+            # column update and the store); attention sees the
+            # round-tripped value so this step's logits match every
+            # later step's read of the same codes
+            qkn, skn, kn_use = _quant_row_kernel(kn_ref[0], q_ref.dtype)
+            qvn, svn, vn_use = _quant_row_kernel(vn_ref[0], q_ref.dtype)
+        else:
+            kn_use = kn_ref[0]
+            vn_use = vn_ref[0]
 
         @pl.when(jnp.logical_and(j == nb - 1, slot >= 0))
         def _new_token_column():
@@ -146,7 +237,7 @@ def _decode_kernel(
             # straight from the VMEM-resident kn/vn rows
             for h in range(n_kv):
                 q = q_ref[0, h]  # (Gp, D)
-                stn = (jnp.sum(q * kn_ref[0, h][None, :], axis=1,
+                stn = (jnp.sum(q * kn_use[h][None, :], axis=1,
                                keepdims=True) * scale
                        ).astype(jnp.float32)  # (Gp, 1)
                 if alibi:
@@ -160,7 +251,7 @@ def _decode_kernel(
                 corr = jnp.exp(m_prev - m_new)
                 l_sc[row] = l_sc[row] * corr + p
                 acc_sc[row] = (acc_sc[row] * corr
-                               + p * vn_ref[0, h][None, :].astype(jnp.float32))
+                               + p * vn_use[h][None, :].astype(jnp.float32))
                 m_sc[row] = m_new
 
         @pl.when(j == nb - 1)
@@ -176,8 +267,17 @@ def _decode_kernel(
                 jnp.int32, (block_size, 1, 1), 0
             ) == jnp.maximum(slot, 0) % block_size
             wmask = jnp.logical_and(slot >= 0, rowm)
-            ck_out[0] = jnp.where(wmask, kn_ref[0][None], kb)
-            cv_out[0] = jnp.where(wmask, vn_ref[0][None], vb)
+            if quant:
+                ck_out[0] = jnp.where(wmask, qkn[None], kb)
+                cv_out[0] = jnp.where(wmask, qvn[None], vb)
+                # the scale tile RMWs alongside its code block (same
+                # target index map, (bs, KV) row mask)
+                smask = jnp.logical_and(slot >= 0, rowm[:, :, 0])
+                cks_out[0] = jnp.where(smask, skn[None], ks_ref[0])
+                cvs_out[0] = jnp.where(smask, svn[None], vs_ref[0])
+            else:
+                ck_out[0] = jnp.where(wmask, kn_ref[0][None], kb)
+                cv_out[0] = jnp.where(wmask, vn_ref[0][None], vb)
 
     @pl.when(j == nb - 1)
     def _finalize():
@@ -193,11 +293,18 @@ def _decode_kernel(
 def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens,
                            window: int = 0, allowed_slots=None,
                            k_new=None, v_new=None, slots=None,
-                           alibi_slopes=None):
+                           alibi_slopes=None, k_scale=None, v_scale=None):
     """One-token-per-sequence attention over the paged KV cache.
 
     q: [S, H, D] (the new token's queries)
     k_cache/v_cache: [num_blocks, block_size, KV, D]
+    k_scale/v_scale: optional [num_blocks, block_size, KV] f32 — int8
+      per-block KV quantization: the caches hold int8 codes and each
+      block carries a (block_size, KV) scale tile; dequant fuses into
+      the attention inner loop, and the fused write+attend mode
+      quantizes the new rows in-kernel (codes + scales RMW'd back
+      through aliased outputs, so fused mode returns
+      (out, k_cache, v_cache, k_scale, v_scale)).
     block_table: [S, NB] int32 — cache block ids per sequence
     ctx_lens: [S] int32 — context length INCLUDING the new token; rows
       with 0 are batch padding (output is garbage, sliced by the caller)
@@ -231,6 +338,7 @@ def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens,
     sparse = allowed_slots is not None
     fused = k_new is not None
     alibi = alibi_slopes is not None
+    quant = k_scale is not None
     allow = (allowed_slots.astype(jnp.int32) if sparse
              else jnp.ones((S, NB), jnp.int32))
     slots_arr = (slots.astype(jnp.int32) if fused
@@ -245,7 +353,7 @@ def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens,
         if Gp != G:
             ab = jnp.pad(ab, ((0, 0), (0, Gp - G)))
 
-    def kv_index(s, j, tbl_ref, ctx_ref, allow_ref, slot_ref):
+    def kv_block_of(s, j, tbl_ref, ctx_ref, allow_ref, slot_ref):
         last = jnp.maximum(ctx_ref[s] - 1, 0) // bs
         if window > 0:
             j = _win_jbase_decode(ctx_ref[s], window, bs) + j
@@ -258,7 +366,14 @@ def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens,
             j = jnp.where(allow_ref[s, j] != 0, j, last)
         # clip to the arena: a violated table contract must stay
         # contained (a wild block index can wedge the TPU runtime)
-        return (_arena_block(tbl_ref[s, j], NBLK), 0, 0, 0)
+        return _arena_block(tbl_ref[s, j], NBLK)
+
+    def kv_index(s, j, *refs):
+        return (kv_block_of(s, j, *refs), 0, 0, 0)
+
+    def sc_index(s, j, *refs):
+        # a block's scale tile rides the SAME paging as its codes
+        return (kv_block_of(s, j, *refs), 0, 0)
 
     def row_index(s, j, tbl_ref, ctx_ref, allow_ref, slot_ref):
         return (s, 0, 0)
@@ -266,18 +381,27 @@ def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens,
     def q_index(s, j, tbl_ref, ctx_ref, allow_ref, slot_ref):
         return (s, 0, 0, 0)
 
-    def tgt_index(s, j, tbl_ref, ctx_ref, allow_ref, slot_ref):
+    def tgt_block_of(s, j, tbl_ref, ctx_ref, allow_ref, slot_ref):
         # constant in j: the sequence's NEWEST block — flushed once
         last = jnp.maximum(ctx_ref[s] - 1, 0) // bs
-        return (_arena_block(tbl_ref[s, last], NBLK), 0, 0, 0)
+        return _arena_block(tbl_ref[s, last], NBLK)
+
+    def tgt_index(s, j, *refs):
+        return (tgt_block_of(s, j, *refs), 0, 0, 0)
+
+    def tgt_sc_index(s, j, *refs):
+        return (tgt_block_of(s, j, *refs), 0, 0)
 
     NBw = min(NB, pl.cdiv(window, bs) + 1) if window > 0 else NB
     kv_spec = pl.BlockSpec((1, bs, KV, D), kv_index)
+    sc_spec = pl.BlockSpec((1, bs, KV), sc_index)
     in_specs = [pl.BlockSpec((1, KV, Gp, D), q_index)]
     if fused:
         in_specs += [pl.BlockSpec((1, KV, D), row_index),
                      pl.BlockSpec((1, KV, D), row_index)]
     in_specs += [kv_spec, kv_spec]
+    if quant:
+        in_specs += [sc_spec, sc_spec]
     if alibi:  # whole [KV, Gp] slope table resident in VMEM
         in_specs.append(pl.BlockSpec(
             (KV, Gp), lambda s, j, tbl_ref, ctx_ref, allow_ref, slot_ref:
@@ -291,7 +415,16 @@ def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens,
                      jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
                      jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype)]
         # args: (4 scalar-prefetch), q, kn, vn, k_cache, v_cache
+        # [, k_scale, v_scale] — code pools and scale tiles alias
+        # through so the arena updates in place
         aliases = {7: 1, 8: 2}
+        if quant:
+            tgt_sc_spec = pl.BlockSpec((1, bs, KV), tgt_sc_index)
+            out_specs += [tgt_sc_spec, tgt_sc_spec]
+            out_shape += [
+                jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+                jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype)]
+            aliases = {7: 1, 8: 2, 9: 3, 10: 4}
     else:
         out_specs = o_spec
         out_shape = o_shape
@@ -311,41 +444,58 @@ def paged_decode_attention(q, k_cache, v_cache, block_table, ctx_lens,
         functools.partial(
             _decode_kernel, block_size=bs, scale=scale, n_kv=KV, gp=Gp,
             window=window, sparse=sparse, fused=fused, alibi=alibi,
+            quant=quant,
         ),
         grid_spec=grid_spec,
         out_shape=out_shape,
         input_output_aliases=aliases,
         interpret=_interpret(),
     )
+    sc = (k_scale, v_scale) if quant else ()
     tail = (ab,) if alibi else ()
     if fused:
-        out, ck, cv = call(block_table, ctx_lens, allow, slots_arr, qg,
-                           k_new, v_new, k_cache, v_cache, *tail)
+        res = call(block_table, ctx_lens, allow, slots_arr, qg,
+                   k_new, v_new, k_cache, v_cache, *sc, *tail)
+        if quant:
+            out, ck, cv, cks, cvs = res
+            return out[:, :, :G, :].reshape(S, H, D), ck, cv, cks, cvs
+        out, ck, cv = res
         return out[:, :, :G, :].reshape(S, H, D), ck, cv
     out = call(block_table, ctx_lens, allow, slots_arr, qg, k_cache, v_cache,
-               *tail)
+               *sc, *tail)
     return out[:, :, :G, :].reshape(S, H, D)
 
 
 def paged_decode_attention_xla(q, k_cache, v_cache, block_table, ctx_lens,
                                allowed=None, window: int = 0,
-                               alibi_slopes=None):
+                               alibi_slopes=None, k_scale=None,
+                               v_scale=None):
     """jnp oracle for the kernel (tests; also a CPU fallback, and the
     block-sparse serving path via `allowed`).
 
     Gathers each sequence's paged KV into a dense [S, NB*bs, KV, D]
-    context — O(S·max_ctx) memory, fine at test scale.
+    context — O(S·max_ctx) memory, fine at test scale. THIS is the
+    per-step block-table gather materialization the fused kernel
+    exists to avoid; it stays as the reference/oracle path only.
 
     allowed: optional [S, NB*bs] bool — extra per-position mask (the
     block-sparse layout row of each query's position).
     window > 0: token-exact sliding window per row.
     alibi_slopes: optional [H] — score bias slope_h * key_pos (the
-    single query row makes the absolute form exact under softmax)."""
+    single query row makes the absolute form exact under softmax).
+    k_scale/v_scale: int8-KV mode — per-block scale tiles
+    [NBLK, bs, KV]; codes gather with their scales and dequantize to
+    the compute dtype exactly as the kernel's fused dequant does."""
     S, H, D = q.shape
     _, bs, KV, _ = k_cache.shape
     G = H // KV
     k = k_cache[block_table].reshape(S, -1, KV, D)  # [S, NB*bs, KV, D]
     v = v_cache[block_table].reshape(S, -1, KV, D)
+    if k_scale is not None:
+        ks = k_scale[block_table].reshape(S, -1, KV)
+        vs = v_scale[block_table].reshape(S, -1, KV)
+        k = (k.astype(jnp.float32) * ks[..., None]).astype(q.dtype)
+        v = (v.astype(jnp.float32) * vs[..., None]).astype(q.dtype)
     if G > 1:
         k = jnp.repeat(k, G, axis=2)
         v = jnp.repeat(v, G, axis=2)
@@ -747,3 +897,16 @@ def paged_kv_write(cache_k, cache_v, k_new, v_new, flat_slots):
         input_output_aliases={3: 0, 4: 1},
         interpret=_interpret(),
     )(slots, kn, vn, cache_k, cache_v)
+
+
+def paged_scale_write(k_scale, v_scale, ks_new, vs_new, flat_slots):
+    """Write [T, KV] per-row quant scales into the [NBLK, bs, KV] scale
+    pools at flat slot ids [T] — the scale half of a quantized
+    paged_kv_write. Rides the SAME RMW kernel through a
+    [NBLK, bs, 1, KV] view (the KV axis lands on the lane dim, so the
+    block tile stays lane-aligned and dtype-generic)."""
+    NBLK, bs, KV = k_scale.shape
+    ck, cv = paged_kv_write(
+        k_scale.reshape(NBLK, bs, 1, KV), v_scale.reshape(NBLK, bs, 1, KV),
+        ks_new[:, None, :], vs_new[:, None, :], flat_slots)
+    return ck.reshape(NBLK, bs, KV), cv.reshape(NBLK, bs, KV)

@@ -31,6 +31,57 @@ LINKS = {
     "dcn_bytes_per_s": 6.25e9,
 }
 
+# --- per-chip roofline tables: THE single authority --------------------
+#
+# Chip-kind substring -> bf16 dense peak FLOP/s, HBM bytes, HBM bytes/s.
+# Accelerator.peak_flops / hbm_per_device / hbm_bandwidth match the
+# RUNNING device against these; chip_roofline(kind) looks a NAMED chip
+# up directly — how the CPU-hosted gates (scripts/ds_budget.py S006
+# verdict on the fused decode program) project a real serving chip's
+# balance point instead of the host's degenerate 1:1 profile.
+PEAK_FLOPS = {
+    "v5 lite": 197e12,
+    "v5litepod": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 45e12,
+    "v6": 918e12,
+}
+HBM_PER_DEVICE = {
+    "v5 lite": 16 * 10**9,
+    "v5litepod": 16 * 10**9,
+    "v5e": 16 * 10**9,
+    "v5p": 95 * 10**9,
+    "v4": 32 * 10**9,
+    "v3": 32 * 10**9,
+    "v2": 16 * 10**9,
+    "v6": 32 * 10**9,
+}
+HBM_BANDWIDTH = {
+    "v5 lite": 819e9,
+    "v5litepod": 819e9,
+    "v5e": 819e9,
+    "v5p": 2765e9,
+    "v4": 1228e9,
+    "v3": 900e9,
+    "v2": 700e9,
+    "v6": 1640e9,
+}
+
+
+def chip_roofline(kind: str):
+    """(peak_flops, hbm_bandwidth) of a NAMED chip kind — the roofline
+    constants for projecting a program's balance point onto a target
+    chip from any host (raises KeyError on an unknown kind so a typo'd
+    gate config fails loudly)."""
+    key = kind.lower()
+    for k in PEAK_FLOPS:
+        if k in key:
+            return PEAK_FLOPS[k], HBM_BANDWIDTH[k]
+    raise KeyError(f"unknown chip kind {kind!r}; known: {sorted(PEAK_FLOPS)}")
+
 
 class Accelerator:
     """Device management / memory stats / dtype support for one platform."""
@@ -120,23 +171,12 @@ class Accelerator:
     def peak_flops(self, dtype: str = "bfloat16", index: int = 0) -> float:
         """Per-chip peak matmul FLOP/s, used for MFU accounting."""
         kind = self.device_name(index).lower()
-        table = {
-            # chip kind substring -> bf16 dense peak FLOP/s
-            "v5 lite": 197e12,
-            "v5litepod": 197e12,
-            "v5e": 197e12,
-            "v5p": 459e12,
-            "v4": 275e12,
-            "v3": 123e12,
-            "v2": 45e12,
-            "v6": 918e12,
-        }
-        for key, val in table.items():
+        for key, val in PEAK_FLOPS.items():
             if key in kind:
                 return val
         if self.devices()[index].platform == "cpu":
             return 1e11  # nominal; only used so MFU math never divides by zero
-        return 197e12
+        return PEAK_FLOPS["v5e"]
 
     def hbm_per_device(self, index: int = 0) -> int:
         """Per-device HBM capacity in bytes — the budget the static cost
@@ -145,18 +185,7 @@ class Accelerator:
         backend's reported bytes_limit; otherwise a 16 GiB default so the
         CPU fake-mesh path stays deterministic."""
         kind = self.device_name(index).lower()
-        table = {
-            # chip kind substring -> HBM bytes per chip
-            "v5 lite": 16 * 10**9,
-            "v5litepod": 16 * 10**9,
-            "v5e": 16 * 10**9,
-            "v5p": 95 * 10**9,
-            "v4": 32 * 10**9,
-            "v3": 32 * 10**9,
-            "v2": 16 * 10**9,
-            "v6": 32 * 10**9,
-        }
-        for key, val in table.items():
+        for key, val in HBM_PER_DEVICE.items():
             if key in kind:
                 return val
         limit = self.total_memory(index)
@@ -165,17 +194,7 @@ class Accelerator:
     def hbm_bandwidth(self, index: int = 0) -> float:
         """Per-chip HBM bandwidth in bytes/s (roofline memory leg)."""
         kind = self.device_name(index).lower()
-        table = {
-            "v5 lite": 819e9,
-            "v5litepod": 819e9,
-            "v5e": 819e9,
-            "v5p": 2765e9,
-            "v4": 1228e9,
-            "v3": 900e9,
-            "v2": 700e9,
-            "v6": 1640e9,
-        }
-        for key, val in table.items():
+        for key, val in HBM_BANDWIDTH.items():
             if key in kind:
                 return val
         return 100e9  # nominal host-memory class; keeps ratios finite

@@ -88,6 +88,29 @@ def _shape_bytes(dtype: str, dims: str) -> int:
     return n * _DTYPE_BYTES.get(dtype, 4)
 
 
+_GATHER_RE = re.compile(
+    # result-shape ... gather( — the lookbehind keeps all-gather (a
+    # collective, counted by parse_hlo_collectives) out of this probe
+    r"=\s*(?P<dtype>[a-z]+\d+)\[(?P<dims>[0-9,<=\s]*)\][^\n]*?"
+    r"(?<![\w-])gather\(",
+)
+
+
+def max_gather_bytes(hlo_text: str) -> int:
+    """Largest gather-instruction RESULT in the program, in bytes.
+
+    The ds_schedule gate probes the fused paged-decode program with
+    this: the Pallas kernel indexes KV blocks in place, so the only
+    gathers left are small table/embedding lookups — a regression back
+    to the per-step block-table gather (k_cache[block_table]
+    materializing [S, NB*bs, KV, D]) shows up as a result orders of
+    magnitude past the committed limit."""
+    best = 0
+    for m in _GATHER_RE.finditer(hlo_text):
+        best = max(best, _shape_bytes(m.group("dtype"), m.group("dims")))
+    return best
+
+
 def _group_size(tail: str) -> int:
     """Replica-group size of one collective instruction's attribute
     tail (0 = not stated / flat world group `{}`)."""

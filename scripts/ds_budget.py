@@ -17,6 +17,13 @@ compiled on the virtual 8-device CPU mesh, no step executed:
                     (engine.sanitize's compiled artifact)
   serving_decode_w8 the width-8 paged-KV decode program
                     (the serving warmup footprint unit)
+  serving_decode_w8_int8
+                    the width-8 FUSED decode program over the int8
+                    per-block-quantized KV pool (decode_impl='pallas':
+                    the Pallas kernel in interpret mode — in-place
+                    paged indexing, no block-table gather). Also
+                    carries the kv_bytes_per_token capacity ratio the
+                    budgets section pins at >= 1.8x.
 
 Everything is compile-time static analysis: byte counts come from
 compiled.memory_analysis() and the HLO text, so the gate runs anywhere
@@ -78,11 +85,9 @@ def build_reports():
     import warnings
 
     params = T.init(mcfg, jax.random.PRNGKey(0))
-    eng = init_inference(
-        params, mcfg,
-        dict(max_seq_len=32, kv_block_size=8, num_kv_blocks=32,
-             min_prefill_bucket=8, max_batch_size=8),
-        dtype=jnp.float32)
+    icfg = dict(max_seq_len=32, kv_block_size=8, num_kv_blocks=32,
+                min_prefill_bucket=8, max_batch_size=8)
+    eng = init_inference(params, mcfg, dict(icfg), dtype=jnp.float32)
     toks = np.zeros((8,), np.int32)
     ctx = np.zeros((8,), np.int32)
     tables = np.full((8, eng.config.blocks_per_seq), eng.pad_block, np.int32)
@@ -93,11 +98,50 @@ def build_reports():
             eng._dev(ctx)).compile()
     decode_cost = build_cost_report(compiled, label="serving_decode[w8]")
 
+    # the int8-quantized FUSED decode program (kv_cache_dtype='int8',
+    # decode_impl='pallas' — the Pallas kernel in interpret mode, so
+    # the canonical artifact is the in-place paged indexing path, not
+    # the gather oracle). Three committed verdicts ride this program:
+    # the KV capacity ratio (budgets, >= 1.8x), the S006 roofline
+    # bound, and the max-gather probe (SCHEDULE.json — a regression
+    # back to the block-table gather materialization fails ds_schedule)
+    from deepspeed_tpu.analysis.costmodel import roofline
+    from deepspeed_tpu.platform.accelerator import chip_roofline
+    from deepspeed_tpu.profiling.hlo import max_gather_bytes
+
+    eng_q = init_inference(
+        params, mcfg, dict(icfg, kv_cache_dtype="int8",
+                           decode_impl="pallas"),
+        dtype=jnp.float32)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        compiled_q = eng_q._decode_fn(8, True).lower(
+            eng_q.params, eng_q.cache, eng_q._dev(toks),
+            eng_q._dev(tables), eng_q._dev(ctx)).compile()
+    quant_cost = build_cost_report(compiled_q,
+                                   label="serving_decode[w8,int8kv]")
+    if quant_cost is not None:
+        # the verdict projects the SERVING chip's balance point (v5e
+        # flagship profile from the chip-table authority) — the CPU
+        # host's degenerate 1:1 flops:bytes profile would call any
+        # program with intensity > 1 compute-bound
+        peak, hbm_bw = chip_roofline("v5e")
+        quant_cost._s006_bound = roofline(
+            quant_cost, peak, hbm_bw)["bound"]
+        quant_cost._max_gather_bytes = max_gather_bytes(
+            compiled_q.as_text())
+        quant_cost._kv_bytes_per_token = {
+            "ref": eng.kv_bytes_per_token(),
+            "int8": eng_q.kv_bytes_per_token(),
+        }
+
     reports = {}
     if san.cost is not None:
         reports["train_step"] = san.cost
     if decode_cost is not None:
         reports["serving_decode_w8"] = decode_cost
+    if quant_cost is not None:
+        reports["serving_decode_w8_int8"] = quant_cost
     return reports, live
 
 
@@ -112,6 +156,8 @@ def capture(path: str) -> int:
         print(json.dumps({"error": "no cost artifacts available on this "
                                    "backend; baseline not written"}))
         return 1
+    kv = getattr(reports.get("serving_decode_w8_int8"),
+                 "_kv_bytes_per_token", None)
     doc = save_baseline(
         path, reports,
         budgets={
@@ -119,6 +165,13 @@ def capture(path: str) -> int:
             "hbm_regression_tolerance": 0.10,
             "collective_k": 6.0,  # 2*gas+2 of the canonical train engine
             "live_sharded_bytes": live,
+            # int8 per-block KV quantization capacity win: resident
+            # bytes/token of the reference pool vs the quantized pool
+            # (engine.kv_bytes_per_token — codes + scale tiles), and
+            # the floor --check enforces
+            "kv_bytes_per_token_ref": int(kv["ref"]) if kv else 0,
+            "kv_bytes_per_token_int8": int(kv["int8"]) if kv else 0,
+            "kv_capacity_ratio_min": 1.8,
         },
         meta={"platform": jax.default_backend(),
               "device_count": jax.device_count(),
@@ -154,6 +207,27 @@ def check(path: str, strict: bool) -> int:
     reports, _ = build_reports()
     findings = []
     summary = {}
+    # int8-KV capacity floor: the quantized pool must keep >= the
+    # committed ratio more resident tokens per byte than the reference
+    # pool — a scale-tensor widening (or a quiet dequant-at-rest
+    # regression) fails here before pytest ever runs
+    kv = getattr(reports.get("serving_decode_w8_int8"),
+                 "_kv_bytes_per_token", None)
+    if kv:
+        ratio_min = float(budgets.get("kv_capacity_ratio_min", 1.8))
+        ratio = kv["ref"] / max(1, kv["int8"])
+        summary["kv_bytes_per_token"] = {
+            "ref": int(kv["ref"]), "int8": int(kv["int8"]),
+            "ratio": round(ratio, 2), "min": ratio_min}
+        if ratio < ratio_min:
+            findings.append({
+                "rule": "S004", "severity": "error",
+                "program": "serving_decode_w8_int8",
+                "message": (
+                    f"int8 KV pool holds only {ratio:.2f}x more tokens "
+                    f"per byte than the reference pool (floor "
+                    f"{ratio_min}x): {kv['int8']} vs {kv['ref']} "
+                    "bytes/token — scale tensors grew or codes widened")})
     for name, rep in reports.items():
         entry = base.get("programs", {}).get(name)
         if entry is None:

@@ -18,6 +18,10 @@ programs, compiled on the virtual 8-device CPU mesh, no step executed:
   train_step_fp16    the fp16 dynamic-loss-scaled training step
   train_step_onebit  the 1-bit Adam compressed-momentum step
   serving_decode_w8  the width-8 paged-KV decode program
+  serving_decode_w8_int8
+                     the width-8 FUSED Pallas decode program over the
+                     int8 per-block-quantized KV pool (pins the
+                     codes -> f32-scale dequant chain)
 
 Per program the committed NUMERICS.json records a dtype LEDGER —
 additive-reduce / dot dtype histograms and convert chains from the
@@ -86,7 +90,7 @@ def _train_artifacts(engine, batch, fn=None):
 
 
 ALL_PROGRAMS = ("train_step", "train_step_fp16", "train_step_onebit",
-                "serving_decode_w8")
+                "serving_decode_w8", "serving_decode_w8_int8")
 
 
 def build_programs(only=None):
@@ -183,6 +187,34 @@ def build_programs(only=None):
             cd = ld.compile()
         record("serving_decode_w8", cd, ld,
                ieng.sanitize_numerics(widths=[8]))
+
+    # width-8 FUSED decode over the int8 per-block-quantized KV pool
+    # (kv_cache_dtype='int8', decode_impl='pallas'): the committed
+    # ledger pins the dequant dtype chain — int8 codes -> f32 scale
+    # multiply -> compute dtype — so a quiet downcast of the scales or
+    # an integer dot sneaking in shows as a new/absent dtype key
+    if "serving_decode_w8_int8" in only:
+        from deepspeed_tpu.inference import init_inference
+
+        params = T.init(mcfg, jax.random.PRNGKey(0))
+        qeng = init_inference(
+            params, mcfg,
+            dict(max_seq_len=32, kv_block_size=8, num_kv_blocks=32,
+                 min_prefill_bucket=8, max_batch_size=8,
+                 kv_cache_dtype="int8", decode_impl="pallas"),
+            dtype=jnp.float32)
+        toks = np.zeros((8,), np.int32)
+        ctx = np.zeros((8,), np.int32)
+        tables = np.full((8, qeng.config.blocks_per_seq), qeng.pad_block,
+                         np.int32)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            ldq = qeng._decode_fn(8, True).lower(
+                qeng.params, qeng.cache, qeng._dev(toks),
+                qeng._dev(tables), qeng._dev(ctx))
+            cdq = ldq.compile()
+        record("serving_decode_w8_int8", cdq, ldq,
+               qeng.sanitize_numerics(widths=[8]))
     return out
 
 

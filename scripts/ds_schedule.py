@@ -16,6 +16,13 @@ programs — compiled on the virtual 8-device CPU mesh, no step executed
 
   train_step        the zero-3 + TP fused training step
   serving_decode_w8 the width-8 paged-KV decode program
+  serving_decode_w8_int8
+                    the width-8 FUSED Pallas decode program over the
+                    int8-quantized KV pool — its entry additionally
+                    commits the S006 roofline verdict (must stay
+                    bandwidth-bound) and a max-gather-bytes probe, so
+                    a regression back to the per-step block-table
+                    gather materialization fails this gate
 
 Everything is compile-time static analysis: the schedule ledger comes
 from the post-scheduling HLO text (profiling/hlo.py
@@ -62,9 +69,9 @@ def build_schedules():
     return out
 
 
-def _entry(sched):
+def _entry(rep, sched):
     d = sched.to_dict()
-    return {
+    e = {
         "step_time_us": round(d["step_time_us"], 3),
         "exposed_us": round(d["exposed_us"], 3),
         "compute_us": round(d["compute_us"], 3),
@@ -73,6 +80,17 @@ def _entry(sched):
         "n_async": d["n_async"],
         "n_sync": d["n_sync"],
     }
+    bound = getattr(rep, "_s006_bound", None)
+    if bound is not None:
+        # the fused int8-KV decode program's committed S006 verdict
+        # (must be memory i.e. bandwidth-bound) + the max-gather probe:
+        # the limit is sized so table/embedding lookups pass and ANY
+        # [S, NB*bs, ...] block-table materialization fails --check
+        gb = int(getattr(rep, "_max_gather_bytes", 0))
+        e["s006_bound"] = bound
+        e["max_gather_bytes"] = gb
+        e["gather_bytes_limit"] = max(4096, 2 * gb)
+    return e
 
 
 def capture(path: str) -> int:
@@ -95,8 +113,8 @@ def capture(path: str) -> int:
             "step_time_tolerance": STEP_TIME_TOLERANCE,
             "min_exposed_us": MIN_EXPOSED_US,
         },
-        "programs": {name: _entry(sched)
-                     for name, (_rep, sched) in schedules.items()},
+        "programs": {name: _entry(rep, sched)
+                     for name, (rep, sched) in schedules.items()},
     }
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(doc, fh, indent=1, sort_keys=True)
@@ -132,13 +150,38 @@ def check(path: str, strict: bool) -> int:
     schedules = build_schedules()
     findings = []
     summary = {}
-    for name, (_rep, sched) in schedules.items():
+    for name, (rep, sched) in schedules.items():
         entry = base.get("programs", {}).get(name)
         if entry is None:
             findings.append({
                 "rule": "S009", "severity": "warning", "program": name,
                 "message": f"no baseline entry for {name}; re-capture"})
             continue
+        # fused-decode regression probes (the int8-KV canonical
+        # program): the S006 verdict must stay bandwidth(memory)-bound
+        # and no gather may grow past the committed limit — a rewrite
+        # back to the k_cache[block_table] materialization fails HERE,
+        # before pytest
+        if "s006_bound" in entry:
+            bound = getattr(rep, "_s006_bound", None)
+            if bound is not None and bound != entry["s006_bound"]:
+                findings.append({
+                    "rule": "S006", "severity": "error", "program": name,
+                    "message": (
+                        f"fused decode program compiles {bound}-bound "
+                        f"but the committed verdict is "
+                        f"{entry['s006_bound']}-bound — re-capture only "
+                        "if the balance change is intended")})
+            gb = int(getattr(rep, "_max_gather_bytes", 0))
+            limit = int(entry.get("gather_bytes_limit", 0))
+            if limit and gb > limit:
+                findings.append({
+                    "rule": "S006", "severity": "error", "program": name,
+                    "message": (
+                        f"fused decode program materializes a {gb}-byte "
+                        f"gather (limit {limit}) — the per-step "
+                        "block-table gather is back; decode must index "
+                        "paged KV blocks in place")})
         checks = [
             check_exposed_comm(sched, baseline=entry,
                                min_exposed_us=floor, tolerance=tol,

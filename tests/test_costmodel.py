@@ -504,8 +504,13 @@ class TestDsBudgetScript:
         r = self._run("--capture", "--baseline", str(out))
         assert r.returncode == 0, r.stdout + r.stderr
         doc = json.loads(out.read_text())
-        assert set(doc["programs"]) == {"train_step", "serving_decode_w8"}
+        assert set(doc["programs"]) == {"train_step", "serving_decode_w8",
+                                        "serving_decode_w8_int8"}
         assert all(p["peak_hbm_bytes"] > 0
                    for p in doc["programs"].values())
+        # int8-KV capacity ratio committed + above the floor
+        b = doc["budgets"]
+        assert b["kv_bytes_per_token_ref"] >= 1.8 * \
+            b["kv_bytes_per_token_int8"] > 0
         r = self._run("--check", "--strict", "--baseline", str(out))
         assert r.returncode == 0, r.stdout + r.stderr

@@ -708,9 +708,15 @@ class TestDsScheduleScript:
         assert r.returncode == 0, r.stdout + r.stderr
         doc = json.loads(out.read_text())
         assert set(doc["programs"]) == {"train_step",
-                                        "serving_decode_w8"}
+                                        "serving_decode_w8",
+                                        "serving_decode_w8_int8"}
         assert all(p["step_time_us"] > 0
                    for p in doc["programs"].values())
         assert doc["programs"]["train_step"]["n_collectives"] > 0
+        # the fused int8-KV decode entry commits its S006 verdict and
+        # the gather-materialization probe
+        q = doc["programs"]["serving_decode_w8_int8"]
+        assert q["s006_bound"] == "memory"
+        assert 0 < q["max_gather_bytes"] <= q["gather_bytes_limit"]
         r = self._run("--check", "--strict", "--baseline", str(out))
         assert r.returncode == 0, r.stdout + r.stderr

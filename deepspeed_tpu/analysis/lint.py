@@ -92,7 +92,10 @@ _HOT_FILES = ("runtime/engine.py", "inference/engine.py",
               # windows) — a host sync added here would tax every
               # dispatch
               "resilience/faults.py", "resilience/health.py",
-              "resilience/integrity.py")
+              "resilience/integrity.py",
+              # the autoscaler ticks once per fleet sweep and its
+              # adapter reads router/scheduler counters on that path
+              "inference/autoscaler.py")
 _HOT_FN_PREFIXES = (
     "train_batch", "eval_batch", "_dispatch", "decode", "_decode",
     "generate", "put", "step", "_sample", "prefill", "_prefill",
@@ -110,6 +113,13 @@ _HOT_FN_PREFIXES = (
     "update", "occupancy", "watermark_scale", "estimate_ttft",
     "_try_spill", "_resume_from_spill", "_brownout", "_pressure",
     "_decode_can_take", "_fleet_brownout", "trim_parked",
+    # replica lifecycle + autoscaler (docs/autoscaling.md): the policy
+    # tick runs per fleet sweep; spin-up/drain move KV pages through
+    # the serving_readback-audited transfer path
+    "tick", "add_replica", "join_replica", "drain_replica",
+    "_drain_migrate", "_drain_target", "_maybe_release", "pump_drains",
+    "_warm_boot", "_rebalance_to", "export_parked_kv", "parked_chains",
+    "scale_up", "scale_down", "signals", "observe_time", "lifecycle",
 )
 _SYNC_CALLS = ("block_until_ready", "device_get")
 # serving_readback: the scheduler loop's one named readback point

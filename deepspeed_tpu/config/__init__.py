@@ -1,4 +1,5 @@
 from .config import (
+    AutoscalerConfig,
     DeepSpeedTPUConfig,
     MeshConfig,
     OffloadConfig,

@@ -12,7 +12,7 @@ size coming from the mesh rather than torch.distributed.
 
 import json
 from enum import IntEnum
-from typing import Any, Dict, Optional, Union
+from typing import Any, Dict, List, Optional, Union
 
 from pydantic import BaseModel, ConfigDict, Field, model_validator
 
@@ -425,6 +425,84 @@ class ServingSchedulerConfig(ConfigModel):
         return self
 
 
+class AutoscalerConfig(ConfigModel):
+    """SLO-class autoscaler policy loop (inference/autoscaler.py
+    Autoscaler; docs/autoscaling.md). Off by default — a fleet stays
+    at its constructed size until a deployment opts in.
+
+    Replica-count bounds: min_replicas / max_replicas clamp every
+    decision (the policy never drains below min or spins past max).
+
+    Scale-up signals, evaluated every evaluation_interval_s on the
+    injectable clock (virtual-time sim and wall clock share one path):
+    any replica's pressure level >= scale_up_pressure
+    (inference/pressure.py: 1 yellow / 2 red / 3 brownout), fleet
+    queue depth per live replica > scale_up_queue_per_replica, or a
+    shed/deadline-rejection delta since the last evaluation. A signal
+    must hold for up_hysteresis CONSECUTIVE evaluations before the
+    fleet grows (occupancy noise at a watermark must not flap the
+    fleet size), except when the delta includes a class named in
+    premium_classes — a premium-impact event is already an SLO breach,
+    so it bypasses hysteresis (cooldown still applies).
+
+    Scale-down: pressure GREEN everywhere, queue depth per replica <
+    scale_down_queue_per_replica, and no shed/rejection activity, held
+    for down_hysteresis consecutive evaluations. Cooldowns are
+    asymmetric (scale_up_cooldown_s < scale_down_cooldown_s: growing
+    is cheap and urgent, shrinking wrong costs a spin-up later), and
+    any scale action resets both.
+
+    Spin-up failure policy: a failed add_replica (the chaos point
+    'replica.spinup' models a replica killed mid-scale-up) burns the
+    attempt and retries after spinup_retry_backoff_s, doubling up to
+    spinup_max_retries attempts before the policy loop re-arms on the
+    next scale-up signal."""
+
+    enabled: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 8
+    evaluation_interval_s: float = 1.0
+    scale_up_pressure: int = 2
+    scale_up_queue_per_replica: float = 4.0
+    scale_down_queue_per_replica: float = 1.0
+    up_hysteresis: int = 2
+    down_hysteresis: int = 4
+    scale_up_cooldown_s: float = 5.0
+    scale_down_cooldown_s: float = 30.0
+    spinup_retry_backoff_s: float = 1.0
+    spinup_max_retries: int = 3
+    premium_classes: List[str] = Field(default_factory=list)
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        if self.evaluation_interval_s <= 0:
+            raise ValueError("evaluation_interval_s must be > 0")
+        if not (0 <= self.scale_up_pressure <= 3):
+            raise ValueError(
+                "scale_up_pressure must be a pressure level in [0, 3]")
+        if self.scale_up_queue_per_replica < 0 \
+                or self.scale_down_queue_per_replica < 0:
+            raise ValueError("queue watermarks must be >= 0")
+        if self.scale_down_queue_per_replica \
+                > self.scale_up_queue_per_replica:
+            raise ValueError(
+                "scale_down_queue_per_replica must be <= "
+                "scale_up_queue_per_replica (the dead band must exist)")
+        if self.up_hysteresis < 1 or self.down_hysteresis < 1:
+            raise ValueError("hysteresis counts must be >= 1")
+        if self.scale_up_cooldown_s < 0 or self.scale_down_cooldown_s < 0:
+            raise ValueError("cooldowns must be >= 0")
+        if self.spinup_retry_backoff_s <= 0 or self.spinup_max_retries < 0:
+            raise ValueError(
+                "spinup_retry_backoff_s must be > 0, "
+                "spinup_max_retries >= 0")
+        return self
+
+
 class ServingRouterConfig(ConfigModel):
     """Multi-replica serving front door (inference/router.py
     ServingRouter) — the fleet layer over N ServingScheduler-backed
@@ -485,6 +563,15 @@ class ServingRouterConfig(ConfigModel):
     max_fleet_queue is unbounded (the effective bound becomes the
     fleet's live batch capacity)."""
 
+    # -- replica lifecycle (docs/autoscaling.md) ------------------------
+    # warm_prefix_limit: how many of the donor's hottest parked prefix
+    # chains a joining replica imports at spin-up (add_replica warm
+    # boot; 0 = always join cache-cold). autoscaler: the SLO-class
+    # autoscaler policy block (inference/autoscaler.py; disabled by
+    # default — construction-time fleet size is final until enabled).
+    warm_prefix_limit: int = 8
+    autoscaler: AutoscalerConfig = Field(default_factory=AutoscalerConfig)
+
     replicas: int = 1
     policy: str = "prefix_aware"
     cache_weight: float = 2.0
@@ -510,6 +597,8 @@ class ServingRouterConfig(ConfigModel):
 
     @model_validator(mode="after")
     def _check(self):
+        if self.warm_prefix_limit < 0:
+            raise ValueError("warm_prefix_limit must be >= 0 (0 = cold)")
         if self.pressure_routing_weight < 0:
             raise ValueError("pressure_routing_weight must be >= 0")
         if self.max_handoff_backlog < 0:

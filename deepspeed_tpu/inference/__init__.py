@@ -19,7 +19,17 @@ from .ragged import (
     SequenceDescriptor,
     StateManager,
 )
-from .router import RequestShedError, ServingRouter, ServingRouterConfig
+from .autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    RouterFleetAdapter,
+)
+from .router import (
+    ReplicaDrainError,
+    RequestShedError,
+    ServingRouter,
+    ServingRouterConfig,
+)
 from .scheduler import Request, ServingScheduler, ServingSchedulerConfig
 
 __all__ = [
@@ -38,6 +48,10 @@ __all__ = [
     "RED",
     "BROWNOUT",
     "PressureGovernor",
+    "Autoscaler",
+    "AutoscalerConfig",
+    "RouterFleetAdapter",
+    "ReplicaDrainError",
     "Request",
     "RequestShedError",
     "ServingRouter",

@@ -1,0 +1,326 @@
+"""SLO-class autoscaler: the policy loop that makes the serving fleet
+ELASTIC (docs/autoscaling.md).
+
+The resilience arc gave the fleet failover (PR 7), overload governance
+(PR 10), and a replica LIFECYCLE (inference/router.py add_replica /
+drain_replica) — but something still has to decide WHEN the fleet
+grows and shrinks. Static provisioning is the alternative, and it is
+wrong twice a day: sized for the diurnal peak it burns replica-hours
+all night; sized for the valley it sheds load every evening. The
+`Autoscaler` closes the loop on the PR-10 pressure/overload signals
+(max pressure level, queue depth per replica, shed and
+deadline-rejection rates) against per-tenant SLO classes:
+
+- **signals, not wall clocks**: every input is a counter the router
+  already maintains; evaluation runs on the injectable clock
+  (resilience/health.py's convention), so the deterministic
+  virtual-time diurnal sim (bench.py --autoscale-sim) and wall-clock
+  serving drive ONE policy path.
+- **hysteresis + asymmetric cooldowns**: a scale-up signal must hold
+  for `up_hysteresis` consecutive evaluations (occupancy noise at a
+  watermark must not flap the fleet), scale-down for the longer
+  `down_hysteresis`; any action opens a cooldown window
+  (scale_up_cooldown_s < scale_down_cooldown_s — growing is urgent,
+  shrinking wrong costs a spin-up later).
+- **premium bypass**: a shed or deadline rejection hitting a class in
+  `premium_classes` is already an SLO breach — it bypasses hysteresis
+  (cooldown still applies) so the fleet grows on the FIRST premium
+  impact, not the third.
+- **burned spin-ups retry with backoff**: a scale-up that raises (the
+  'replica.spinup' chaos point models a replica killed mid-scale-up)
+  is burned; the autoscaler retries after spinup_retry_backoff_s,
+  doubling per attempt up to spinup_max_retries, then re-arms on the
+  next scale-up signal.
+
+The policy is fleet-agnostic: it talks to a duck-typed fleet object
+(`live_replicas()`, `signals()`, `scale_up(now)`, `scale_down(now)`,
+optional `note_time(now)`), so the macro diurnal simulator's fluid
+fleet model and the real router (via `RouterFleetAdapter`) exercise
+EXACTLY the same decision code — what the AUTOSCALE.json gate
+measures over millions of simulated sessions is the code production
+runs.
+"""
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+from ..config.config import AutoscalerConfig
+from ..utils.logging import log_dist
+from .pressure import GREEN
+from .router import ReplicaDrainError, ServingRouter
+
+__all__ = ["Autoscaler", "AutoscalerConfig", "RouterFleetAdapter"]
+
+
+class Autoscaler:
+    """The policy loop. `fleet` is any object implementing:
+
+      live_replicas() -> int      capacity-bearing replicas (routable
+                                  + warming: capacity already paid for
+                                  counts against min/max even before
+                                  it joins routing)
+      signals() -> dict           cumulative counters + instantaneous
+                                  gauges: queue_depth,
+                                  max_pressure_level, shed_requests,
+                                  deadline_rejections, premium_sheds,
+                                  premium_rejections
+      scale_up(now)               add one replica; raises on a burned
+                                  spin-up (the autoscaler retries)
+      scale_down(now) -> bool     drain one replica; False when no
+                                  legal victim exists
+      note_time(now)              optional: advance the fleet's
+                                  replica-hour integral
+
+    Drive it by calling tick() — from a serving loop, a timer thread,
+    or a virtual-clock simulator passing explicit `now` values. tick()
+    is cheap when it is not an evaluation boundary (one clock read +
+    one comparison), so calling it every sweep is fine."""
+
+    def __init__(
+        self,
+        fleet: Any,
+        config: Union[AutoscalerConfig, Dict[str, Any], None] = None,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        if isinstance(config, dict):
+            config = AutoscalerConfig(**config)
+        if config is None and hasattr(fleet, "router"):
+            # the nested ServingRouterConfig.autoscaler block is the
+            # default policy for a router-backed fleet
+            config = fleet.router.cfg.autoscaler
+        self.cfg = config or AutoscalerConfig()
+        self.fleet = fleet
+        self._clock = clock or time.monotonic
+        self._last_eval: Optional[float] = None
+        self._cooldown_until: Optional[float] = None
+        self._up_votes = 0
+        self._down_votes = 0
+        self._prev: Optional[Dict[str, float]] = None
+        self._retry_at: Optional[float] = None
+        self._retry_attempt = 0
+        self.counters: Dict[str, int] = {
+            "evals": 0, "scale_ups": 0, "scale_downs": 0,
+            "scale_up_denied": 0, "scale_down_denied": 0,
+            "spinup_failures": 0, "spinup_retries": 0,
+            "premium_bypass": 0, "cooldown_holds": 0,
+        }
+        # decision audit: [{"t", "action", "reason"}] — the diurnal
+        # lane's scale-event trace comes straight from here
+        self.log: List[Dict[str, Any]] = []
+
+    # -- bookkeeping ------------------------------------------------------
+    def _note(self, now: float, action: str, reason: str) -> None:
+        self.log.append({"t": now, "action": action, "reason": reason})
+        log_dist(f"autoscaler: {action} at t={now:.3f} ({reason})",
+                 ranks=[0])
+
+    def _cooling(self, now: float) -> bool:
+        return self._cooldown_until is not None \
+            and now < self._cooldown_until
+
+    # -- the policy loop --------------------------------------------------
+    def tick(self, now: Optional[float] = None) -> Optional[str]:
+        """One policy pass. Returns the action taken ('scale_up',
+        'scale_down', 'spinup_failed') or None. Between evaluation
+        boundaries only a pending spin-up retry can act; at a
+        boundary the signal deltas since the previous evaluation are
+        computed and voted."""
+        if not self.cfg.enabled:
+            return None
+        now = self._clock() if now is None else now
+        note_time = getattr(self.fleet, "note_time", None)
+        if note_time is not None:
+            note_time(now)
+        # a scheduled spin-up retry fires as soon as its backoff
+        # expires — the decision was already made, only the attempt
+        # was burned
+        if self._retry_at is not None and now >= self._retry_at:
+            if int(self.fleet.live_replicas()) >= self.cfg.max_replicas:
+                self._retry_at = None
+                self._retry_attempt = 0
+            else:
+                return self._try_scale_up(now, "spin-up retry")
+        if self._last_eval is not None and \
+                now - self._last_eval < self.cfg.evaluation_interval_s:
+            return None
+        self._last_eval = now
+        self.counters["evals"] += 1
+        sig = {k: float(v) for k, v in self.fleet.signals().items()}
+        prev, self._prev = self._prev, sig
+
+        def delta(key: str) -> float:
+            return sig.get(key, 0.0) - (prev.get(key, 0.0) if prev
+                                        else 0.0)
+
+        live = max(1, int(self.fleet.live_replicas()))
+        qpr = sig.get("queue_depth", 0.0) / live
+        pressure_hot = (sig.get("max_pressure_level", 0.0)
+                        >= self.cfg.scale_up_pressure)
+        degraded = (delta("shed_requests") > 0
+                    or delta("deadline_rejections") > 0)
+        premium_hit = (delta("premium_sheds") > 0
+                       or delta("premium_rejections") > 0)
+        want_up = (pressure_hot or degraded
+                   or qpr > self.cfg.scale_up_queue_per_replica)
+        calm = (sig.get("max_pressure_level", 0.0) <= GREEN
+                and qpr < self.cfg.scale_down_queue_per_replica
+                and not degraded)
+        if want_up:
+            self._up_votes += 1
+            self._down_votes = 0
+        elif calm:
+            self._down_votes += 1
+            self._up_votes = 0
+        else:
+            self._up_votes = 0
+            self._down_votes = 0
+        if premium_hit:
+            self.counters["premium_bypass"] += 1
+        if want_up and (premium_hit
+                        or self._up_votes >= self.cfg.up_hysteresis):
+            if self._retry_at is not None:
+                # a burned spin-up already owns the next attempt: the
+                # eval path must not race past its backoff
+                return None
+            if live >= self.cfg.max_replicas:
+                self.counters["scale_up_denied"] += 1
+                return None
+            if self._cooling(now):
+                self.counters["cooldown_holds"] += 1
+                return None
+            reason = ("premium SLO impact" if premium_hit else
+                      "pressure" if pressure_hot else
+                      "degradation" if degraded else
+                      f"queue {qpr:.1f}/replica")
+            return self._try_scale_up(now, reason)
+        if calm and self._down_votes >= self.cfg.down_hysteresis:
+            if live <= self.cfg.min_replicas:
+                return None
+            if self._cooling(now):
+                self.counters["cooldown_holds"] += 1
+                return None
+            if self.fleet.scale_down(now):
+                self.counters["scale_downs"] += 1
+                self._down_votes = 0
+                self._cooldown_until = \
+                    now + self.cfg.scale_down_cooldown_s
+                self._note(now, "scale_down",
+                           f"queue {qpr:.1f}/replica, pressure green")
+                return "scale_down"
+            self.counters["scale_down_denied"] += 1
+        return None
+
+    def _try_scale_up(self, now: float, reason: str) -> str:
+        self._retry_at = None
+        try:
+            self.fleet.scale_up(now)
+        except Exception as e:
+            # burned spin-up (replica died mid-scale-up): retry with
+            # exponential backoff; after spinup_max_retries the loop
+            # re-arms on the next scale-up signal instead
+            self.counters["spinup_failures"] += 1
+            if self._retry_attempt < self.cfg.spinup_max_retries:
+                backoff = (self.cfg.spinup_retry_backoff_s
+                           * (2 ** self._retry_attempt))
+                self._retry_attempt += 1
+                self.counters["spinup_retries"] += 1
+                self._retry_at = now + backoff
+                self._note(now, "spinup_failed",
+                           f"{e!r}; retry in {backoff:.3f}s")
+            else:
+                self._retry_attempt = 0
+                self._note(now, "spinup_abandoned", repr(e))
+            return "spinup_failed"
+        self._retry_attempt = 0
+        self.counters["scale_ups"] += 1
+        self._up_votes = 0
+        self._cooldown_until = now + self.cfg.scale_up_cooldown_s
+        self._note(now, "scale_up", reason)
+        return "scale_up"
+
+    def metrics(self) -> Dict[str, float]:
+        m = {f"autoscaler_{k}": float(v)
+             for k, v in self.counters.items()}
+        m["autoscaler_up_votes"] = float(self._up_votes)
+        m["autoscaler_down_votes"] = float(self._down_votes)
+        m["autoscaler_retry_pending"] = float(self._retry_at is not None)
+        return m
+
+
+class RouterFleetAdapter:
+    """Binds the policy loop to a real ServingRouter: signals come
+    from the router/scheduler counters the overload work already
+    maintains, scale_up spins a replica from `engine_factory` through
+    add_replica (cache-warm boot included), scale_down drains the
+    least-loaded routable replica of the scaled pool. With
+    join=False, spun-up replicas are left WARMING and their ids
+    collect in `pending_join` — the virtual-clock simulator charges
+    each one its modeled spin-up time, then calls
+    router.join_replica(); wall-clock callers keep the default
+    join=True (add_replica's warmup IS the spin-up time)."""
+
+    def __init__(self, router: ServingRouter,
+                 engine_factory: Callable[[], Any],
+                 role: str = "decode",
+                 premium_classes: Sequence[str] = (),
+                 join: bool = True):
+        self.router = router
+        self.engine_factory = engine_factory
+        self.role = role
+        self.premium = tuple(premium_classes)
+        self.join = join
+        self.pending_join: List[int] = []
+
+    def live_replicas(self) -> int:
+        r = self.router
+        return sum(1 for i in range(len(r.schedulers))
+                   if r._routable(i) or i in r.warming)
+
+    def signals(self) -> Dict[str, float]:
+        r = self.router
+        n = len(r.schedulers)
+        sig = {
+            "queue_depth": float(sum(
+                len(r.schedulers[i].waiting) for i in range(n)
+                if r._serving(i))),
+            "max_pressure_level": float(max(
+                (r._pressure(i) for i in range(n) if r._serving(i)),
+                default=0)),
+            "shed_requests": float(r.counters["shed_requests"]),
+            "deadline_rejections": float(sum(
+                s.counters["deadline_rejections"]
+                for s in r.schedulers)),
+            "premium_sheds": float(sum(
+                r.shed_by_class.get(c, 0) for c in self.premium)),
+            "premium_rejections": float(sum(
+                s.slo_rejections.get(c, 0) for s in r.schedulers
+                for c in self.premium)),
+        }
+        return sig
+
+    def scale_up(self, now: float) -> int:
+        rid = self.router.add_replica(
+            self.engine_factory(), role=self.role, join=self.join,
+            now=now)
+        if not self.join:
+            self.pending_join.append(rid)
+        return rid
+
+    def scale_down(self, now: float) -> bool:
+        r = self.router
+        pool = (r.prefill_idx if self.role == "prefill"
+                else r.decode_idx)
+        cands = [i for i in pool if r._routable(i)]
+        if len(cands) <= 1:
+            return False
+        # least-loaded first; ties drain the YOUNGEST replica (the
+        # most recently added host is the one to give back)
+        victim = min(cands, key=lambda i: (r._load(i), -i))
+        try:
+            r.drain_replica(victim, now=now)
+        except ReplicaDrainError:
+            return False
+        return True
+
+    def note_time(self, now: float) -> None:
+        self.router.observe_time(now)

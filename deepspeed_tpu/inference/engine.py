@@ -1019,6 +1019,43 @@ class InferenceEngine:
         self.cache = self._kv_scatter_fn()(
             self.cache, self._dev(idx), *gathered)
 
+    def export_parked_kv(self, limit: int) -> List[Dict[str, Any]]:
+        """Serialize up to `limit` of this engine's hottest PARKED
+        prefix chains (StateManager.parked_chains — MRU-first, full
+        token provenance) as export_kv-format payloads, one per chain:
+        seen_tokens covers exactly the chain's full blocks, the page
+        stacks ride the SAME compiled gather as a live handoff, and
+        the blake2b digest envelope is attached. A joining replica
+        (inference/router.py add_replica warm boot) import_kv()s each
+        payload onto a scratch uid and flushes it, which parks the
+        pages AND registers the prefix chain in its own hash index —
+        the new replica's first same-prefix prompt scores a cache hit
+        before it has served anything. Chains longer than
+        blocks_per_seq are truncated to the transfer window (the
+        leading blocks still form a valid chain). Read-only on the
+        donor: nothing is acquired, flushed, or evicted."""
+        payloads: List[Dict[str, Any]] = []
+        bs = self.state.block_size
+        for tokens, blocks in self.state.parked_chains(limit):
+            nb = min(len(blocks), self.config.blocks_per_seq)
+            idx = self._pad_block_idx(blocks[:nb])
+            self.recompile_tracker.record("kv_transfer_gather", (idx,))
+            gathered = self._kv_gather_fn()(self.cache, self._dev(idx))
+            payload = {
+                "seen_tokens": nb * bs,
+                "n_blocks": nb,
+                "kv_dtype": str(self.cache.k[0].dtype),
+                "token_ids": list(tokens[:nb * bs]),
+                "k": serving_readback(gathered[0])[:, :nb],
+                "v": serving_readback(gathered[1])[:, :nb],
+            }
+            if self.cache.quantized:
+                payload["k_scale"] = serving_readback(gathered[2])[:, :nb]
+                payload["v_scale"] = serving_readback(gathered[3])[:, :nb]
+            payload["digest"] = payload_digest(payload)
+            payloads.append(payload)
+        return payloads
+
     # -- scheduling queries (ref: engine_v2.py query:158/can_schedule:184)
     def query(self, uid: int) -> Dict[str, Any]:
         seq = self.state.get(uid)

@@ -149,6 +149,12 @@ class BlockedAllocator:
         if 0 <= self._pool_cap < len(self._lru):
             self._free.append(self._evict_lru())
 
+    def parked_blocks_mru(self) -> List[int]:
+        """Parked (evictable, index-addressed) block ids, MOST recently
+        used first — the replica-spin-up warm-boot path enumerates the
+        donor's hottest prefix chains in this order (read-only)."""
+        return list(reversed(self._lru))
+
     def trim_parked(self, max_blocks: int) -> int:
         """Evict up to `max_blocks` LRU-parked blocks into the free
         list (contents dropped, index keys released via the evict
@@ -242,6 +248,12 @@ class StateManager:
         self._seqs: Dict[int, SequenceDescriptor] = {}
         self._index: Dict[bytes, int] = {}      # chain key -> block id
         self._block_key: Dict[int, bytes] = {}  # block id -> chain key
+        # chain key -> (parent key, this block's token ids): the token
+        # provenance that lets a parked chain be re-serialized for a
+        # cross-replica warm boot (parked_chains) — block_size ints per
+        # indexed block, dropped with the index entry on eviction
+        self._chain_meta: Dict[
+            bytes, Tuple[Optional[bytes], Tuple[int, ...]]] = {}
         self.stats: Dict[str, int] = {
             "lookup_hits": 0, "lookup_misses": 0,
             "cached_tokens": 0, "prompt_tokens": 0, "cow_copies": 0,
@@ -251,6 +263,7 @@ class StateManager:
         key = self._block_key.pop(block, None)
         if key is not None and self._index.get(key) == block:
             del self._index[key]
+            self._chain_meta.pop(key, None)
 
     # -- queries (ref: ragged_manager.py get_sequence:125 etc.) ----------
     def get(self, uid: int) -> Optional[SequenceDescriptor]:
@@ -335,6 +348,52 @@ class StateManager:
             out.append((key, block))
         return out
 
+    def parked_chains(
+            self, limit: int) -> List[Tuple[List[int], List[int]]]:
+        """Up to `limit` indexed prefix chains whose LEAF block is
+        currently parked, hottest (MRU) first: [(token_ids, blocks)],
+        each chain root-to-leaf with full token provenance. Read-only
+        — nothing is acquired or mutated. The replica-lifecycle warm
+        boot (inference/router.py add_replica) serializes these through
+        engine.export_parked_kv so a joining replica starts with the
+        donor's hottest cached prefixes already parked in its own
+        pool. A chain that is a prefix of an already-collected one is
+        skipped (the longer chain carries it); a chain whose interior
+        metadata was evicted is skipped whole (its pages may be
+        recycled)."""
+        chains: List[Tuple[List[int], List[int]]] = []
+        seen_keys: set = set()
+        for block in self.allocator.parked_blocks_mru():
+            if len(chains) >= max(0, limit):
+                break
+            key = self._block_key.get(block)
+            if key is None or key in seen_keys:
+                continue
+            toks_rev: List[Tuple[int, ...]] = []
+            blocks_rev: List[int] = []
+            walk: List[bytes] = []
+            k: Optional[bytes] = key
+            intact = True
+            while k is not None:
+                meta = self._chain_meta.get(k)
+                b = self._index.get(k)
+                if meta is None or b is None:
+                    intact = False
+                    break
+                toks_rev.append(meta[1])
+                blocks_rev.append(b)
+                walk.append(k)
+                k = meta[0]
+            # ancestors are covered by this (longer) chain either way:
+            # a broken walk means the root was evicted and every
+            # descendant key is equally unservable as a chain
+            seen_keys.update(walk)
+            if not intact:
+                continue
+            tokens = [t for blk in reversed(toks_rev) for t in blk]
+            chains.append((tokens, list(reversed(blocks_rev))))
+        return chains
+
     def _acquire(self, block: int) -> None:
         if self.allocator.is_parked(block):
             self.allocator.acquire_cached(block)
@@ -354,6 +413,8 @@ class StateManager:
             if key not in self._index:
                 self._index[key] = block
                 self._block_key[block] = key
+                self._chain_meta[key] = (
+                    parent, tuple(seq.tokens[i * bs:(i + 1) * bs]))
                 self.allocator.mark_cached(block)
             # an existing entry wins (concurrent identical prompts):
             # this sequence's duplicate block stays private

@@ -60,6 +60,30 @@ backed replicas and decides, per request, WHERE work runs:
   sheds with per-session fairness (RequestShedError / finish_reason
   'shed') instead of growing latency without bound.
 
+- **elastic replica lifecycle** (docs/autoscaling.md): the fleet size
+  is a DYNAMIC resource, not a construction-time constant. Replica ids
+  are stable — `schedulers` is append-only and a released replica's
+  slot is tombstoned, never compacted, so `replica<i>/*` metric names,
+  breaker slots, and session pins stay correct across add/drain
+  cycles. `add_replica()` spins a replica up: scheduler construction
+  AOT-warms the decode grid + the KV-transfer pair, then a cache-warm
+  boot imports the healthiest donor's hottest parked prefix chains
+  (engine.export_parked_kv -> import_kv under the digest envelope;
+  deferred when every donor sits at RED+ pressure) BEFORE the replica
+  enters the routing score — joins keep the zero-recompile steady
+  state and start winning prefix-locality picks immediately.
+  `drain_replica()` is the graceful inverse of fail_replica: the
+  replica stops taking new work (DRAINING — routing, pins, and pump
+  targets all skip it), its waiting queue re-routes, in-flight
+  handoffs pump out, and its RUNNING/PREFILL sequences MIGRATE by
+  page move (export_kv -> adopt on a peer — zero recompute, zero
+  token change) with requeue-for-recompute as the token-identical
+  fallback; once empty the replica is RELEASED and its drain time
+  recorded. The chaos points `replica.spinup` / `replica.drain` model
+  a replica killed mid-scale-up (burned — the autoscaler retries with
+  backoff) and a drain that fails at entry. The policy loop deciding
+  WHEN to scale lives in inference/autoscaler.py.
+
 The router is single-threaded by design, like the scheduler under it:
 `serve()` round-robins step()/pump() across replicas until idle, and
 the serving simulator (bench.py --serving-sim --replicas N) drives
@@ -86,9 +110,21 @@ from ..resilience.integrity import HandoffIntegrityError
 from ..utils.logging import log_dist
 from .engine import InferenceEngine
 from .pressure import BROWNOUT, GREEN, RED
-from .scheduler import FINISHED, Request, ServingScheduler
+from .scheduler import FINISHED, PREFILL, RUNNING, Request, ServingScheduler
 
-__all__ = ["ServingRouter", "ServingRouterConfig", "RequestShedError"]
+__all__ = ["ServingRouter", "ServingRouterConfig", "RequestShedError",
+           "ReplicaDrainError"]
+
+# replica lifecycle states (docs/autoscaling.md): ACTIVE serves and
+# routes; WARMING is registered but invisible to routing/stepping until
+# join_replica(); DRAINING serves its in-flight work but takes nothing
+# new; RELEASED is a tombstone (the slot's id is never reused); DEAD is
+# the failover state (restorable — the orthogonal dead/draining sets
+# compose: a draining replica can die, a dead one cannot drain).
+ACTIVE, WARMING, DRAINING, RELEASED, DEAD = (
+    "active", "warming", "draining", "released", "dead")
+LIFECYCLE_CODE = {ACTIVE: 0, WARMING: 1, DRAINING: 2, RELEASED: 3,
+                  DEAD: 4}
 
 
 class RequestShedError(RuntimeError):
@@ -96,6 +132,13 @@ class RequestShedError(RuntimeError):
     the NEW request as the victim (its session already holds the most
     queued work, or shed_policy='reject'). Callers back off / surface
     429; nothing was enqueued."""
+
+
+class ReplicaDrainError(RuntimeError):
+    """drain_replica() would leave the fleet unable to serve: the
+    target is the last routable replica of its pool (decode — or
+    prefill in a disaggregated fleet). Nothing was drained; scale up
+    first, or fail the replica over if it is actually broken."""
 
 
 class ServingRouter:
@@ -130,6 +173,10 @@ class ServingRouter:
                 f"{len(engines)} engines were provided")
         self._check_homogeneous(engines)
         self.seed = int(seed)
+        # kept for replica spin-up: a replica added later must share
+        # the fleet's sampling config (draws key on seed/stream/
+        # position — the SAME chain everywhere, or placement shows)
+        self._sampling = dict(sampling) if sampling else None
 
         # -- role split -------------------------------------------------
         self.mode = self.cfg.mode
@@ -177,6 +224,19 @@ class ServingRouter:
 
         # -- routing state ----------------------------------------------
         self.dead: set = set()
+        # replica lifecycle (docs/autoscaling.md): ids are STABLE —
+        # self.schedulers is append-only and a released replica's slot
+        # is tombstoned by membership in `released`, never compacted,
+        # so replica<i>/* metric names, breaker slots, and the
+        # failover audit stay correct across add/drain/release cycles
+        self.warming: set = set()
+        self.draining: set = set()
+        self.released: set = set()
+        self._drain_started: Dict[int, float] = {}
+        self._drain_s: List[float] = []          # drain start -> release
+        self._replica_hours = 0.0                # provisioned-time integral
+        self._last_obs_t: Optional[float] = None
+        self.shed_by_class: Dict[str, int] = {}  # slo_class -> sheds
         self._reqs: Dict[int, Request] = {}      # gid -> request
         self._where: Dict[int, int] = {}         # gid -> replica index
         self._session_of: Dict[int, Any] = {}    # gid -> session id
@@ -197,6 +257,23 @@ class ServingRouter:
             # replica at its handoff-backlog bound
             "handoff_backpressure": 0, "prefill_backpressure": 0,
             "brownout_shed_engaged": 0,
+            # replica lifecycle (docs/autoscaling.md): spin-up/drain
+            # outcomes — scale_ups counts completed registrations,
+            # burned_replicas the spin-ups killed mid-flight
+            # (replica.spinup chaos point), warm_prefix_imports the
+            # donor prefix chains imported at join (warm boot),
+            # warm_joins_deferred the joins that went cache-cold
+            # because every donor sat at RED+ pressure,
+            # affinity_drain_breaks the session pins broken by a
+            # drain, drain_migrations the sequences moved out of a
+            # draining replica by page transfer (zero recompute),
+            # drain_recomputes the ones that fell back to
+            # requeue-for-recompute (still token-identical)
+            "scale_ups": 0, "scale_downs": 0, "spinup_joins": 0,
+            "rebalanced_on_join": 0,
+            "burned_replicas": 0, "warm_prefix_imports": 0,
+            "warm_joins_deferred": 0, "affinity_drain_breaks": 0,
+            "drain_migrations": 0, "drain_recomputes": 0,
         }
 
         # -- self-healing state ------------------------------------------
@@ -242,6 +319,52 @@ class ServingRouter:
                     "the fleet must be model/geometry-identical (KV "
                     "pages move between replicas verbatim)")
 
+    # -- lifecycle predicates ---------------------------------------------
+    def lifecycle(self, i: int) -> str:
+        """Replica i's lifecycle state (dead wins over draining: a
+        replica that died mid-drain is a failover case, not a drain)."""
+        if i in self.released:
+            return RELEASED
+        if i in self.dead:
+            return DEAD
+        if i in self.warming:
+            return WARMING
+        if i in self.draining:
+            return DRAINING
+        return ACTIVE
+
+    def _routable(self, i: int) -> bool:
+        """May NEW work (submissions, requeues, handoff imports) land
+        on replica i? Draining and warming replicas are skipped — a
+        draining replica is leaving, a warming one has not yet earned
+        its zero-recompile steady state."""
+        return (i not in self.dead and i not in self.released
+                and i not in self.draining and i not in self.warming)
+
+    def _serving(self, i: int) -> bool:
+        """Does replica i still step/pump (its in-flight work counts)?
+        True for ACTIVE and DRAINING — a draining replica keeps
+        serving what it holds until migration empties it."""
+        return (i not in self.dead and i not in self.released
+                and i not in self.warming)
+
+    def observe_time(self, now: Optional[float] = None) -> None:
+        """Advance the replica-hour integral: every PROVISIONED replica
+        (warming, active, draining, dead-awaiting-restore — anything
+        whose host is still held, i.e. not released) accrues hours
+        between observations. The autoscaler calls this every tick on
+        the shared clock; add/drain/release call it internally, so
+        fleet/replica_hours is exact at every fleet-size transition."""
+        now = self._clock() if now is None else now
+        if self._last_obs_t is None:
+            self._last_obs_t = now
+            return
+        dt = max(0.0, now - self._last_obs_t)
+        n = sum(1 for i in range(len(self.schedulers))
+                if i not in self.released)
+        self._replica_hours += n * dt / 3600.0
+        self._last_obs_t = now
+
     # -- load + scoring ---------------------------------------------------
     def _load(self, i: int) -> int:
         """Backlog of replica i, in requests (queued + in flight)."""
@@ -249,7 +372,9 @@ class ServingRouter:
         return len(s.waiting) + len(s.active) + len(s.handoff_ready)
 
     def _live(self, pool: Sequence[int]) -> List[int]:
-        live = [i for i in pool if i not in self.dead]
+        """The pool members NEW work may land on: live AND routable
+        (dead, draining, warming, and released replicas all skipped)."""
+        live = [i for i in pool if self._routable(i)]
         if not live:
             raise RuntimeError(
                 "serving router: no live replica in the "
@@ -342,7 +467,7 @@ class ServingRouter:
         even with max_fleet_queue unbounded. False when no replica has
         a governor (pressure off)."""
         live = [i for i in range(len(self.schedulers))
-                if i not in self.dead]
+                if self._routable(i)]
         govs = [self.schedulers[i].governor for i in live]
         if not govs or any(g is None for g in govs):
             return False
@@ -374,10 +499,10 @@ class ServingRouter:
         if bound == 0 and self.cfg.brownout_shed and self._fleet_brownout():
             bound = sum(
                 self.schedulers[i].engine.config.max_batch_size
-                for i in range(len(self.schedulers)) if i not in self.dead)
+                for i in range(len(self.schedulers)) if self._routable(i))
             self.counters["brownout_shed_engaged"] += 1
         if bound > 0:
-            self._shed_for_room(session, bound)
+            self._shed_for_room(session, bound, slo_class=slo_class)
         gid = self._next_gid
         self._next_gid += 1
         pool = (self.prefill_idx if self.mode == "disaggregated"
@@ -411,7 +536,8 @@ class ServingRouter:
         return self._session_of.get(req.stream)
 
     def _shed_for_room(self, session: Any,
-                       bound: Optional[int] = None) -> None:
+                       bound: Optional[int] = None,
+                       slo_class: Optional[str] = None) -> None:
         """Graceful degradation: called before enqueueing a new request
         when a queue bound is in force (max_fleet_queue, or the fleet
         batch capacity while every live replica is at BROWNOUT). Under
@@ -422,11 +548,12 @@ class ServingRouter:
         request is the victim (RequestShedError; nothing enqueued)."""
         bound = self.cfg.max_fleet_queue if bound is None else bound
         waiting = [(i, req) for i, s in enumerate(self.schedulers)
-                   if i not in self.dead for req in s.waiting]
+                   if self._serving(i) for req in s.waiting]
         if len(waiting) < bound:
             return
         self.counters["shed_requests"] += 1
         if self.cfg.shed_policy == "reject":
+            self._count_shed_class(slo_class)
             raise RequestShedError(
                 f"fleet queue at its bound ({bound}); request rejected")
         counts: Dict[Any, int] = {}
@@ -436,6 +563,7 @@ class ServingRouter:
         heaviest = max(counts.values())
         mine = counts.get(session, 0) if session is not None else 0
         if session is None or mine >= heaviest:
+            self._count_shed_class(slo_class)
             raise RequestShedError(
                 "fleet queue full and the submitting session holds the "
                 f"most queued work ({mine}/{heaviest}); request shed")
@@ -448,10 +576,18 @@ class ServingRouter:
         victim.finish_reason = "shed"
         victim.finish_t = time.perf_counter()
         self.schedulers[i].finished[victim.rid] = victim
+        self._count_shed_class(victim.slo_class)
         log_dist(
             f"serving router: fleet queue at its bound ({bound}); "
             f"shed request gid={victim.stream} of session "
             f"{self._session_key(victim)!r} on replica {i}", ranks=[0])
+
+    def _count_shed_class(self, slo_class: Optional[str]) -> None:
+        """Per-class shed accounting: the autoscaler's premium-impact
+        signal needs WHOSE request was shed, not just that one was."""
+        if slo_class is not None:
+            self.shed_by_class[slo_class] = \
+                self.shed_by_class.get(slo_class, 0) + 1
 
     @property
     def has_work(self) -> bool:
@@ -459,7 +595,7 @@ class ServingRouter:
 
     def _pending(self):
         for i, s in enumerate(self.schedulers):
-            if i in self.dead:
+            if not self._serving(i):
                 continue
             yield s.has_work or bool(s.handoff_ready)
 
@@ -484,7 +620,11 @@ class ServingRouter:
             return moves
         backpressured = False
         for p in self.prefill_idx:
-            if p in self.dead:
+            # draining prefill replicas are still pumped FROM — their
+            # parked handoff payloads are finished work the drain must
+            # move out, not recompute — but never INTO (_live/_routable
+            # keeps new work and decode targets off them)
+            if not self._serving(p):
                 continue
             ps = self.schedulers[p]
             while ps.handoff_ready:
@@ -560,10 +700,12 @@ class ServingRouter:
         return moves
 
     def _decode_can_take(self) -> bool:
-        """Is any live decode replica able to absorb a handoff right
-        now (a free batch slot and pressure below RED)?"""
+        """Is any live ROUTABLE decode replica able to absorb a handoff
+        right now (a free batch slot and pressure below RED)? Draining
+        replicas never take a handoff — they are pumping their own
+        work out."""
         for i in self.decode_idx:
-            if i in self.dead:
+            if not self._routable(i):
                 continue
             s = self.schedulers[i]
             if len(s.active) < s.engine.config.max_batch_size \
@@ -599,10 +741,16 @@ class ServingRouter:
         only restore_replica() brings it back. The health monitor's
         automatic path leaves the breaker OPEN so backoff + half-open
         probes drive the rejoin."""
-        if i in self.dead:
+        if i in self.dead or i in self.released:
             return 0
         now = self._clock() if now is None else now
         self.dead.add(i)
+        # a replica that dies mid-drain is a failover, not a drain:
+        # the drain is aborted (no drain time recorded) and the
+        # orphans take the requeue path like any other death
+        self.draining.discard(i)
+        self._drain_started.pop(i, None)
+        self.warming.discard(i)
         if not _auto:
             self.health.hold(i)
         s = self.schedulers[i]
@@ -633,6 +781,380 @@ class ServingRouter:
             f"({'auto' if _auto else 'manual'}); requeued {moved} "
             f"in-flight requests onto live replicas", ranks=[0])
         return moved
+
+    # -- elastic lifecycle: spin-up / join / drain / release --------------
+    def add_replica(self, engine: InferenceEngine, role: str = "decode",
+                    join: bool = True,
+                    now: Optional[float] = None) -> int:
+        """Spin up one replica and (optionally) enter it into routing.
+        Returns the new replica's stable id. Protocol
+        (docs/autoscaling.md):
+
+          1. geometry/KV-dtype validation against a live fleet engine
+             (pages must move verbatim in BOTH directions);
+          2. scheduler construction — engine.warmup() AOT-compiles the
+             decode/sample grid, warmup_kv_transfer() the handoff
+             gather/scatter pair, so the join keeps the fleet's
+             zero-recompile steady state;
+          3. cache-warm boot (_warm_boot): the healthiest live donor
+             exports its hottest parked prefix chains
+             (engine.export_parked_kv, digest envelope attached) and
+             the joiner imports + parks them — it starts winning
+             prefix-locality picks before serving anything. Deferred
+             (cache-cold join) when every candidate donor sits at RED+
+             pressure: a gather/readback there would tax the pool
+             exactly while it is defending itself, and no donor's
+             parked blocks are touched (no eviction storm);
+          4. chaos point 'replica.spinup' (phase ctx 'build'/'join'):
+             a raise models the replica dying mid-scale-up — the
+             attempt is BURNED (counter burned_replicas, no id
+             consumed, no routing state half-mutated) and the error
+             surfaces to the caller; the autoscaler retries with
+             exponential backoff;
+          5. registration: breaker slot, role pool, mode flag — then
+             ACTIVE (join=True) or WARMING (join=False: a virtual-
+             clock driver charges the modeled spin-up time and calls
+             join_replica() when it elapses; routing, stepping, and
+             pump targets all skip WARMING replicas)."""
+        if role not in ("decode", "prefill"):
+            raise ValueError(f"unknown replica role {role!r} "
+                             "(expected decode|prefill)")
+        if role == "prefill" and self.mode != "disaggregated":
+            raise ValueError(
+                "prefill replicas only exist in disaggregated mode")
+        now = self._clock() if now is None else now
+        self.observe_time(now)
+        rid = len(self.schedulers)
+        try:
+            fault_point("replica.spinup", replica=rid, phase="build")
+            ref = next((self.schedulers[i].engine
+                        for i in range(len(self.schedulers))
+                        if i not in self.released), None)
+            if ref is not None:
+                self._check_homogeneous([ref, engine])
+            sched = ServingScheduler(
+                engine, self.cfg.scheduler, sampling=self._sampling,
+                seed=self.seed)
+            sched.replica_index = rid
+            engine.warmup_kv_transfer()
+            self._warm_boot(sched)
+            fault_point("replica.spinup", replica=rid, phase="join")
+        except Exception:
+            self.counters["burned_replicas"] += 1
+            log_dist(
+                f"serving router: replica {rid} spin-up burned "
+                "mid-scale-up; nothing was registered", ranks=[0])
+            raise
+        self.schedulers.append(sched)
+        self.replica_mode.append(
+            "prefill" if role == "prefill"
+            else "decode" if self.mode == "disaggregated" else "mixed")
+        self.health.add_replica()
+        (self.prefill_idx if role == "prefill"
+         else self.decode_idx).append(rid)
+        self.counters["scale_ups"] += 1
+        if join:
+            self.counters["spinup_joins"] += 1
+            self._rebalance_to(rid)
+        else:
+            self.warming.add(rid)
+        log_dist(
+            f"serving router: replica {rid} ({role}) spun up "
+            f"{'and joined routing' if join else 'WARMING'}", ranks=[0])
+        return rid
+
+    def join_replica(self, rid: int, now: Optional[float] = None) -> None:
+        """Enter a WARMING replica into routing — the second half of a
+        two-phase spin-up (add_replica(join=False)), called by
+        virtual-clock drivers once the modeled spin-up time elapsed."""
+        if rid not in self.warming:
+            raise ValueError(f"replica {rid} is not warming "
+                             f"({self.lifecycle(rid)})")
+        now = self._clock() if now is None else now
+        self.observe_time(now)
+        self.warming.discard(rid)
+        self.counters["spinup_joins"] += 1
+        self._rebalance_to(rid)
+        log_dist(f"serving router: replica {rid} joined routing",
+                 ranks=[0])
+
+    def _rebalance_to(self, rid: int) -> int:
+        """Level the waiting queues onto a freshly-joined replica: a
+        scale-up must relieve the backlog that CAUSED it, not just
+        future arrivals — without this, a burst that queued before the
+        join is served entirely by the old fleet while the new replica
+        idles. Moves the NEWEST waiting requests off the queue-
+        heaviest peers (the oldest keep their local FCFS position)
+        until the newcomer is within one request of the heaviest
+        queue. WAITING requests hold no KV, so a move is a pure
+        bookkeeping requeue — token-identical by the (seed, stream,
+        position) contract."""
+        pool = (self.prefill_idx
+                if rid in self.prefill_idx else self.decode_idx)
+        moved = 0
+        while True:
+            others = [j for j in pool if j != rid and self._routable(j)]
+            if not others:
+                break
+            heavy = max(others,
+                        key=lambda j: (len(self.schedulers[j].waiting), -j))
+            hs = self.schedulers[heavy]
+            if len(hs.waiting) <= len(self.schedulers[rid].waiting) + 1:
+                break
+            req = hs.waiting.pop()
+            req.uid = None
+            self.schedulers[rid].requeue(req)
+            self._where[req.stream] = rid
+            moved += 1
+        if moved:
+            self.counters["rebalanced_on_join"] += moved
+            log_dist(
+                f"serving router: rebalanced {moved} waiting requests "
+                f"onto joined replica {rid}", ranks=[0])
+        return moved
+
+    def _warm_boot(self, sched: ServingScheduler) -> int:
+        """Cache-warm the joining replica from the healthiest live
+        donor: import + park up to warm_prefix_limit of the donor's
+        hottest parked prefix chains. Returns chains imported (0 =
+        cold join). Deferral: when every candidate donor sits at RED+
+        pressure the join goes cold instead (warm_joins_deferred) —
+        the joiner warming up is strictly less urgent than a
+        pressured donor staying afloat, and nothing on any donor is
+        evicted, flushed, or acquired."""
+        limit = self.cfg.warm_prefix_limit
+        if limit < 1:
+            return 0
+        donors = [i for i in range(len(self.schedulers))
+                  if self._routable(i)]
+        if not donors:
+            return 0
+        calm = [i for i in donors if self._pressure(i) < RED]
+        if not calm:
+            self.counters["warm_joins_deferred"] += 1
+            log_dist(
+                "serving router: every warm-boot donor is at RED+ "
+                "pressure; joining cache-cold", ranks=[0])
+            return 0
+        donor = min(calm,
+                    key=lambda i: (self._pressure(i), self._load(i), i))
+        imported = 0
+        for payload in \
+                self.schedulers[donor].engine.export_parked_kv(limit):
+            uid = sched._alloc_uid()
+            try:
+                sched.engine.import_kv(uid, payload)
+                sched.engine.flush(uid)  # parks + registers the chain
+            except Exception as e:
+                if sched.engine.state.get(uid) is not None:
+                    sched.engine.flush(uid)
+                log_dist(
+                    f"serving router: warm-boot chain import failed "
+                    f"({e!r}); continuing", ranks=[0])
+                continue
+            imported += 1
+        self.counters["warm_prefix_imports"] += imported
+        return imported
+
+    def drain_replica(self, i: int, now: Optional[float] = None) -> int:
+        """Gracefully remove replica i: stop new admissions (DRAINING
+        — routing, session pins, and pump targets all skip it), break
+        its session pins (re-score + re-pin at each session's next
+        submit; counter affinity_drain_breaks), re-route its waiting
+        queue, and start migrating its in-flight sequences out
+        (_drain_migrate: page moves first, token-identical recompute
+        as fallback). The replica keeps stepping its remaining work;
+        step()/pump_drains() retries migration each sweep and RELEASES
+        the replica once it is empty (drain time recorded; counter
+        scale_downs). Returns the number of requests moved off
+        immediately.
+
+        Distinct from fail_replica by construction: a drain's happy
+        path MOVES the KV pages (export_kv -> adopt — zero recompute,
+        the pending token rides along), where failover can only
+        requeue. Raises ReplicaDrainError when i is the last routable
+        replica of its pool — a fleet must keep serving."""
+        if i in self.released or i in self.dead:
+            raise ValueError(
+                f"replica {i} is {self.lifecycle(i)}; only active or "
+                "warming replicas can drain")
+        if i in self.draining:
+            return 0
+        now = self._clock() if now is None else now
+        fault_point("replica.drain", replica=i)
+        pools = ([self.prefill_idx, self.decode_idx]
+                 if self.mode == "disaggregated" else [self.decode_idx])
+        for pool in pools:
+            if i in pool and not any(
+                    j != i and self._routable(j) for j in pool):
+                raise ReplicaDrainError(
+                    f"replica {i} is the last routable "
+                    f"{'prefill' if pool is self.prefill_idx else 'decode'}"
+                    " replica — draining it would leave the fleet "
+                    "unable to serve")
+        self.observe_time(now)
+        if i in self.warming:
+            # never entered routing: release directly, nothing to move
+            self.warming.discard(i)
+            self._drain_started[i] = now
+            self.draining.add(i)
+            self._maybe_release(i, now=now)
+            return 0
+        self.draining.add(i)
+        self._drain_started[i] = now
+        broken = [s for s, r in self._sessions.items() if r == i]
+        for s in broken:
+            del self._sessions[s]
+        self.counters["affinity_drain_breaks"] += len(broken)
+        sched = self.schedulers[i]
+        moved = 0
+        # waiting work never started here — route it somewhere live
+        for req in list(sched.waiting):
+            sched.waiting.remove(req)
+            req.uid = None
+            pool = (self.prefill_idx if self.mode == "disaggregated"
+                    else self.decode_idx)
+            r = self._route(req.base, self._session_of.get(req.stream),
+                            pool)
+            req.handoff = self.mode == "disaggregated"
+            self.schedulers[r].requeue(req)
+            self._where[req.stream] = r
+            moved += 1
+        moved += self._drain_migrate(i)
+        self._maybe_release(i, now=now)
+        log_dist(
+            f"serving router: replica {i} draining; moved {moved} "
+            f"requests out, {len(sched.active)} in-flight remain "
+            f"(+{len(sched.handoff_ready)} parked handoffs)", ranks=[0])
+        return moved
+
+    def _drain_target(self, i: int) -> Optional[int]:
+        """The decode replica a draining sequence migrates TO: routable,
+        a free batch slot, pressure below RED — least-loaded wins.
+        None when every peer is saturated (the sequence stays for the
+        next sweep: its KV is done work worth keeping)."""
+        best = None
+        for j in self.decode_idx:
+            if j == i or not self._routable(j):
+                continue
+            s = self.schedulers[j]
+            if len(s.active) >= s.engine.config.max_batch_size:
+                continue
+            if self._pressure(j) >= RED:
+                continue
+            if best is None or (self._load(j), j) < (self._load(best), best):
+                best = j
+        return best
+
+    def _drain_migrate(self, i: int) -> int:
+        """Move replica i's in-flight sequences out. Decode/mixed
+        replicas migrate by PAGE TRANSFER: export_kv -> adopt on a
+        peer with room (RUNNING resumes at its pending token,
+        mid-PREFILL continues chunking — zero recompute either way;
+        counter drain_migrations), falling back to requeue-for-
+        recompute (drain_recomputes — still token-identical) when the
+        export/import fails. Disaggregated PREFILL replicas requeue
+        their unfinished prefills onto peer prefill replicas instead
+        (an adopt target would cross the role split); their FINISHED
+        handoff payloads are pump()'s business and move untouched."""
+        sched = self.schedulers[i]
+        moved = 0
+        if self.mode == "disaggregated" and i in self.prefill_idx:
+            for req in list(sched.active):
+                sched.active.remove(req)
+                if req.uid is not None \
+                        and sched.engine.state.get(req.uid) is not None:
+                    sched.engine.flush(req.uid)
+                req.uid = None
+                r = self._route(req.base,
+                                self._session_of.get(req.stream),
+                                self.prefill_idx)
+                req.handoff = True
+                self.schedulers[r].requeue(req)
+                self._where[req.stream] = r
+                self.counters["drain_recomputes"] += 1
+                moved += 1
+            return moved
+        for req in list(sched.active):
+            if req.state not in (RUNNING, PREFILL):
+                continue
+            target = self._drain_target(i)
+            if target is None:
+                break  # every peer saturated: retry next sweep
+            gid = req.stream
+            try:
+                payload = sched.engine.export_kv(req.uid)
+            except Exception as e:
+                log_dist(
+                    f"serving router: drain export of gid={gid} on "
+                    f"replica {i} failed ({e!r}); recomputing",
+                    ranks=[0])
+                if sched.engine.state.get(req.uid) is not None:
+                    sched.engine.flush(req.uid)
+                sched.active.remove(req)
+                req.uid = None
+                self.counters["drain_recomputes"] += 1
+                self._requeue_for_recompute(req)
+                moved += 1
+                continue
+            sched.engine.flush(req.uid)
+            sched.active.remove(req)
+            req.uid = None
+            try:
+                self.schedulers[target].adopt(req, payload)
+                self._where[gid] = target
+                self.counters["drain_migrations"] += 1
+            except Exception as e:
+                log_dist(
+                    f"serving router: drain adopt of gid={gid} on "
+                    f"replica {target} failed ({e!r}); recomputing",
+                    ranks=[0])
+                self.counters["drain_recomputes"] += 1
+                self._requeue_for_recompute(req)
+            moved += 1
+        return moved
+
+    def _maybe_release(self, i: int,
+                       now: Optional[float] = None) -> bool:
+        """Finish a drain: once replica i holds no waiting, active, or
+        parked-handoff work, flush whatever the engine still tracks
+        (its parked prefix pool leaves with the host), tombstone the
+        slot (RELEASED — the id is never reused), remove it from its
+        role pool, and record the drain duration."""
+        if i not in self.draining:
+            return False
+        s = self.schedulers[i]
+        if s.active or s.waiting or s.handoff_ready:
+            return False
+        now = self._clock() if now is None else now
+        self.observe_time(now)
+        for uid in list(s.engine.state.tracked_uids):
+            s.engine.flush(uid)
+        self.draining.discard(i)
+        self.released.add(i)
+        if i in self.decode_idx:
+            self.decode_idx.remove(i)
+        if i in self.prefill_idx:
+            self.prefill_idx.remove(i)
+        dur = max(0.0, now - self._drain_started.pop(i))
+        self._drain_s.append(dur)
+        self.counters["scale_downs"] += 1
+        log_dist(
+            f"serving router: replica {i} drained and released "
+            f"({dur:.3f}s)", ranks=[0])
+        return True
+
+    def pump_drains(self, now: Optional[float] = None) -> bool:
+        """One drain sweep: retry migration off every draining replica
+        and release the ones that emptied. step() calls this; virtual-
+        clock drivers call it directly with their own now."""
+        progressed = False
+        for i in list(self.draining):
+            if self._drain_migrate(i):
+                progressed = True
+            if self._maybe_release(i, now=now):
+                progressed = True
+        return progressed
 
     # -- self-healing: observations, probes, rejoin -----------------------
     def note_step_result(self, i: int, ok: bool, duration_s: float,
@@ -691,6 +1213,10 @@ class ServingRouter:
         re-enable routing. Session pins re-form through scoring; no
         pin survives a death, so nothing routes here until the replica
         wins a pick again."""
+        if i in self.released:
+            raise ValueError(
+                f"replica {i} was drained and released — its slot is a "
+                "tombstone; spin up a new replica (add_replica) instead")
         if i not in self.dead:
             return
         now = self._clock() if now is None else now
@@ -721,7 +1247,7 @@ class ServingRouter:
         breaker probes. Returns False when nothing progressed."""
         progressed = False
         for i, sched in enumerate(self.schedulers):
-            if i in self.dead:
+            if not self._serving(i):
                 continue
             t0 = self._clock()
             ok = True
@@ -741,6 +1267,8 @@ class ServingRouter:
                 if self.note_step_result(i, ok, dur, now=now) == "open":
                     progressed = True  # fleet state changed: orphans moved
         if self.pump():
+            progressed = True
+        if self.pump_drains():
             progressed = True
         if self.poll_health():
             progressed = True
@@ -770,7 +1298,7 @@ class ServingRouter:
 
     # -- observability ----------------------------------------------------
     def describe(self) -> Dict[str, Any]:
-        """Static fleet topology: mode, per-replica role flags."""
+        """Fleet topology: mode, per-replica role flags + lifecycle."""
         return {
             "mode": self.mode,
             "replicas": len(self.schedulers),
@@ -778,12 +1306,21 @@ class ServingRouter:
             "prefill_replicas": list(self.prefill_idx),
             "decode_replicas": list(self.decode_idx),
             "policy": self.cfg.policy,
+            "lifecycle": [self.lifecycle(i)
+                          for i in range(len(self.schedulers))],
         }
 
     def metrics(self) -> Dict[str, float]:
         """Fleet-aggregate metrics under fleet/ plus every replica's
         scheduler metrics under replica<i>/ — the monitor feed
-        (monitor.serving_events(router, step) emits all of them)."""
+        (monitor.serving_events(router, step) emits all of them).
+        `i` is the replica's STABLE id (append-only slots, tombstoned
+        on release), so a name never changes meaning across
+        add/drain/release; released replicas keep reporting their
+        final counters (their TTFT/TPOT history stays in the fleet
+        percentiles — they served real requests) plus
+        replica<i>/lifecycle (0 active / 1 warming / 2 draining /
+        3 released / 4 dead)."""
         def pct(xs, q):
             return float(np.percentile(np.asarray(xs), q) * 1e3) if xs \
                 else 0.0
@@ -797,6 +1334,7 @@ class ServingRouter:
             for k, v in s.metrics().items():
                 m[f"replica{i}/{k}"] = v
             m[f"replica{i}/health_state"] = STATE_CODE[self.health.state(i)]
+            m[f"replica{i}/lifecycle"] = LIFECYCLE_CODE[self.lifecycle(i)]
             ttft += s._ttft
             tpot += s._tpot
             if s._spec:
@@ -804,9 +1342,21 @@ class ServingRouter:
                 spec_accepted += s.spec_stats["accepted_tokens"]
                 spec_chunks += s.spec_stats["verified_chunks"]
                 spec_collapsed += s.spec_stats["draft_collapsed_steps"]
-        m["fleet/replicas"] = float(len(self.schedulers))
+        n = len(self.schedulers)
+        m["fleet/replicas"] = float(n)
+        # live = still serving in-flight work (active + draining);
+        # routable = may take NEW work; the lifecycle breakdown lets
+        # dashboards tell a shrinking fleet from a dying one
         m["fleet/live_replicas"] = float(
-            len(self.schedulers) - len(self.dead))
+            sum(1 for i in range(n) if self._serving(i)))
+        m["fleet/routable_replicas"] = float(
+            sum(1 for i in range(n) if self._routable(i)))
+        m["fleet/warming_replicas"] = float(len(self.warming))
+        m["fleet/draining_replicas"] = float(len(self.draining))
+        m["fleet/released_replicas"] = float(len(self.released))
+        m["fleet/replica_hours"] = self._replica_hours
+        m["fleet/drain_p50_ms"] = pct(self._drain_s, 50)
+        m["fleet/drain_p95_ms"] = pct(self._drain_s, 95)
         m["fleet/disaggregated"] = float(self.mode == "disaggregated")
         m["fleet/queue_depth"] = float(
             sum(len(s.waiting) for s in self.schedulers))
@@ -832,7 +1382,18 @@ class ServingRouter:
                 s.counters[key] for s in self.schedulers))
         m["fleet/max_pressure_level"] = float(max(
             (self._pressure(i) for i in range(len(self.schedulers))
-             if i not in self.dead), default=0))
+             if self._serving(i)), default=0))
+        # per-SLO-class degradation: sheds (router fair-shed victims)
+        # and deadline rejections broken out by class — the
+        # autoscaler's premium-impact signal
+        for cls, v in sorted(self.shed_by_class.items()):
+            m[f"fleet/shed_{cls}"] = float(v)
+        by_class: Dict[str, float] = {}
+        for s in self.schedulers:
+            for cls, v in s.slo_rejections.items():
+                by_class[cls] = by_class.get(cls, 0.0) + v
+        for cls, v in sorted(by_class.items()):
+            m[f"fleet/deadline_rejections_{cls}"] = v
         m["fleet/recompiles"] = float(sum(
             len(s.engine.recompile_tracker.findings)
             for s in self.schedulers))

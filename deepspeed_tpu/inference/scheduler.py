@@ -196,6 +196,10 @@ class ServingScheduler:
             "accepted_tokens": 0, "draft_collapsed_steps": 0,
             "mean_accepted": 0.0,
         }
+        # SLO-class breakdown of deadline rejections: the autoscaler's
+        # premium-impact signal (inference/autoscaler.py) needs to know
+        # WHOSE deadlines the fleet is failing, not just how many
+        self.slo_rejections: Dict[str, int] = {}
         self._ttft: List[float] = []
         self._tpot: List[float] = []
         # set by ServingRouter (fault-point ctx + health identity);
@@ -344,6 +348,9 @@ class ServingScheduler:
             req.finish_t = time.perf_counter()
             self.finished[rid] = req
             self.counters["deadline_rejections"] += 1
+            if slo_class is not None:
+                self.slo_rejections[slo_class] = \
+                    self.slo_rejections.get(slo_class, 0) + 1
             return rid
         if self.scfg.needs_presence:
             pres = np.zeros((self.engine.cfg.vocab_size,), np.uint8)
@@ -376,9 +383,12 @@ class ServingScheduler:
         self.waiting.append(req)
 
     def adopt(self, req: Request, payload: Dict[str, Any]) -> None:
-        """Admit a prefill-complete request whose KV arrives by block
-        transfer (engine.import_kv payload): the sequence starts
-        RUNNING here with its first token pending — no recompute.
+        """Admit a request whose KV arrives by block transfer
+        (engine.import_kv payload): a prefill-complete sequence starts
+        RUNNING here with its first token pending, a MID-PREFILL one
+        (a drain migration caught between chunks — the payload carries
+        only its written blocks, like a spill) re-reserves the rest of
+        its base and continues chunking — no recompute either way.
         Raises RuntimeError when the batch or the KV pool cannot take
         it (callers fall back to requeue())."""
         if len(self.active) >= self.engine.config.max_batch_size:
@@ -394,13 +404,27 @@ class ServingScheduler:
             if self.engine.state.get(uid) is not None:
                 self.engine.flush(uid)
             raise
+        seen = int(payload["seen_tokens"])
+        if req.output and seen == len(req.base) - 1:
+            req.pending = req.output[-1]
+            req.state = RUNNING
+        else:
+            # mid-prefill: chunked prefill continues at `fed` (the
+            # _resume_from_spill geometry — import laid down only the
+            # written blocks, so room for the remainder is re-reserved
+            # exactly as admission would have)
+            try:
+                self.engine.state.extend(uid, len(req.base) - seen)
+            except KVCacheExhaustedError:
+                self.engine.flush(uid)
+                raise
+            req.pending = None
+            req.state = PREFILL
         req.uid = uid
         req.rid = self._next_rid
         self._next_rid += 1
         req.handoff = False
-        req.fed = int(payload["seen_tokens"])
-        req.pending = req.output[-1]
-        req.state = RUNNING
+        req.fed = seen
         self.active.append(req)
         self.counters["adopted"] += 1
         self.counters["admitted"] += 1
@@ -1308,6 +1332,8 @@ class ServingScheduler:
             m.update(self.spill_store.stats())
         for k, v in self.counters.items():
             m[k] = float(v)
+        for cls, v in sorted(self.slo_rejections.items()):
+            m[f"deadline_rejections_{cls}"] = float(v)
         if self.counters["steps"]:
             m["batched_tokens_per_step"] = (
                 self.counters["batched_tokens"] / self.counters["steps"])

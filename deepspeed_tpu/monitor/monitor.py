@@ -163,7 +163,34 @@ def serving_events(scheduler, step: int,
     `fleet/deadline_rejections`, `fleet/starvation_protected`,
     `fleet/max_pressure_level`, plus the backpressure counters
     `fleet/handoff_backpressure`, `fleet/prefill_backpressure`, and
-    `fleet/brownout_shed_engaged`."""
+    `fleet/brownout_shed_engaged`.
+
+    Elastic-lifecycle feed (docs/autoscaling.md): `replica<i>` names
+    key on STABLE replica ids — router slots are append-only and a
+    released replica's slot is tombstoned, never compacted, so a name
+    keeps meaning the same replica across add/drain/release cycles.
+    Per replica: `replica<i>/lifecycle` (0 active / 1 warming /
+    2 draining / 3 released / 4 dead; released replicas keep
+    reporting their final counters — their TTFT/TPOT history stays in
+    the fleet percentiles). Fleet-level: the lifecycle breakdown
+    `fleet/live_replicas` (active + draining — still serving),
+    `fleet/routable_replicas`, `fleet/warming_replicas`,
+    `fleet/draining_replicas`, `fleet/released_replicas`;
+    `fleet/replica_hours` (the provisioned-time integral on the
+    router's injected clock — the cost number the autoscale gate
+    compares against static provisioning); `fleet/scale_ups`,
+    `fleet/scale_downs`, `fleet/spinup_joins`,
+    `fleet/burned_replicas` (spin-ups killed mid-scale-up),
+    `fleet/warm_prefix_imports` / `fleet/warm_joins_deferred`
+    (cache-warm boot outcomes), `fleet/rebalanced_on_join`,
+    `fleet/drain_p50_ms` / `fleet/drain_p95_ms` (drain start ->
+    release), `fleet/drain_migrations` (sequences moved by page
+    transfer — zero recompute) vs `fleet/drain_recomputes` (the
+    token-identical fallback), and `fleet/affinity_drain_breaks`
+    (session pins broken by a drain, re-pinned at next submit).
+    Per-SLO-class degradation: `fleet/shed_<class>` and
+    `fleet/deadline_rejections_<class>` — the autoscaler's
+    premium-impact signal."""
     metrics = scheduler.metrics()
     return [(f"{prefix}/{name}", float(value), step)
             for name, value in sorted(metrics.items())]

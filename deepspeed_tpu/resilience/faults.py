@@ -109,6 +109,21 @@ Fault points registered across the tree (ctx keys in parens):
                                   K/V page stacks in transit; digest
                                   verification discards the payload
                                   and the router recomputes
+  replica.spinup      (replica,   replica spin-up (inference/router.py
+                       phase)     add_replica; phase 'build' fires
+                                  before scheduler construction,
+                                  'join' after warmup + warm boot,
+                                  just before registration) — raise =
+                                  the replica died mid-scale-up: the
+                                  attempt is BURNED (counter, no id
+                                  consumed) and the autoscaler
+                                  (inference/autoscaler.py) retries
+                                  with exponential backoff
+  replica.drain       (replica)   graceful drain entry
+                                  (inference/router.py drain_replica,
+                                  BEFORE any state mutates) — raise =
+                                  the drain rejected at entry; the
+                                  replica keeps serving untouched
 
 kind='corrupt' payloads: `corrupt_file` flips raw bytes of a file on
 disk (checkpoint bitrot); the three in-memory points above flip bits

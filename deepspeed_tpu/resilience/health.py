@@ -147,6 +147,14 @@ class FleetHealth:
             ReplicaBreaker(cfg) for _ in range(n)]
         self.transitions: List[str] = []   # "<i>:<event>" audit trail
 
+    def add_replica(self) -> int:
+        """Grow the fleet by one breaker (replica spin-up,
+        inference/router.py add_replica). Slots are append-only —
+        breaker ids track the router's stable replica ids, so a
+        released replica's slot is never reused. Returns the new id."""
+        self.breakers.append(ReplicaBreaker(self.cfg))
+        return len(self.breakers) - 1
+
     def observe(self, i: int, ok: bool, duration_s: float,
                 now: float) -> Optional[str]:
         ev = self.breakers[i].observe(ok, duration_s, now)

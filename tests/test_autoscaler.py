@@ -1,0 +1,588 @@
+"""Elastic-autoscaling tests (docs/autoscaling.md): the Autoscaler
+policy loop on a fake fleet under a virtual clock (hysteresis,
+asymmetric cooldowns, premium bypass, burned-spin-up retry backoff),
+the router's replica lifecycle (cache-warm spin-up with donor-RED
+deferral, two-phase join + queue rebalance, graceful drain with
+page-move migration, typed last-replica rejection, stable metric
+ids across add/drain/release), the parked-prefix-chain export
+substrate, and the replica.spinup/replica.drain chaos points.
+
+Fast lane: the policy-loop suite (FakeFleet, pure host arithmetic)
+plus the cheap lifecycle edges. The engine-backed lifecycle lanes
+(tiny model, f32, CPU, warmup off — but every test builds 2-5 fresh
+engines whose decode programs compile) are slow-marked: the fast
+tier-1 lane was already at its timeout budget, and the ds_autoscale
+pre-test gate exercises the same spin-up/drain/chaos machinery
+end-to-end deterministically."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.config.config import AutoscalerConfig
+from deepspeed_tpu.inference import (
+    Autoscaler,
+    ReplicaDrainError,
+    RouterFleetAdapter,
+    ServingRouter,
+    ServingScheduler,
+    ServingSchedulerConfig,
+    init_inference,
+)
+from deepspeed_tpu.inference.engine import HandoffIntegrityError
+from deepspeed_tpu.models import transformer as T
+from deepspeed_tpu.resilience import FaultPlan, armed
+from deepspeed_tpu.resilience.faults import InjectedFault
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = T.TransformerConfig(
+        vocab_size=128, n_layers=2, n_heads=4, d_model=64, max_seq=64,
+        variant="llama", use_flash=False)
+    params = T.init(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)
+
+
+def engine_for(model, **over):
+    cfg, params = model
+    kw = dict(max_seq_len=64, kv_block_size=8, num_kv_blocks=32,
+              min_prefill_bucket=8, max_batch_size=8)
+    kw.update(over)
+    return init_inference(params, cfg, kw, dtype=jnp.float32)
+
+
+NO_WARM = {"scheduler": {"warmup": False}}
+
+
+def router_for(model, n, seed=0, clock=None, **cfg):
+    c = dict(NO_WARM)
+    c.update(cfg)
+    c["replicas"] = n
+    return ServingRouter([engine_for(model) for _ in range(n)], c,
+                         seed=seed, clock=clock)
+
+
+def reference_outputs(model, prompts, max_new, seed=0):
+    sched = ServingScheduler(
+        engine_for(model), ServingSchedulerConfig(warmup=False),
+        seed=seed)
+    rids = [sched.submit(p, max_new, stream=i)
+            for i, p in enumerate(prompts)]
+    sched.run()
+    return [sched.finished[r].output for r in rids]
+
+
+# -- the policy loop on a fake fleet -----------------------------------
+class FakeFleet:
+    """Scripted fleet: the policy loop's decisions are observed, its
+    scale calls mutate only counters."""
+
+    def __init__(self, n=2, fail_spinups=0):
+        self.n = n
+        self.sig = {"queue_depth": 0.0, "max_pressure_level": 0.0,
+                    "shed_requests": 0.0, "deadline_rejections": 0.0,
+                    "premium_sheds": 0.0, "premium_rejections": 0.0}
+        self.ups = []
+        self.downs = []
+        self.fail_spinups = fail_spinups
+
+    def live_replicas(self):
+        return self.n
+
+    def signals(self):
+        return dict(self.sig)
+
+    def scale_up(self, now):
+        if self.fail_spinups > 0:
+            self.fail_spinups -= 1
+            raise InjectedFault("spin-up burned")
+        self.n += 1
+        self.ups.append(now)
+
+    def scale_down(self, now):
+        if self.n <= 1:
+            return False
+        self.n -= 1
+        self.downs.append(now)
+        return True
+
+
+ACFG = dict(enabled=True, min_replicas=1, max_replicas=4,
+            evaluation_interval_s=1.0, scale_up_pressure=2,
+            scale_up_queue_per_replica=4.0,
+            scale_down_queue_per_replica=1.0,
+            up_hysteresis=2, down_hysteresis=3,
+            scale_up_cooldown_s=5.0, scale_down_cooldown_s=10.0,
+            spinup_retry_backoff_s=1.0, spinup_max_retries=2,
+            premium_classes=["premium"])
+
+
+class TestAutoscalerPolicy:
+    def test_scale_up_needs_hysteresis(self):
+        fleet = FakeFleet(2)
+        asc = Autoscaler(fleet, ACFG, clock=lambda: 0.0)
+        fleet.sig["max_pressure_level"] = 2.0
+        assert asc.tick(now=0.0) is None       # vote 1 of 2
+        assert asc.tick(now=1.0) == "scale_up"  # vote 2 fires
+        assert fleet.ups == [1.0]
+
+    def test_noise_resets_votes(self):
+        fleet = FakeFleet(2)
+        asc = Autoscaler(fleet, ACFG, clock=lambda: 0.0)
+        fleet.sig["max_pressure_level"] = 2.0
+        asc.tick(now=0.0)
+        fleet.sig["max_pressure_level"] = 0.0   # blip clears
+        asc.tick(now=1.0)
+        fleet.sig["max_pressure_level"] = 2.0
+        assert asc.tick(now=2.0) is None        # votes restarted
+        assert asc.tick(now=3.0) == "scale_up"
+
+    def test_premium_impact_bypasses_hysteresis(self):
+        fleet = FakeFleet(2)
+        asc = Autoscaler(fleet, ACFG, clock=lambda: 0.0)
+        asc.tick(now=0.0)                       # baseline deltas
+        fleet.sig["premium_sheds"] = 1.0
+        fleet.sig["shed_requests"] = 1.0
+        assert asc.tick(now=1.0) == "scale_up"  # ONE eval, no wait
+        assert asc.counters["premium_bypass"] == 1
+
+    def test_cooldown_holds_second_scale_up(self):
+        fleet = FakeFleet(2)
+        asc = Autoscaler(fleet, ACFG, clock=lambda: 0.0)
+        fleet.sig["max_pressure_level"] = 2.0
+        asc.tick(now=0.0)
+        assert asc.tick(now=1.0) == "scale_up"
+        asc.tick(now=2.0)
+        assert asc.tick(now=3.0) is None        # inside 5 s cooldown
+        assert asc.counters["cooldown_holds"] >= 1
+        # votes kept accruing through the hold: the first eval past
+        # the cooldown window acts
+        assert asc.tick(now=6.5) == "scale_up"
+
+    def test_scale_down_needs_long_calm_and_respects_min(self):
+        fleet = FakeFleet(2)
+        asc = Autoscaler(fleet, ACFG, clock=lambda: 0.0)
+        for t in (0.0, 1.0):
+            assert asc.tick(now=t) is None      # calm votes 1, 2
+        assert asc.tick(now=2.0) == "scale_down"  # vote 3 fires
+        assert fleet.n == 1
+        # at min_replicas the fleet never shrinks further
+        for t in (20.0, 21.0, 22.0, 23.0):
+            assert asc.tick(now=t) is None
+        assert fleet.n == 1
+
+    def test_max_replicas_denies_scale_up(self):
+        fleet = FakeFleet(4)
+        asc = Autoscaler(fleet, ACFG, clock=lambda: 0.0)
+        fleet.sig["max_pressure_level"] = 2.0
+        asc.tick(now=0.0)
+        assert asc.tick(now=1.0) is None
+        assert asc.counters["scale_up_denied"] == 1
+        assert fleet.n == 4
+
+    def test_burned_spinup_retries_with_exponential_backoff(self):
+        fleet = FakeFleet(2, fail_spinups=2)
+        asc = Autoscaler(fleet, ACFG, clock=lambda: 0.0)
+        fleet.sig["max_pressure_level"] = 2.0
+        asc.tick(now=0.0)
+        assert asc.tick(now=1.0) == "spinup_failed"   # burn 1
+        assert asc.tick(now=1.5) is None              # backoff 1.0 s
+        assert asc.tick(now=2.0) == "spinup_failed"   # retry burns
+        # backoff doubled to 2.0 s; the eval path must NOT race past
+        # the pending retry's backoff window
+        assert asc.tick(now=3.0) is None
+        assert asc.tick(now=4.0) == "scale_up"        # retry succeeds
+        assert asc.counters["spinup_failures"] == 2
+        assert asc.counters["spinup_retries"] == 2
+        assert fleet.ups == [4.0]
+
+    def test_retry_exhaustion_rearms_on_signal(self):
+        fleet = FakeFleet(2, fail_spinups=3)
+        asc = Autoscaler(fleet, ACFG, clock=lambda: 0.0)
+        fleet.sig["max_pressure_level"] = 2.0
+        asc.tick(now=0.0)
+        asc.tick(now=1.0)           # burn 1, schedules retry
+        asc.tick(now=2.0)           # retry burn 2
+        asc.tick(now=4.0)           # retry burn 3 -> abandoned
+        assert asc._retry_at is None
+        # the NEXT evaluation window can still decide to scale up
+        # (votes held through the burned attempts)
+        assert asc.tick(now=5.0) == "scale_up"
+
+    def test_disabled_autoscaler_never_acts(self):
+        fleet = FakeFleet(1)
+        asc = Autoscaler(fleet, dict(ACFG, enabled=False),
+                         clock=lambda: 0.0)
+        fleet.sig["max_pressure_level"] = 3.0
+        for t in range(5):
+            assert asc.tick(now=float(t)) is None
+        assert fleet.n == 1
+
+    def test_config_dead_band_validated(self):
+        with pytest.raises(ValueError):
+            AutoscalerConfig(scale_up_queue_per_replica=1.0,
+                             scale_down_queue_per_replica=2.0)
+        with pytest.raises(ValueError):
+            AutoscalerConfig(min_replicas=4, max_replicas=2)
+
+
+# -- parked-chain export substrate -------------------------------------
+class TestParkedChainExport:
+    def test_parked_chains_enumerate_flushed_prefixes(self, model, rng):
+        eng = engine_for(model)
+        prefix = list(rng.integers(0, 128, 16))  # 2 full blocks
+        eng.generate([prefix + [1, 2]], max_new_tokens=2)
+        chains = eng.state.parked_chains(8)
+        assert len(chains) == 1
+        tokens, blocks = chains[0]
+        assert tokens == prefix and len(blocks) == 2
+
+    @pytest.mark.slow
+    def test_export_import_registers_prefix_on_joiner(self, model, rng):
+        donor = engine_for(model)
+        prefix = list(rng.integers(0, 128, 16))
+        donor.generate([prefix + [1, 2]], max_new_tokens=2)
+        payloads = donor.export_parked_kv(8)
+        assert len(payloads) == 1 and "digest" in payloads[0]
+        joiner = engine_for(model)
+        joiner.import_kv(0, payloads[0])
+        joiner.flush(0)
+        assert joiner.state.lookup_prefix(prefix + [7, 7]) == 16
+        # warm pages serve token-identically to a cold engine
+        probe = prefix + list(rng.integers(0, 128, 4))
+        cold = engine_for(model).generate([probe], max_new_tokens=4)
+        assert joiner.generate([probe], max_new_tokens=4) == cold
+
+    @pytest.mark.slow
+    def test_tampered_warm_payload_rejected(self, model, rng):
+        donor = engine_for(model)
+        prefix = list(rng.integers(0, 128, 16))
+        donor.generate([prefix + [1, 2]], max_new_tokens=2)
+        payload = donor.export_parked_kv(1)[0]
+        payload["k"] = payload["k"].copy()
+        payload["k"].reshape(-1)[3] += 1
+        joiner = engine_for(model)
+        with pytest.raises(HandoffIntegrityError):
+            joiner.import_kv(0, payload)
+
+
+# -- replica lifecycle on the real router ------------------------------
+class TestSpinUp:
+    @pytest.mark.slow
+    def test_add_replica_warm_boots_and_serves(self, model, rng):
+        router = router_for(model, 1)
+        prefix = list(rng.integers(0, 128, 16))
+        prompts = [prefix + list(rng.integers(0, 128, 4))
+                   for _ in range(3)]
+        for p in prompts[:2]:
+            router.submit(p, 4)
+        router.serve()
+        rid = router.add_replica(engine_for(model))
+        assert rid == 1
+        assert router.counters["scale_ups"] == 1
+        assert router.counters["warm_prefix_imports"] >= 1
+        # the joiner's index already holds the donor's prefix
+        assert router.schedulers[1].engine.state.lookup_prefix(
+            prefix + [5, 5]) == 16
+        g = router.submit(prompts[2], 4)
+        router.serve()
+        ref = reference_outputs(model, prompts, 4)
+        assert router.result(g).output == ref[2]
+
+    def test_two_phase_join_skips_warming_replica(self, model, rng):
+        router = router_for(model, 1)
+        rid = router.add_replica(engine_for(model), join=False)
+        assert router.lifecycle(rid) == "warming"
+        # routing never picks a warming replica
+        for _ in range(4):
+            g = router.submit(list(rng.integers(0, 128, 8)), 2)
+            assert router._where[g] == 0
+        router.join_replica(rid)
+        assert router.lifecycle(rid) == "active"
+
+    @pytest.mark.slow
+    def test_join_rebalances_waiting_backlog(self, model, rng):
+        router = router_for(model, 1)
+        for _ in range(10):
+            router.submit(list(rng.integers(0, 128, 8)), 2)
+        rid = router.add_replica(engine_for(model), join=False)
+        assert len(router.schedulers[rid].waiting) == 0
+        router.join_replica(rid)
+        assert router.counters["rebalanced_on_join"] >= 4
+        assert len(router.schedulers[rid].waiting) >= 4
+        router.serve()
+        assert all(r.done for r in router._reqs.values())
+
+    @pytest.mark.slow
+    def test_warm_boot_defers_when_donor_at_red(self, model, rng):
+        # a governor'd donor whose pool sits above the RED watermark:
+        # the join must go cache-cold and touch NOTHING on the donor
+        router = router_for(model, 1, scheduler={
+            "warmup": False,
+            "pressure": {"enabled": True, "yellow": 0.2, "red": 0.3,
+                         "brownout": 0.99}})
+        prefix = list(rng.integers(0, 128, 16))
+        router.submit(prefix + [1, 2], 2)
+        router.serve()  # parks the prefix chain
+        # pin live occupancy above RED with long prompts mid-flight
+        gids = [router.submit(list(rng.integers(0, 128, 40)), 24)
+                for _ in range(6)]
+        for _ in range(3):
+            router.step()
+        router.schedulers[0].governor.update()
+        assert router._pressure(0) >= 2
+        evict0 = router.schedulers[0].engine.state.cache_stats()[
+            "evictions"]
+        rid = router.add_replica(engine_for(model))
+        assert router.counters["warm_joins_deferred"] == 1
+        assert router.counters["warm_prefix_imports"] == 0
+        assert router.schedulers[rid].engine.state.indexed_blocks == 0
+        assert router.schedulers[0].engine.state.cache_stats()[
+            "evictions"] == evict0  # no eviction storm on the donor
+        router.serve()
+        assert all(router.result(g).done for g in gids)
+
+    @pytest.mark.slow
+    def test_spinup_chaos_burns_replica_and_autoscaler_retries(
+            self, model, rng):
+        t = [0.0]
+        router = router_for(model, 1, clock=lambda: t[0])
+        adapter = RouterFleetAdapter(router, lambda: engine_for(model))
+        asc = Autoscaler(adapter, dict(ACFG, up_hysteresis=1),
+                         clock=lambda: t[0])
+        plan = FaultPlan([{"point": "replica.spinup", "kind": "raise",
+                           "error": "io", "where": {"phase": "join"},
+                           "at": 1, "times": 1}])
+        with armed(plan):
+            for _ in range(12):
+                router.submit(list(rng.integers(0, 128, 8)), 2)
+            # up_hysteresis=1: the first eval sees the queue and acts;
+            # the armed plan kills the spin-up at its join phase
+            assert asc.tick(now=0.0) == "spinup_failed"
+            assert router.counters["burned_replicas"] == 1
+            assert len(router.schedulers) == 1    # nothing registered
+            t[0] = 1.0
+            assert asc.tick(now=1.0) == "scale_up"  # backoff retry
+        assert len(router.schedulers) == 2
+        assert router.lifecycle(1) == "active"
+        router.serve()
+        assert all(r.done for r in router._reqs.values())
+
+
+class TestDrain:
+    @pytest.mark.slow
+    def test_drain_migrates_running_sequences_token_identically(
+            self, model, rng):
+        prompts = [list(rng.integers(0, 128, 8)) for _ in range(6)]
+        ref = reference_outputs(model, prompts, 12)
+        router = router_for(model, 2, policy="round_robin")
+        gids = [router.submit(p, 12) for p in prompts]
+        for _ in range(3):
+            router.step()  # mid-decode on both replicas
+        victim = 1
+        assert any(r.state == "running"
+                   for r in router.schedulers[victim].active) or \
+            router.schedulers[victim].active
+        router.drain_replica(victim)
+        assert router.counters["drain_migrations"] >= 1
+        router.serve()
+        assert router.lifecycle(victim) == "released"
+        assert [router.result(g).output for g in gids] == ref
+        m = router.metrics()
+        assert m["fleet/scale_downs"] == 1.0
+        assert m["fleet/drain_p95_ms"] >= 0.0
+
+    @pytest.mark.slow
+    def test_drain_breaks_and_repins_sessions(self, model, rng):
+        router = router_for(model, 2)
+        p = list(rng.integers(0, 128, 8))
+        g = router.submit(p, 2, session="s")
+        pinned = router._where[g]
+        router.serve()
+        router.drain_replica(pinned)
+        assert router.counters["affinity_drain_breaks"] == 1
+        assert "s" not in router._sessions
+        g2 = router.submit(p + [1], 2, session="s")
+        other = router._where[g2]
+        assert other != pinned
+        assert router._sessions["s"] == other  # re-scored + re-pinned
+        router.serve()
+        assert router.result(g2).done
+
+    def test_drain_last_decode_replica_rejected_typed(self, model):
+        router = router_for(model, 1)
+        with pytest.raises(ReplicaDrainError):
+            router.drain_replica(0)
+        # two replicas, one already draining: the second is now last
+        router = router_for(model, 2)
+        router.drain_replica(1)
+        with pytest.raises(ReplicaDrainError):
+            router.drain_replica(0)
+
+    @pytest.mark.slow
+    def test_drain_with_in_flight_handoff_payload(self, model, rng):
+        """A draining prefill replica's parked handoff payloads are
+        finished work: pump() must move them to decode replicas (never
+        INTO the draining one) and the drain completes with zero token
+        change."""
+        prompts = [list(rng.integers(0, 128, 8)) for _ in range(3)]
+        ref = reference_outputs(model, prompts, 8)
+        router = router_for(model, 3, mode="disaggregated",
+                            prefill_replicas=2)
+        gids = [router.submit(p, 8) for p in prompts]
+        # prefill until at least one handoff parks, WITHOUT pumping
+        for _ in range(12):
+            if any(s.handoff_ready for s in router.schedulers):
+                break
+            for i in range(3):
+                router.schedulers[i].step()
+        assert any(s.handoff_ready
+                   for i, s in enumerate(router.schedulers)
+                   if i in router.prefill_idx)
+        victim = next(i for i in router.prefill_idx
+                      if router.schedulers[i].handoff_ready)
+        router.drain_replica(victim)
+        assert router.lifecycle(victim) == "draining"
+        router.serve()  # pump drains the payload out, drain completes
+        assert router.lifecycle(victim) == "released"
+        assert victim not in router.prefill_idx
+        assert [router.result(g).output for g in gids] == ref
+
+    @pytest.mark.slow
+    def test_draining_replica_invisible_to_routing_and_pump(
+            self, model, rng):
+        router = router_for(model, 3, policy="round_robin")
+        gids = [router.submit(list(rng.integers(0, 128, 8)), 20)
+                for _ in range(3)]
+        for _ in range(2):
+            router.step()
+        router.drain_replica(2)
+        for _ in range(6):
+            g = router.submit(list(rng.integers(0, 128, 8)), 2)
+            assert router._where[g] != 2
+        assert not router._decode_can_take() or all(
+            i != 2 for i in router.decode_idx if router._routable(i))
+        router.serve()
+        assert all(r.done for r in router._reqs.values())
+
+    @pytest.mark.slow
+    def test_released_slot_is_tombstoned(self, model, rng):
+        router = router_for(model, 2)
+        g = router.submit(list(rng.integers(0, 128, 8)), 2)
+        router.serve()
+        router.drain_replica(1)
+        router.serve()
+        assert router.lifecycle(1) == "released"
+        assert router.fail_replica(1) == 0
+        with pytest.raises(ValueError):
+            router.restore_replica(1)
+        with pytest.raises(ValueError):
+            router.drain_replica(1)
+        # a new replica gets a FRESH id — released ids are never reused
+        rid = router.add_replica(engine_for(model))
+        assert rid == 2
+        assert router.result(g).done
+
+    @pytest.mark.slow
+    def test_drain_fault_point_fires(self, model, rng):
+        router = router_for(model, 2)
+        plan = FaultPlan([{"point": "replica.drain", "kind": "raise",
+                           "error": "io", "at": 1, "times": 1}])
+        with armed(plan):
+            with pytest.raises(InjectedFault):
+                router.drain_replica(1)
+        # nothing mutated: the replica still serves
+        assert router.lifecycle(1) == "active"
+        g = router.submit(list(rng.integers(0, 128, 8)), 2)
+        router.serve()
+        assert router.result(g).done
+
+
+class TestObservability:
+    @pytest.mark.slow
+    def test_metric_ids_stable_across_add_and_release(self, model, rng):
+        t = [0.0]
+        router = router_for(model, 2, clock=lambda: t[0])
+        router.observe_time(0.0)
+        g = router.submit(list(rng.integers(0, 128, 8)), 4)
+        router.serve()
+        before = router.metrics()
+        assert before["replica1/lifecycle"] == 0.0
+        t[0] = 3600.0
+        rid = router.add_replica(engine_for(model), now=3600.0)
+        t[0] = 7200.0
+        router.drain_replica(1, now=7200.0)
+        router.serve()
+        m = router.metrics()
+        # stable ids: replica1's name still means the SAME replica
+        assert m["replica1/lifecycle"] == 3.0          # released
+        assert m[f"replica{rid}/lifecycle"] == 0.0     # the newcomer
+        assert m["fleet/replicas"] == 3.0
+        assert m["fleet/live_replicas"] == 2.0
+        assert m["fleet/released_replicas"] == 1.0
+        assert m["fleet/scale_ups"] == 1.0
+        assert m["fleet/scale_downs"] == 1.0
+        # replica-hours integrated on the injected clock: 2 replicas
+        # for the first hour, 3 for the second
+        assert m["fleet/replica_hours"] == pytest.approx(5.0)
+        # released replicas keep their final counters addressable
+        assert f"replica1/steps" in m
+
+    @pytest.mark.slow
+    def test_monitor_events_include_lifecycle_keys(self, model, rng):
+        from deepspeed_tpu.monitor.monitor import serving_events
+
+        router = router_for(model, 2)
+        router.submit(list(rng.integers(0, 128, 8)), 2)
+        router.serve()
+        names = {n for n, _, _ in serving_events(router, step=1)}
+        for key in ("fleet/replica_hours", "fleet/scale_ups",
+                    "fleet/scale_downs", "fleet/drain_p95_ms",
+                    "fleet/warming_replicas",
+                    "fleet/draining_replicas"):
+            assert f"inference/serving/{key}" in names
+        assert "inference/serving/replica0/lifecycle" in names
+
+    def test_shed_by_class_counts_premium(self, model, rng):
+        router = router_for(model, 1, max_fleet_queue=2,
+                            scheduler={"warmup": False,
+                                       "slo_classes": {"premium": 60.0}})
+        router.submit(list(rng.integers(0, 128, 8)), 2,
+                      session="a", slo_class="premium")
+        router.submit(list(rng.integers(0, 128, 8)), 2, session="a",
+                      slo_class="premium")
+        with pytest.raises(Exception):
+            router.submit(list(rng.integers(0, 128, 8)), 2,
+                          session="a", slo_class="premium")
+        assert router.shed_by_class.get("premium", 0) >= 1
+        assert router.metrics()["fleet/shed_premium"] >= 1.0
+
+
+class TestAdapter:
+    @pytest.mark.slow
+    def test_adapter_signals_and_scale_paths(self, model, rng):
+        router = router_for(model, 2)
+        adapter = RouterFleetAdapter(router, lambda: engine_for(model),
+                                     premium_classes=("premium",))
+        for _ in range(4):
+            router.submit(list(rng.integers(0, 128, 8)), 2)
+        sig = adapter.signals()
+        assert sig["queue_depth"] == 4.0
+        assert adapter.live_replicas() == 2
+        rid = adapter.scale_up(now=0.0)
+        assert adapter.live_replicas() == 3
+        assert adapter.scale_down(now=1.0)
+        router.serve()
+        assert router.counters["scale_downs"] == 1
+        # the drained victim was the youngest idle replica, never the
+        # last one: two more downs hit the floor
+        assert adapter.scale_down(now=2.0)
+        router.serve()
+        assert not adapter.scale_down(now=3.0)

@@ -73,6 +73,18 @@ recovery needs no disk, and a rerun is byte-identical.
 scripts/ds_sdc.py gates this in CI (docs/fault_tolerance.md SDC
 section).
 
+`python bench.py --moe-sim [plan]` (plan = 'default' = MOE.json)
+runs the DROPLESS-MoE lane (docs/moe.md): dropless vs capacity-factor
+routing trained on identical seeds/batches on the virtual 8-device
+mesh (zero3+EP+TP), plus dropless MoE decode through the
+ServingScheduler. Exit is non-zero unless dropless routes every
+assignment (zero drops, pinned), the capacity reference measurably
+drops on the skew workload, dropless trains at least as well, EP=1 ==
+EP=N training math and serving decode tokens, steady-state serving
+compiles nothing after warmup, the expert-utilization census reaches
+scheduler.metrics(), and a rerun is byte-identical.
+scripts/ds_moe.py gates this in CI.
+
 `python bench.py --autoscale-sim [plan]` (plan = 'default' =
 AUTOSCALE.json, or a path) runs the ELASTIC-AUTOSCALING lane
 (docs/autoscaling.md), two tiers sharing ONE Autoscaler policy code
@@ -1797,6 +1809,239 @@ def _overload_sim(plan_arg: str, capture=None):
     return 0 if all(gates.values()) else 1
 
 
+def _default_moe_plan() -> dict:
+    """The CI MoE plan (scripts/ds_moe.py gates on it; the committed
+    MOE.json carries this dict plus the expected quality/routing
+    ledger). Two halves: (a) TRAINING — dropless vs capacity-factor
+    routing trained on identical seeds/batches on the virtual 8-dev
+    mesh (zero3+EP+TP), pinning zero dropped tokens for dropless, a
+    skew workload where the capacity path measurably drops, loss
+    parity-or-better for dropless, and EP=1 == EP=N layout invariance;
+    (b) SERVING — dropless MoE decode through the ServingScheduler
+    (per-expert token batching in one compiled program), pinning
+    EP-layout token identity, zero recompiles after warmup, and the
+    expert-census counters."""
+    return {
+        "name": "moe-default",
+        "seed": 0,
+        "workload": {
+            # model: 4 experts, top-2 gating, gated (SwiGLU) experts
+            "vocab": 128, "n_layers": 2, "d_model": 64, "n_heads": 4,
+            "n_experts": 4, "top_k": 2,
+            # training: 8 steps on 3 cycling fixed batches, batch 16
+            "train_steps": 8, "train_batch": 16, "seq": 32,
+            # the capacity reference drops hard: factor 0.25 keeps only
+            # ~1/4 of the per-expert queue on the skewed distribution
+            "capacity_factor": 0.25, "min_capacity": 1,
+            "z_loss_coef": 1e-3,
+            # serving: 10 shared-suffix-free prompts, greedy decode
+            "serve_requests": 10, "prompt_tokens": [6, 20],
+            "max_new_tokens": 8,
+        },
+    }
+
+
+def _moe_sim(plan_arg: str = "default", capture=None):
+    """Dropless-MoE gate (scripts/ds_moe.py; docs/moe.md): dropless vs
+    capacity-factor training ledger + EP layout invariance + dropless
+    serving decode through the scheduler, all deterministic on the
+    virtual 8-device CPU mesh. With `capture`, writes the committed
+    MOE.json (plan + measured ledger)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.inference import ServingScheduler, init_inference
+    from deepspeed_tpu.models import transformer as T
+    from deepspeed_tpu.moe import dropless_topk_gating, topk_gating
+    from deepspeed_tpu.platform.mesh import build_mesh
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    committed = os.path.join(root, "MOE.json")
+    expect = None
+    if plan_arg == "default":
+        if os.path.exists(committed) and capture is None:
+            raw = json.load(open(committed))
+            expect = raw.get("expect")
+        else:
+            raw = _default_moe_plan()
+    else:
+        raw = json.load(open(plan_arg))
+        expect = raw.get("expect")
+    wk = {**_default_moe_plan()["workload"], **raw.get("workload", {})}
+    seed = int(raw.get("seed", 0))
+
+    V, S = int(wk["vocab"]), int(wk["seq"])
+    X, K = int(wk["n_experts"]), int(wk["top_k"])
+
+    def model_cfg(**kw):
+        base = dict(
+            vocab_size=V, n_layers=int(wk["n_layers"]),
+            n_heads=int(wk["n_heads"]), d_model=int(wk["d_model"]),
+            max_seq=S, variant="llama", use_flash=False, n_experts=X,
+            moe_top_k=K)
+        base.update(kw)
+        return T.TransformerConfig(**base)
+
+    def build_engine(mcfg, mesh):
+        return ds.initialize(
+            {"train_micro_batch_size_per_gpu": 2,
+             "train_batch_size": int(wk["train_batch"]),
+             "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+             "seed": seed, "steps_per_print": 10**9, "mesh": mesh},
+            loss_fn=T.make_loss_fn(mcfg),
+            param_init_fn=lambda k: T.init(mcfg, k),
+            param_logical_specs=T.logical_specs(mcfg))
+
+    rng = np.random.default_rng(seed)
+    batches = [{"tokens": rng.integers(
+        0, V, (int(wk["train_batch"]), S + 1)).astype(np.int32)}
+        for _ in range(3)]
+    steps = int(wk["train_steps"])
+
+    def train(mcfg, mesh):
+        eng = build_engine(mcfg, mesh)
+        losses = [float(eng.train_batch(batches[i % 3])["loss"])
+                  for i in range(steps)]
+        cost = eng.sanitize(batches[0]).cost
+        step_us = (round(cost.step_time_s * 1e6, 3)
+                   if cost is not None and cost.step_time_s else 0.0)
+        return losses, step_us
+
+    drop_cfg = model_cfg(moe_dropless=True,
+                         moe_z_loss_coef=float(wk["z_loss_coef"]))
+    cap_cfg = model_cfg(
+        moe_capacity_factor=float(wk["capacity_factor"]),
+        moe_min_capacity=int(wk["min_capacity"]))
+
+    ep_mesh = {"data": 4, "expert": 2}
+    drop_losses, drop_step_us = train(drop_cfg, ep_mesh)
+    cap_losses, cap_step_us = train(cap_cfg, ep_mesh)
+    # EP layout invariance: the same dropless model on a pure-DP mesh
+    ep1_losses, _ = train(drop_cfg, {"data": -1})
+
+    # routing census on a SKEWED synthetic distribution: the capacity
+    # path drops, dropless never does (counts sum == T*K exactly)
+    g = np.random.default_rng(seed)
+    skew = jnp.asarray(
+        g.normal(size=(S * 8, X)) + np.array([3.0] + [0.0] * (X - 1)),
+        jnp.float32)
+    _, disp, _ = topk_gating(
+        skew, K, capacity_factor=float(wk["capacity_factor"]),
+        min_capacity=int(wk["min_capacity"]))
+    cap_kept = int(jnp.sum(disp))
+    idx, _, _, _ = dropless_topk_gating(skew, K)
+    from deepspeed_tpu.moe import expert_counts
+    drop_routed = int(expert_counts(idx, X).sum())
+    total_assign = skew.shape[0] * K
+
+    # -- serving: dropless decode through the scheduler -----------------
+    params = T.init(drop_cfg, jax.random.PRNGKey(seed))
+    icfg = dict(max_seq_len=64, kv_block_size=8, num_kv_blocks=64,
+                min_prefill_bucket=8, max_batch_size=4, moe_census=True)
+    prompts = [list(g.integers(0, V, int(g.integers(
+        int(wk["prompt_tokens"][0]), int(wk["prompt_tokens"][1])))))
+        for _ in range(int(wk["serve_requests"]))]
+    max_new = int(wk["max_new_tokens"])
+
+    def serve():
+        eng = init_inference(params, drop_cfg, dict(icfg),
+                             dtype=jnp.float32)
+        sched = ServingScheduler(
+            eng, {"max_num_batched_tokens": 32, "prefill_chunk": 8},
+            seed=seed)
+        rids = [sched.submit(list(p), max_new, stream=i)
+                for i, p in enumerate(prompts)]
+        sched.run()
+        outs = [list(sched.finished[r].output) for r in rids]
+        m = sched.metrics()
+        return outs, m, eng
+
+    outs, metrics, eng = serve()
+    # EP serving: the same weights sharded over an 'expert' mesh
+    ep_eng = init_inference(
+        params, drop_cfg, dict(icfg, moe_census=False),
+        dtype=jnp.float32,
+        mesh=build_mesh({"expert": 2}, devices=jax.devices()[:2]))
+    # generate() returns the completions — directly comparable to the
+    # scheduler's per-request outputs
+    ep_outs = [[int(t) for t in o] for o in ep_eng.generate(
+        [np.asarray(p, np.int32) for p in prompts],
+        max_new_tokens=max_new)]
+
+    rerun_outs, rerun_metrics, _ = serve()
+
+    led = {
+        "dropless_final_loss": round(drop_losses[-1], 6),
+        "capacity_final_loss": round(cap_losses[-1], 6),
+        "ep1_final_loss": round(ep1_losses[-1], 6),
+        "dropless_step_us": drop_step_us,
+        "capacity_step_us": cap_step_us,
+        "capacity_kept_assignments": cap_kept,
+        "dropless_routed_assignments": drop_routed,
+        "total_assignments": total_assign,
+        "census_tokens": int(metrics.get("moe_census_tokens", 0)),
+        "moe_imbalance": round(float(metrics.get("moe_imbalance", 0)), 4),
+        "served_tokens": sum(len(o) for o in outs),
+    }
+
+    gates = {
+        # dropless never drops: every assignment routed, none lost
+        "dropless_zero_drops": drop_routed == total_assign,
+        # the capacity reference measurably drops on the skew workload
+        "capacity_path_drops_on_skew": cap_kept < total_assign,
+        # no token ever dropped -> at least loss parity on skewed data
+        "dropless_quality_no_worse": (
+            drop_losses[-1] <= cap_losses[-1] + 1e-3),
+        # EP=1 == EP=N training math (layout invariance)
+        "ep_layout_training_invariant": all(
+            abs(a - b) <= 1e-6 * max(abs(a), 1.0)
+            for a, b in zip(drop_losses, ep1_losses)),
+        # EP-layout token identity in serving decode
+        "ep_layout_serving_token_identical": outs == ep_outs,
+        # steady-state serving compiles nothing after warmup
+        "zero_recompiles_after_warmup": (
+            metrics.get("recompiles", 1) == 0),
+        # the expert-utilization census reached the metrics surface
+        "expert_census_counted": (
+            led["census_tokens"] > 0 and "moe_imbalance" in metrics),
+        # same seeds, same trace -> same tokens and census, byte for byte
+        "deterministic_rerun": (
+            outs == rerun_outs
+            and int(rerun_metrics.get("moe_census_tokens", -1))
+            == led["census_tokens"]),
+    }
+    if expect is not None:
+        gates["ledger_matches_baseline"] = all(
+            led.get(k) == v for k, v in expect.items() if k in led)
+
+    out = {
+        "metric": "moe_sim_gates_green",
+        "value": 1.0 if all(gates.values()) else 0.0,
+        "unit": "fraction",
+        "vs_baseline": 1.0,
+        "plan": {"name": raw.get("name", "moe-default"),
+                 "workload": dict(wk)},
+        "gates": gates,
+        "ledger": led,
+        "losses": {"dropless": [round(x, 6) for x in drop_losses],
+                   "capacity": [round(x, 6) for x in cap_losses]},
+        "platform": jax.default_backend(),
+    }
+    if capture is not None:
+        snap = dict(raw)
+        snap["expect"] = led
+        with open(capture, "w") as f:
+            json.dump(snap, f, indent=1, sort_keys=True)
+            f.write("\n")
+        out["captured"] = capture
+    print(json.dumps(out))
+    return 0 if all(gates.values()) else 1
+
+
 def _default_autoscale_plan() -> dict:
     """The CI autoscaling plan (scripts/ds_autoscale.py gates on it;
     the committed AUTOSCALE.json carries this dict plus the expected
@@ -3031,6 +3276,12 @@ if __name__ == "__main__":
         plan = (argv[i + 1] if i + 1 < len(argv)
                 and not argv[i + 1].startswith("-") else "default")
         sys.exit(_autoscale_sim(plan))
+    if "--moe-sim" in sys.argv[1:]:
+        argv = sys.argv[1:]
+        i = argv.index("--moe-sim")
+        plan = (argv[i + 1] if i + 1 < len(argv)
+                and not argv[i + 1].startswith("-") else "default")
+        sys.exit(_moe_sim(plan))
     if "--overload-sim" in sys.argv[1:]:
         argv = sys.argv[1:]
         i = argv.index("--overload-sim")

@@ -95,7 +95,11 @@ _HOT_FILES = ("runtime/engine.py", "inference/engine.py",
               "resilience/integrity.py",
               # the autoscaler ticks once per fleet sweep and its
               # adapter reads router/scheduler counters on that path
-              "inference/autoscaler.py")
+              "inference/autoscaler.py",
+              # dropless MoE dispatch runs INSIDE every train step and
+              # serving decode/prefill program — a host sync here would
+              # serialize the grouped GEMM per layer per step
+              "moe/dropless.py")
 _HOT_FN_PREFIXES = (
     "train_batch", "eval_batch", "_dispatch", "decode", "_decode",
     "generate", "put", "step", "_sample", "prefill", "_prefill",
@@ -120,6 +124,10 @@ _HOT_FN_PREFIXES = (
     "_drain_migrate", "_drain_target", "_maybe_release", "pump_drains",
     "_warm_boot", "_rebalance_to", "export_parked_kv", "parked_chains",
     "scale_up", "scale_down", "signals", "observe_time", "lifecycle",
+    # dropless MoE dispatch/combine (moe/dropless.py): traced per layer
+    # per step in both engines
+    "dropless_", "grouped_mm", "sort_by_expert", "expert_counts",
+    "router_z_loss", "_ragged_wire", "_a2a_wire", "_expert_mlp",
 )
 _SYNC_CALLS = ("block_until_ready", "device_get")
 # serving_readback: the scheduler loop's one named readback point

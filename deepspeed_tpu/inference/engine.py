@@ -22,6 +22,7 @@ constructs this engine; greedy `generate` is provided for parity with
 the wrapped-module generate path.
 """
 
+import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -73,6 +74,13 @@ class InferenceConfig(ConfigModel):
     # kernels (interpret mode off-TPU — the CPU test/gate lane);
     # 'xla' forces the oracle
     decode_impl: str = "auto"
+    # MoE expert-utilization census: every compiled decode/prefill
+    # application streams its per-expert routed-token counts to the
+    # engine (jax.debug.callback — one tiny [X] host transfer per
+    # layer), surfaced as engine.moe_expert_census() and the
+    # scheduler's moe_expert_* / moe_imbalance metrics. Off by default
+    # (a per-layer callback is not free); no effect on dense models.
+    moe_census: bool = False
     # automatic prefix caching (config/config.py PrefixCacheConfig):
     # hash-matched block reuse + COW tails in the ragged control plane
     prefix_cache: PrefixCacheConfig = Field(default_factory=PrefixCacheConfig)
@@ -389,6 +397,15 @@ class InferenceEngine:
         from ..analysis.sanitizer import RecompileTracker
 
         self.recompile_tracker = RecompileTracker()
+        # MoE expert-utilization census (config.moe_census): per-expert
+        # routed-token counts accumulated from every compiled MoE FFN
+        # application. debug.callback fires on runtime threads, so the
+        # accumulator is lock-guarded (the R003 race class).
+        self._census_enabled = (self.config.moe_census
+                                and model_config.n_experts > 0)
+        self._census = np.zeros((max(model_config.n_experts, 1),),
+                                np.int64)
+        self._census_lock = threading.Lock()
         # per-bucket static footprints captured by warmup(footprint=True)
         # ({width: {peak_hbm_bytes, ...}} — analysis/costmodel.py)
         self.warmup_footprints: Dict[int, Dict[str, float]] = {}
@@ -658,10 +675,13 @@ class InferenceEngine:
             mesh = self.mesh
             fetch = self._fetch_layer()
 
+            census = self._census_cb()
+
             def step(params, cache, tokens, n_real, tables):
                 return M.prefill_batch(
                     deq(params), cache, tokens, n_real, tables, cfg,
                     use_kernel, mesh=mesh, fetch_layer=fetch,
+                    census_cb=census,
                 )
 
             # donated: the paged KV cache aliases the returned cache
@@ -670,6 +690,25 @@ class InferenceEngine:
             self._prefill_batch_fns[key] = jax.jit(step, donate_argnums=(1,))  # ds-lint: ok R003 host dispatch thread only
         return self._prefill_batch_fns[key]
 
+    def _census_cb(self):
+        """The per-application expert-census sink compiled into MoE
+        programs (None when disabled — the compiled program then
+        carries no callback at all)."""
+        if not self._census_enabled:
+            return None
+
+        def add(counts):
+            with self._census_lock:
+                self._census += np.asarray(counts, np.int64)
+
+        return add
+
+    def moe_expert_census(self) -> np.ndarray:
+        """[X] int64 cumulative per-expert routed-token counts (counts
+        accumulate over layers and steps; config.moe_census)."""
+        with self._census_lock:
+            return self._census.copy()
+
     def _decode_fn(self, s: int, unique_rows: bool = False):
         key = (s, unique_rows)
         if key not in self._decode_fns:
@@ -677,10 +716,13 @@ class InferenceEngine:
             mesh = self.mesh
             fetch = self._fetch_layer()
 
+            census = self._census_cb()
+
             def step(params, cache, tokens, tables, ctx):
                 return M.decode_step(
                     deq(params), cache, tokens, tables, ctx, cfg, use_kernel,
                     mesh=mesh, unique_rows=unique_rows, fetch_layer=fetch,
+                    census_cb=census,
                 )
 
             # donated: the KV cache aliases the returned cache in-place
@@ -703,13 +745,14 @@ class InferenceEngine:
             cfg, use_kernel, deq = self.cfg, self._use_kernel, self._dequant
             mesh = self.mesh
             fetch = self._fetch_layer()
+            census = self._census_cb()
 
             if sampling is None:
                 def step(params, cache, tokens, tables, ctx):
                     return M.decode_multi(
                         deq(params), cache, tokens, tables, ctx, cfg,
                         n_steps=n_steps, use_kernel=use_kernel, mesh=mesh,
-                        fetch_layer=fetch,
+                        fetch_layer=fetch, census_cb=census,
                     )
             elif with_presence:
                 def step(params, cache, tokens, tables, ctx, keys, step0,
@@ -719,6 +762,7 @@ class InferenceEngine:
                         n_steps=n_steps, use_kernel=use_kernel, mesh=mesh,
                         sampling=sampling, keys=keys, step0=step0,
                         presence=presence, fetch_layer=fetch,
+                        census_cb=census,
                     )
             else:
                 def step(params, cache, tokens, tables, ctx, keys, step0):
@@ -726,7 +770,7 @@ class InferenceEngine:
                         deq(params), cache, tokens, tables, ctx, cfg,
                         n_steps=n_steps, use_kernel=use_kernel, mesh=mesh,
                         sampling=sampling, keys=keys, step0=step0,
-                        fetch_layer=fetch,
+                        fetch_layer=fetch, census_cb=census,
                     )
 
             # donated: the KV cache aliases the carried cache output

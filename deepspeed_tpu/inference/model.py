@@ -169,15 +169,28 @@ def quantize_prepared(prepared: Dict[str, Any],
 
 def quantize_layer(lp: Dict[str, Any],
                    cfg: T.TransformerConfig) -> Dict[str, Any]:
-    """Per-channel int8 for one prepared layer (see quantize_prepared)."""
+    """Per-channel int8 for one prepared layer (see quantize_prepared).
+
+    MoE expert stacks [X, ...] ride the GROUPWISE int8 path instead
+    (QuantizedWeight — the N004 machinery): a per-output-channel scale
+    does not survive the expert-stacked leading dim, but group scales
+    do, so the stacks park as int8 codes (w/ the offload tiers) and
+    dequantize transiently where the grouped GEMM consumes them
+    (_mlp). Resident expert bytes halve; the router stays fp32."""
     moe = cfg.n_experts > 0
     nlp = dict(lp)
     for name, w in lp.items():
         spec = _SERVING_SPECS.get(name)
+        if moe and name in ("w_gate", "w_in", "w_out"):
+            from ..ops.quantization import quantize_groupwise
+            from .quantization import QuantizedWeight
+
+            q, s = quantize_groupwise(w, 128, 8)
+            nlp[name] = QuantizedWeight(q=q, scale=s, bits=8,
+                                        dtype_name=str(w.dtype))
+            continue
         if spec is None or spec[0] is None:
             continue
-        if moe and name in ("w_gate", "w_in", "w_out"):
-            continue  # expert stacks: keep fp (scanned, not hot)
         nlp[name] = channel_quantize(w, spec[0])
     return nlp
 
@@ -516,24 +529,37 @@ def _sparse_decode_allowed_slots(scfg, positions, n_blocks: int,
     return rows[:, slot_sparse]
 
 
-def _mlp(h, lp, cfg: T.TransformerConfig):
+def _mlp(h, lp, cfg: T.TransformerConfig, census_cb=None):
     """FFN over [T, E] tokens — dense or MoE (Mixtral-class serving).
 
     Dense llama uses the fused [E, 2F] gate|up GEMM when the prepared
     layout carries it (see prepare()).
 
-    MoE serving is CAPACITY-FREE exact top-k: every token gets its full
-    expert mix — no train-time capacity drops (those are a training-
-    throughput artifact; ref: sharded_moe.py top1/top2gating keep the
-    drops only because the fixed [X, C] buffers feed the all-to-all).
-    Gate weights reproduce the training combine weights exactly (top-1:
-    the softmax gate; top-2: the renormalized pair), so serving matches
-    the training forward wherever training dropped nothing.
+    MoE serving is CAPACITY-FREE exact top-k for ANY k: every token
+    gets its full expert mix — no train-time capacity drops (those are
+    a training-throughput artifact; ref: sharded_moe.py topk_gating
+    keeps the drops only because the fixed [X, C] buffers feed the
+    all-to-all). Gate weights reproduce the training combine weights
+    exactly (top-1: the softmax gate; k>=2: renormalized), so serving
+    matches the training forward wherever training dropped nothing.
 
-    Experts run as a `lax.scan` over the stacked expert weights with a
-    per-expert combine column — X-times the dense FFN FLOPs, no [T,X,C]
-    dispatch tensor. Fine for decode widths; a gathered-GEMM path is the
-    optimization lever for huge prefills."""
+    Two expert paths share the gating authority
+    (moe.dropless.dropless_topk_gating):
+    - cfg.moe_dropless: per-expert token batching — the ragged batch's
+      rows stable-sort by expert id and run as ONE grouped (ragged)
+      GEMM per projection inside this same compiled program
+      (moe/dropless.py dropless_apply), FLOPs proportional to T*k.
+    - default: a `lax.scan` over the stacked expert weights with a
+      per-expert combine column — X-times the dense FFN FLOPs, no
+      [T,X,C] dispatch tensor; fine for decode widths.
+
+    Expert stacks may arrive as groupwise-int8 QuantizedWeight (the
+    N004 machinery; quantize_layer): codes dequantize transiently here,
+    so resident HBM holds int8 codes + group scales.
+
+    census_cb: when set, per-expert routed-token counts [X] of this
+    application stream out via jax.debug.callback — the scheduler's
+    expert-utilization/imbalance counters (scheduler.metrics())."""
     act = T._act_fn(cfg)  # one dispatch table for train + serve
     if cfg.n_experts == 0:
         if cfg.is_gated:
@@ -554,28 +580,44 @@ def _mlp(h, lp, cfg: T.TransformerConfig):
             out = out + lp["b_out"].astype(h.dtype)
         return out
 
+    from ..moe.dropless import (
+        dropless_apply,
+        dropless_topk_gating,
+        expert_counts,
+    )
+    from .quantization import QuantizedWeight
+
+    def deq(w):
+        # groupwise-int8 expert stacks (N004 machinery) dequantize
+        # transiently at use; plain arrays pass through
+        return w.dequantize() if isinstance(w, QuantizedWeight) else w
+
     X = cfg.n_experts
-    logits = h.astype(jnp.float32) @ lp["w_router"].astype(jnp.float32)  # [T, X]
-    gates = jax.nn.softmax(logits, axis=-1)
-    idx1 = jnp.argmax(logits, axis=-1)  # eval: no gate noise
-    onehot1 = jax.nn.one_hot(idx1, X, dtype=jnp.float32)
-    g1 = jnp.sum(gates * onehot1, axis=-1)
-    if cfg.moe_top_k == 1:
-        weights = onehot1 * g1[:, None]  # [T, X]
-    else:
-        masked = jnp.where(onehot1 > 0, -jnp.inf, logits)
-        onehot2 = jax.nn.one_hot(jnp.argmax(masked, axis=-1), X,
-                                 dtype=jnp.float32)
-        g2 = jnp.sum(gates * onehot2, axis=-1)
-        denom = jnp.maximum(g1 + g2, jnp.finfo(jnp.float32).eps)
-        weights = (onehot1 * (g1 / denom)[:, None]
-                   + onehot2 * (g2 / denom)[:, None])
+    T_ = h.shape[0]
+    logits = h.astype(jnp.float32) @ lp["w_router"].astype(jnp.float32)
+    # eval gate: no noise; one authority with the training paths
+    idx, wts, _, _ = dropless_topk_gating(logits, cfg.moe_top_k)
+    if census_cb is not None:
+        jax.debug.callback(census_cb, expert_counts(idx, X))
 
     has_gate = cfg.is_gated
     has_bias = "b_in" in lp
-    xs = [lp["w_in"], lp["w_out"], weights.T.astype(h.dtype)]
+    if cfg.moe_dropless:
+        # per-expert token batching across the ragged batch: ONE
+        # grouped GEMM per projection in this same compiled program
+        out = dropless_apply(
+            h, idx, wts, expert_counts(idx, X),
+            deq(lp["w_in"]), deq(lp["w_out"]),
+            w_gate=deq(lp["w_gate"]) if has_gate else None,
+            b_in=lp.get("b_in"), b_out=lp.get("b_out"), act=act)
+        return _moe_residual(out, h, lp, cfg, act)
+
+    # combine-weight matrix [T, X] from the top-k decisions
+    weights = jnp.zeros((T_, X), jnp.float32).at[
+        jnp.arange(T_)[:, None], idx].add(wts)
+    xs = [deq(lp["w_in"]), deq(lp["w_out"]), weights.T.astype(h.dtype)]
     if has_gate:
-        xs.append(lp["w_gate"])
+        xs.append(deq(lp["w_gate"]))
     if has_bias:
         xs += [lp["b_in"], lp["b_out"]]
 
@@ -598,26 +640,31 @@ def _mlp(h, lp, cfg: T.TransformerConfig):
         return acc + wcol[:, None] * y, None
 
     out, _ = jax.lax.scan(expert, jnp.zeros_like(h), tuple(xs))
-    if cfg.moe_use_residual:
-        # PR-MoE serving: dense residual expert + learned mix, matching
-        # the training combine exactly (ref: moe/layer.py use_residual)
-        if has_gate:
-            inner = act(_wmm("te,ef->tf", h, lp["wr_gate"])) \
-                * _wmm("te,ef->tf", h, lp["wr_in"])
-        else:
-            inner = _wmm("te,ef->tf", h, lp["wr_in"])
-            if "br_in" in lp:
-                inner = inner + lp["br_in"].astype(h.dtype)
-            inner = act(inner)
-        dense = _wmm("tf,fe->te", inner, lp["wr_out"])
-        if "br_out" in lp:
-            dense = dense + lp["br_out"].astype(h.dtype)
-        coef = jax.nn.softmax(
-            h.astype(jnp.float32) @ lp["w_coef"].astype(jnp.float32)
-            + lp["b_coef"].astype(jnp.float32), axis=-1)
-        out = (out * coef[:, 0:1].astype(h.dtype)
-               + dense * coef[:, 1:2].astype(h.dtype))
-    return out
+    return _moe_residual(out, h, lp, cfg, act)
+
+
+def _moe_residual(out, h, lp, cfg: T.TransformerConfig, act):
+    """PR-MoE serving tail: dense residual expert + learned mix,
+    matching the training combine exactly (ref: moe/layer.py
+    use_residual). No-op unless cfg.moe_use_residual."""
+    if not cfg.moe_use_residual:
+        return out
+    if cfg.is_gated:
+        inner = act(_wmm("te,ef->tf", h, lp["wr_gate"])) \
+            * _wmm("te,ef->tf", h, lp["wr_in"])
+    else:
+        inner = _wmm("te,ef->tf", h, lp["wr_in"])
+        if "br_in" in lp:
+            inner = inner + lp["br_in"].astype(h.dtype)
+        inner = act(inner)
+    dense = _wmm("tf,fe->te", inner, lp["wr_out"])
+    if "br_out" in lp:
+        dense = dense + lp["br_out"].astype(h.dtype)
+    coef = jax.nn.softmax(
+        h.astype(jnp.float32) @ lp["w_coef"].astype(jnp.float32)
+        + lp["b_coef"].astype(jnp.float32), axis=-1)
+    return (out * coef[:, 0:1].astype(h.dtype)
+            + dense * coef[:, 1:2].astype(h.dtype))
 
 
 def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None,
@@ -733,7 +780,7 @@ def _decode_attention(q, ck, cv, table, ctx, use_kernel: bool, allowed=None,
 def decode_step(
     params, cache: PagedCache, tokens, tables, ctx_lens, cfg: T.TransformerConfig,
     use_kernel: bool = True, mesh: Optional[Mesh] = None,
-    unique_rows: bool = False, fetch_layer=None,
+    unique_rows: bool = False, fetch_layer=None, census_cb=None,
 ):
     """tokens [S] int32, tables [S, NB] int32, ctx_lens [S] int32 (context
     length INCLUDING the new token) → (logits [S, V], new cache).
@@ -878,12 +925,12 @@ def decode_step(
         if cfg.parallel_residual:
             h2 = h1 if cfg.shared_ln else T._act_quant(
                 T._norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg), cfg)
-            x = x + out + _mlp(h2, lp, cfg)
+            x = x + out + _mlp(h2, lp, cfg, census_cb=census_cb)
         else:
             x = x + out
             h2 = T._act_quant(
                 T._norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg), cfg)
-            x = x + _mlp(h2, lp, cfg)
+            x = x + _mlp(h2, lp, cfg, census_cb=census_cb)
         x_hist.append(x)
 
     x = T._norm(x, params["ln_f_scale"], params.get("ln_f_bias"), cfg)
@@ -900,7 +947,7 @@ def decode_multi(
     cfg: T.TransformerConfig, n_steps: int, use_kernel: bool = True,
     mesh: Optional[Mesh] = None, unique_rows: bool = True,
     sampling=None, keys=None, step0=None, presence=None,
-    fetch_layer=None,
+    fetch_layer=None, census_cb=None,
 ):
     """Fused decode: n_steps tokens per compiled program.
 
@@ -935,7 +982,8 @@ def decode_multi(
         logits, cache = decode_step(params, cache, toks, tables, ctx, cfg,
                                     use_kernel, mesh=mesh,
                                     unique_rows=unique_rows,
-                                    fetch_layer=fetch_layer)
+                                    fetch_layer=fetch_layer,
+                                    census_cb=census_cb)
         if sampling is None:
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         else:
@@ -978,7 +1026,7 @@ def prefill_step(
 def prefill_batch(
     params, cache: PagedCache, tokens, n_real, tables,
     cfg: T.TransformerConfig, use_kernel: bool = True,
-    mesh: Optional[Mesh] = None, fetch_layer=None,
+    mesh: Optional[Mesh] = None, fetch_layer=None, census_cb=None,
 ):
     """Cross-prompt batched prefill: tokens [B, Tp] int32 (padded),
     n_real [B] int32, tables [B, NB] int32 → (last-real-token logits
@@ -1116,13 +1164,14 @@ def prefill_batch(
         if cfg.parallel_residual:
             h2 = h1 if cfg.shared_ln else T._act_quant(
                 T._norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg), cfg)
-            x = x + out + _mlp(h2.reshape(B * Tp, E), lp,
-                               cfg).reshape(B, Tp, E)
+            x = x + out + _mlp(h2.reshape(B * Tp, E), lp, cfg,
+                               census_cb=census_cb).reshape(B, Tp, E)
         else:
             x = x + out
             h2 = T._act_quant(
                 T._norm(x, lp["ln2_scale"], lp.get("ln2_bias"), cfg), cfg)
-            x = x + _mlp(h2.reshape(B * Tp, E), lp, cfg).reshape(B, Tp, E)
+            x = x + _mlp(h2.reshape(B * Tp, E), lp, cfg,
+                         census_cb=census_cb).reshape(B, Tp, E)
         x_hist.append(x)
 
     # logits for each prompt's last REAL token only (logits_gather):

@@ -1330,6 +1330,19 @@ class ServingScheduler:
             m.update(self.governor.metrics())
         if self.spill_store is not None:
             m.update(self.spill_store.stats())
+        # MoE expert-utilization census (InferenceConfig.moe_census):
+        # cumulative routed-token share per expert plus the imbalance
+        # ratio max/mean — 1.0 is a perfectly balanced router, and a
+        # rising ratio means hot experts serialize the grouped GEMM
+        if getattr(self.engine, "_census_enabled", False):
+            census = self.engine.moe_expert_census()
+            total = int(census.sum())
+            m["moe_census_tokens"] = float(total)
+            if total:
+                for i, c in enumerate(census):
+                    m[f"moe_expert_{i}_share"] = float(c) / total
+                m["moe_imbalance"] = float(
+                    census.max() / max(float(census.mean()), 1e-9))
         for k, v in self.counters.items():
             m[k] = float(v)
         for cls, v in sorted(self.slo_rejections.items()):

@@ -95,6 +95,16 @@ class TransformerConfig:
     moe_min_capacity: int = 4
     moe_aux_loss_coef: float = 0.01
     moe_noisy_gate_policy: Optional[str] = None  # None | RSample | Jitter
+    # Dropless (capacity-factor-free) routing (moe/dropless.py,
+    # MegaBlocks-style): sort-by-expert grouped batching at EP=1, the
+    # explicit dispatch/combine all-to-all frame under an 'expert' mesh
+    # axis. No token is ever dropped; moe_capacity_factor/min_capacity
+    # are ignored. Serving follows the same flag (per-expert token
+    # batching across the ragged batch instead of the X-pass scan).
+    moe_dropless: bool = False
+    # Router z-loss coefficient (ST-MoE): penalizes large router logits
+    # so the fp32 gate softmax stays numerically sharp. 0 disables.
+    moe_z_loss_coef: float = 0.0
     # PR-MoE residual form (ref: moe/layer.py:29 use_residual, arXiv
     # 2201.05596): each MoE FFN gains a DENSE residual expert and a
     # learned 2-way mixing coefficient —
@@ -725,7 +735,7 @@ def _act_fn(cfg: TransformerConfig):
 
 def _mlp_delta(h, lp, cfg: TransformerConfig, rng=None):
     """FFN branch over the NORMED input h; returns (residual delta,
-    moe aux loss)."""
+    moe aux losses [2] = (load-balance l_aux, router z-loss))."""
     if cfg.n_experts > 0:
         return _moe_mlp_delta(h, lp, cfg, rng)
     x = h
@@ -751,13 +761,15 @@ def _mlp_delta(h, lp, cfg: TransformerConfig, rng=None):
     out = jnp.einsum("bsf,fe->bse", inner, lp["w_out"].astype(x.dtype))
     if cfg.has_mlp_bias:
         out = out + lp["b_out"].astype(x.dtype)
-    return _dropout(out, cfg.dropout, rng), jnp.float32(0.0)
+    return _dropout(out, cfg.dropout, rng), jnp.zeros((2,), jnp.float32)
 
 
 def _moe_mlp_delta(h, lp, cfg: TransformerConfig, rng=None):
     """Expert-parallel MoE FFN over normed h (ref: deepspeed/moe/
     sharded_moe.py MOELayer:421 — dispatch einsum / all-to-all / expert
-    FFN / combine)."""
+    FFN / combine). moe_dropless routes through moe/dropless.py
+    instead: capacity-free sorted/grouped batching (EP=1) or the
+    explicit a2a frame (EP=N, derived from the ambient mesh)."""
     from ..moe.sharded_moe import moe_ffn
 
     B, S, E = h.shape
@@ -787,17 +799,41 @@ def _moe_mlp_delta(h, lp, cfg: TransformerConfig, rng=None):
     gate_rng = None
     if rng is not None and cfg.moe_noisy_gate_policy is not None:
         rng, gate_rng = jax.random.split(rng)
-    out, l_aux = moe_ffn(
-        tokens,
-        lp["w_router"],
-        expert_fn,
-        top_k=cfg.moe_top_k,
-        capacity_factor=cfg.moe_capacity_factor,
-        min_capacity=cfg.moe_min_capacity,
-        rng=gate_rng,
-        noisy_gate_policy=cfg.moe_noisy_gate_policy,
-        shard=shard,
-    )
+    if cfg.moe_dropless:
+        from ..moe.dropless import dropless_moe_ffn
+
+        mesh = _ambient_mesh()
+        ep = 1 if mesh is None or mesh.empty else \
+            int(mesh.shape.get("expert", 1))
+        res = dropless_moe_ffn(
+            tokens,
+            lp["w_router"],
+            lp["w_in"],
+            lp["w_out"],
+            w_gate=lp.get("w_gate"),
+            b_in=lp.get("b_in"),
+            b_out=lp.get("b_out"),
+            act=act,
+            top_k=cfg.moe_top_k,
+            rng=gate_rng,
+            noisy_gate_policy=cfg.moe_noisy_gate_policy,
+            shard=shard,
+            ep_size=ep,
+        )
+        out, l_aux, z_loss = res.out, res.l_aux, res.z_loss
+    else:
+        out, l_aux = moe_ffn(
+            tokens,
+            lp["w_router"],
+            expert_fn,
+            top_k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor,
+            min_capacity=cfg.moe_min_capacity,
+            rng=gate_rng,
+            noisy_gate_policy=cfg.moe_noisy_gate_policy,
+            shard=shard,
+        )
+        z_loss = jnp.float32(0.0)
     out = out.reshape(B, S, E)
     if cfg.moe_use_residual:
         # PR-MoE (ref: moe/layer.py use_residual — moe and a dense
@@ -820,7 +856,9 @@ def _moe_mlp_delta(h, lp, cfg: TransformerConfig, rng=None):
         out = (out * coef[..., 0:1].astype(x.dtype)
                + dense * coef[..., 1:2].astype(x.dtype))
     out = _shard(out, DP, "seq", None)
-    return _dropout(out, cfg.dropout, rng), l_aux
+    aux = jnp.stack([l_aux.astype(jnp.float32),
+                     z_loss.astype(jnp.float32)])
+    return _dropout(out, cfg.dropout, rng), aux
 
 
 # valid TransformerConfig.remat values; __post_init__ validates so a
@@ -898,7 +936,7 @@ def _make_layer_body(cfg: TransformerConfig, use_rng: bool, positions=None,
         p_keep = 1.0 - (idx + 1.0) / cfg.n_layers * (1.0 - pld_theta)
         keep = jax.random.bernoulli(r_pld, p_keep)
         return jax.lax.cond(
-            keep, run, lambda h: (h, jnp.float32(0.0)), h0
+            keep, run, lambda h: (h, jnp.zeros((2,), jnp.float32)), h0
         )
 
     if cfg.remat == "full":
@@ -962,8 +1000,9 @@ def forward_hidden(
 ):
     """tokens [B, S] int32 → final hidden states [B, S, E] (post ln_f).
 
-    with_aux=True additionally returns {"moe_aux_loss": scalar} (sum of
-    per-layer load-balancing losses; 0 for dense models).
+    with_aux=True additionally returns {"moe_aux_loss": scalar,
+    "moe_z_loss": scalar} (sums of per-layer load-balancing / router
+    z-losses; 0 for dense models).
     ltd_idx [B, K] (with cfg.random_ltd_layer_range set) routes the LTD
     layer segment over the kept-token subset only.
     pld_theta: traced scalar keep-floor for Progressive Layer Dropping
@@ -1019,7 +1058,7 @@ def forward_hidden(
         ]
 
         def period_body(carry, xs):
-            h, aux = carry, jnp.float32(0.0)
+            h, aux = carry, jnp.zeros((2,), jnp.float32)
             for j in range(p):
                 sub = jax.tree.map(lambda t: t[j], xs)
                 h, l_aux = bodies[j](h, sub)
@@ -1055,13 +1094,15 @@ def forward_hidden(
         h_sub, aux2 = seg(h_sub, a, b, sub_body)
         x = x.at[jnp.arange(B)[:, None], ltd_idx].set(h_sub)
         x, aux3 = seg(x, b, cfg.n_layers, layer_body)
-        aux_sum = jnp.sum(aux1) + jnp.sum(aux2) + jnp.sum(aux3)
+        aux_sum = (jnp.sum(jnp.reshape(aux1, (-1, 2)), axis=0)
+                   + jnp.sum(jnp.reshape(aux2, (-1, 2)), axis=0)
+                   + jnp.sum(jnp.reshape(aux3, (-1, 2)), axis=0))
     else:
         x, aux = seg(x, 0, cfg.n_layers, layer_body)
-        aux_sum = jnp.sum(aux)
+        aux_sum = jnp.sum(jnp.reshape(aux, (-1, 2)), axis=0)
     out = _norm(x, params["ln_f_scale"], params.get("ln_f_bias"), cfg)
     if with_aux:
-        return out, {"moe_aux_loss": aux_sum}
+        return out, {"moe_aux_loss": aux_sum[0], "moe_z_loss": aux_sum[1]}
     return out
 
 
@@ -1157,8 +1198,11 @@ def make_loss_fn(cfg: TransformerConfig, loss_chunks: int = 8):
                                   head_b=params.get("lm_head_b"))
         if cfg.n_experts > 0:
             # Load-balancing aux loss, coefficient per the reference's
-            # Megatron-DeepSpeed recipe (ref: sharded_moe.py l_aux usage).
+            # Megatron-DeepSpeed recipe (ref: sharded_moe.py l_aux
+            # usage), plus the ST-MoE router z-loss (dropless routing).
             loss = loss + cfg.moe_aux_loss_coef * aux["moe_aux_loss"]
+            if cfg.moe_z_loss_coef:
+                loss = loss + cfg.moe_z_loss_coef * aux["moe_z_loss"]
         return loss
 
     return loss_fn
@@ -1224,7 +1268,7 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, loss_chunks: int = 8):
         use_rng = rng is not None and _wants_rng(cfg)
         layer_body = _make_layer_body(cfg, use_rng)
 
-        carry_in = (x, jnp.zeros((M,), jnp.float32))
+        carry_in = (x, jnp.zeros((M, 2), jnp.float32))
         state_spec = (P("pipe", DP, "seq", None), P("pipe"))
         layers = params["layers"]
         if v > 1:
@@ -1246,7 +1290,7 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, loss_chunks: int = 8):
                     h, l_aux = jax.lax.scan(layer_body, h, (lp, keys))
                 else:
                     h, l_aux = jax.lax.scan(layer_body, h, lp)
-                return h, aux + jnp.sum(l_aux)
+                return h, aux + jnp.sum(l_aux, axis=0)
 
             hidden, aux = pipeline_apply_circular(
                 chunk_fn,
@@ -1263,7 +1307,7 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, loss_chunks: int = 8):
                     h, l_aux = jax.lax.scan(layer_body, h, (lp_stage, keys))
                 else:
                     h, l_aux = jax.lax.scan(layer_body, h, lp_stage)
-                return h, aux + jnp.sum(l_aux)
+                return h, aux + jnp.sum(l_aux, axis=0)
 
             if n_stage <= 1:
                 # degenerate single-stage pipeline: layers stay [L, ...] in
@@ -1291,7 +1335,9 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, loss_chunks: int = 8):
         )(x_out, targets, mask)
         loss = jnp.mean(per_micro)
         if cfg.n_experts > 0:
-            loss = loss + cfg.moe_aux_loss_coef * jnp.mean(aux)
+            loss = loss + cfg.moe_aux_loss_coef * jnp.mean(aux[:, 0])
+            if cfg.moe_z_loss_coef:
+                loss = loss + cfg.moe_z_loss_coef * jnp.mean(aux[:, 1])
         return loss
 
     return loss_fn

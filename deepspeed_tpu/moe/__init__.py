@@ -1,3 +1,13 @@
+from .dropless import (  # noqa: F401
+    DroplessOut,
+    dropless_apply,
+    dropless_moe_ffn,
+    dropless_topk_gating,
+    expert_counts,
+    grouped_mm,
+    router_z_loss,
+    sort_by_expert,
+)
 from .sharded_moe import (  # noqa: F401
     compute_capacity,
     moe_ffn,

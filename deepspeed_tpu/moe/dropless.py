@@ -1,0 +1,291 @@
+"""Dropless (capacity-factor-free) expert-parallel MoE routing.
+
+MegaBlocks-style routing (Gale et al., arXiv 2211.15841) rebuilt for a
+static-shape SPMD world: instead of the GShard/Switch fixed [X, C]
+per-expert buffers of `sharded_moe.py` — which either drop tokens past
+capacity or pad capacity to waste — tokens are *sorted by expert id*
+and the expert FFN runs as a grouped (ragged) GEMM over the sorted
+assignment buffer. No token is ever dropped and no expert slot is ever
+padded, regardless of routing skew.
+
+Two dispatch wires share one gating authority:
+
+- ragged (EP=1, and the serving ragged batch): stable-sort the T*K
+  (token, expert) assignments by expert id, run the expert MLP with
+  `jax.lax.ragged_dot` (grouped GEMM over contiguous expert segments;
+  a masked-scan oracle covers backends without it), and combine with a
+  weighted `segment_sum` back to token order.
+- a2a (EP=N training): tokens regroup as [G, T/G] over the 'expert'
+  mesh axis, dispatch group-locally into a [G, X, C, E] frame with the
+  per-group dropless bound C = T/G (each local token contributes at
+  most one assignment per expert, so nothing can overflow — dropless
+  by construction, not by tuning), and two explicit single-axis
+  reshard constraints move the frame group-sharded -> expert-sharded
+  and back: the XLA partitioner emits exactly the reference's
+  dispatch/combine all-to-all pair (ref: deepspeed/moe/sharded_moe.py
+  _AllToAll:95) with 'expert'-axis replica groups, which the schedule
+  analyzer (S005/S007) attributes per step.
+
+Gate math runs in fp32 regardless of compute dtype (the reference
+casts at TopKGate.forward) and generalizes to any top_k <= n_experts:
+selection by `lax.top_k` over the (optionally noised) logits, combine
+weights renormalized for k > 1 (the GShard top-2 convention) and raw
+softmax mass for k = 1 (the Switch convention) — bit-matching the
+capacity-factor paths wherever those would not drop. The router
+z-loss (ST-MoE, arXiv 2202.08906) and the load-balance aux loss ride
+the return value so the training loss can thread both.
+"""
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .sharded_moe import _apply_noise, _load_balance_loss, _one_hot
+
+_HAS_RAGGED_DOT = hasattr(jax.lax, "ragged_dot")
+
+
+@dataclasses.dataclass(frozen=True)
+class DroplessOut:
+    """Result of one dropless MoE FFN application."""
+
+    out: Any      # [T, E] combined expert outputs, compute dtype
+    l_aux: Any    # scalar fp32 load-balance loss (1.0 at uniform)
+    z_loss: Any   # scalar fp32 router z-loss (ST-MoE logsumexp^2)
+    counts: Any   # [X] int32 tokens routed per expert (the census)
+
+
+def router_z_loss(logits) -> jnp.ndarray:
+    """ST-MoE router z-loss: mean over tokens of logsumexp(logits)^2 —
+    keeps router logits small so the fp32 gate softmax stays sharp
+    without saturating (arXiv 2202.08906 eq. 5)."""
+    lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+    return jnp.mean(jnp.square(lse))
+
+
+def dropless_topk_gating(
+    logits,
+    top_k: int,
+    rng=None,
+    noisy_gate_policy: Optional[str] = None,
+    renormalize: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Capacity-free top-k gate (generic k; math fp32).
+
+    logits: [T, X] router outputs. Selection runs on the noised logits
+    (train-time exploration), combine weights come from the CLEAN
+    softmax — exactly the capacity paths' split, so where those would
+    keep every token the two agree bitwise.
+
+    renormalize: None = (top_k > 1), matching top1_gating (raw softmax
+    mass) and top2_gating (pair renormalized to sum 1).
+
+    Returns (expert_idx [T, K] int32, weights [T, K] fp32, l_aux,
+    z_loss). No capacity, no keep-mask: every row routes.
+    """
+    T, X = logits.shape
+    if not 1 <= top_k <= X:
+        raise ValueError(
+            f"moe top_k must be in [1, {X}] for {X} experts, got {top_k}")
+    if renormalize is None:
+        renormalize = top_k > 1
+    logits = logits.astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)
+    z_loss = router_z_loss(logits)
+
+    noisy = _apply_noise(logits, rng, noisy_gate_policy)
+    _, idx = jax.lax.top_k(noisy, top_k)  # [T, K], ties -> lowest index
+    weights = jnp.take_along_axis(gates, idx, axis=-1)  # [T, K] fp32
+    if renormalize:
+        weights = weights / jnp.maximum(
+            jnp.sum(weights, axis=-1, keepdims=True),
+            jnp.finfo(jnp.float32).eps)
+
+    # load-balance loss over the FIRST choice — the formula both
+    # capacity paths use (top1gating/top2gating compute l_aux on mask1)
+    l_aux = _load_balance_loss(gates, _one_hot(idx[:, 0], X))
+    return idx, weights, l_aux, z_loss
+
+
+def expert_counts(expert_idx, n_experts: int) -> jnp.ndarray:
+    """[X] int32 assignment census from [T, K] (or flat) expert ids."""
+    flat = expert_idx.reshape(-1)
+    return jnp.zeros((n_experts,), jnp.int32).at[flat].add(1)
+
+
+def sort_by_expert(expert_idx) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Stable-sort the flat assignment list by expert id.
+
+    expert_idx: [T, K]. Returns (order [A], src [A], sorted_experts [A])
+    with A = T*K: `order` permutes flat assignment slots into expert-
+    contiguous runs, `src` is the source TOKEN of each sorted slot.
+    Stability makes the permutation a pure function of the routing
+    decision — identical across EP layouts, so the grouped GEMM sees
+    the same row order no matter how the mesh is carved.
+    """
+    T, K = expert_idx.shape
+    flat = expert_idx.reshape(-1)
+    order = jnp.argsort(flat, stable=True)
+    return order, order // K, flat[order]
+
+
+def grouped_mm(xs, w, counts, impl: str = "auto"):
+    """Grouped (ragged) GEMM: rows of `xs` [A, E] are expert-contiguous
+    segments sized by `counts` [X]; each segment contracts with its own
+    expert weight from `w` [X, E, F] -> [A, F].
+
+    impl: 'auto' = lax.ragged_dot when this jax has it, else the
+    masked-scan oracle; 'ragged' / 'dense' force a path ('dense' is the
+    X-pass masked scan — the correctness oracle and the fallback)."""
+    if impl == "auto":
+        impl = "ragged" if _HAS_RAGGED_DOT else "dense"
+    if impl == "ragged":
+        return jax.lax.ragged_dot(xs, w.astype(xs.dtype),
+                                  counts.astype(jnp.int32))
+    if impl != "dense":
+        raise ValueError(f"unknown grouped_mm impl {impl!r}")
+    offsets = jnp.cumsum(counts) - counts  # [X]
+    pos = jnp.arange(xs.shape[0], dtype=jnp.int32)
+
+    def body(acc, ws):
+        w_e, off, n = ws
+        seg = ((pos >= off) & (pos < off + n))[:, None]
+        return acc + jnp.where(seg, xs @ w_e.astype(xs.dtype), 0), None
+
+    acc0 = jnp.zeros((xs.shape[0], w.shape[-1]), xs.dtype)
+    out, _ = jax.lax.scan(
+        body, acc0, (w, offsets.astype(jnp.int32), counts.astype(jnp.int32)))
+    return out
+
+
+def _expert_mlp_sorted(xs, sorted_experts, counts, w_in, w_out, w_gate,
+                       b_in, b_out, act, impl):
+    """The expert MLP over the expert-sorted assignment buffer."""
+    if w_gate is not None:
+        inner = act(grouped_mm(xs, w_gate, counts, impl)) \
+            * grouped_mm(xs, w_in, counts, impl)
+    else:
+        inner = grouped_mm(xs, w_in, counts, impl)
+        if b_in is not None:
+            inner = inner + b_in[sorted_experts].astype(xs.dtype)
+        inner = act(inner)
+    ys = grouped_mm(inner, w_out, counts, impl)
+    if b_out is not None:
+        ys = ys + b_out[sorted_experts].astype(xs.dtype)
+    return ys
+
+
+def _ragged_wire(tokens, idx, weights, counts, w_in, w_out, w_gate,
+                 b_in, b_out, act, impl):
+    """EP=1 / serving wire: sort -> grouped GEMM -> segment-sum."""
+    T = tokens.shape[0]
+    order, src, sorted_experts = sort_by_expert(idx)
+    xs = tokens[src]  # [A, E] expert-contiguous
+    ys = _expert_mlp_sorted(xs, sorted_experts, counts, w_in, w_out,
+                            w_gate, b_in, b_out, act, impl)
+    wf = weights.reshape(-1)[order].astype(tokens.dtype)
+    return jax.ops.segment_sum(ys * wf[:, None], src, num_segments=T)
+
+
+def _a2a_wire(tokens, idx, weights, ep_size, w_in, w_out, w_gate,
+              b_in, b_out, act, shard):
+    """EP=N wire: group-local dispatch into the [G, X, C, E] frame with
+    the per-group dropless bound C = T/G, then two single-axis reshards
+    (group-sharded <-> expert-sharded) that the partitioner lowers to
+    the dispatch/combine all-to-all pair over the 'expert' groups."""
+    T, E = tokens.shape
+    X = w_in.shape[0]
+    G = ep_size
+    Tl = T // G
+    C = Tl  # dropless bound: <=1 assignment per (local token, expert)
+    dtype = tokens.dtype
+
+    tg = tokens.reshape(G, Tl, E)
+    idxg = idx.reshape(G, Tl, -1)
+    wg = weights.reshape(G, Tl, -1)
+    if shard is not None:
+        tg = shard(tg, "expert", None, None)
+
+    onehot = _one_hot(idxg, X)                      # [G, Tl, K, X] fp32
+    mask = jnp.sum(onehot, axis=2)                  # [G, Tl, X] 0/1
+    pos = jnp.cumsum(mask, axis=1) - mask           # [G, Tl, X]
+    d = mask[..., None] * _one_hot(pos.astype(jnp.int32), C)  # [G,Tl,X,C]
+
+    z = jnp.einsum("gtxc,gte->gxce", d.astype(dtype), tg)
+    if shard is not None:
+        z = shard(z, None, "expert", None, None)    # dispatch all-to-all
+    if w_gate is not None:
+        inner = act(jnp.einsum("gxce,xef->gxcf", z, w_gate.astype(dtype))) \
+            * jnp.einsum("gxce,xef->gxcf", z, w_in.astype(dtype))
+    else:
+        inner = jnp.einsum("gxce,xef->gxcf", z, w_in.astype(dtype))
+        if b_in is not None:
+            inner = inner + b_in[None, :, None, :].astype(dtype)
+        inner = act(inner)
+    y = jnp.einsum("gxcf,xfe->gxce", inner, w_out.astype(dtype))
+    if b_out is not None:
+        # padding slots pick up the bias too; the combine one-hot below
+        # zeroes them before any token sees the frame
+        y = y + b_out[None, :, None, :].astype(dtype)
+    if shard is not None:
+        y = shard(y, "expert", None, None, None)    # combine all-to-all
+    gatew = jnp.sum(onehot * wg[..., None], axis=2)  # [G, Tl, X]
+    comb = (d * gatew[..., None]).astype(dtype)
+    out = jnp.einsum("gtxc,gxce->gte", comb, y)
+    if shard is not None:
+        out = shard(out, "expert", None, None)
+    return out.reshape(T, E)
+
+
+def dropless_apply(
+    tokens, expert_idx, weights, counts, w_in, w_out, w_gate=None,
+    b_in=None, b_out=None, *, act, impl: str = "auto",
+):
+    """The ragged wire on PRE-COMPUTED routing decisions — the serving
+    entry point (inference/model.py _mlp): the scheduler's mixed
+    prefill/decode rows arrive as one flat [T, E] batch and leave as
+    per-expert contiguous grouped-GEMM segments in the same compiled
+    program. expert_idx [T, K], weights [T, K], counts [X]."""
+    return _ragged_wire(tokens, expert_idx, weights, counts, w_in,
+                        w_out, w_gate, b_in, b_out, act, impl)
+
+
+def dropless_moe_ffn(
+    tokens,          # [T, E] flattened tokens, compute dtype
+    router_w,        # [E, X]
+    w_in,            # [X, E, F]
+    w_out,           # [X, F, E]
+    w_gate=None,     # [X, E, F] (gated MLP)
+    b_in=None,       # [X, F]
+    b_out=None,      # [X, E]
+    *,
+    act,
+    top_k: int = 1,
+    rng=None,
+    noisy_gate_policy: Optional[str] = None,
+    shard=None,      # fn(x, *mesh axis names) sharding constraint
+    ep_size: int = 1,
+    impl: str = "auto",
+) -> DroplessOut:
+    """Dropless dispatch -> grouped expert MLP -> combine.
+
+    ep_size > 1 (and T divisible by it) selects the a2a wire — the
+    expert-parallel frame whose dispatch/combine pair the schedule
+    analyzer attributes; otherwise the sorted ragged wire runs (zero
+    padding — the serving path and the EP=1 training path). Both wires
+    share the gating authority, so the routed math is identical and
+    EP=1 == EP=N up to float reassociation (test-pinned).
+    """
+    logits = tokens.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    idx, weights, l_aux, z_loss = dropless_topk_gating(
+        logits, top_k, rng=rng, noisy_gate_policy=noisy_gate_policy)
+    counts = expert_counts(idx, w_in.shape[0])
+    if ep_size > 1 and tokens.shape[0] % ep_size == 0:
+        out = _a2a_wire(tokens, idx, weights, ep_size, w_in, w_out,
+                        w_gate, b_in, b_out, act, shard)
+    else:
+        out = _ragged_wire(tokens, idx, weights, counts, w_in, w_out,
+                           w_gate, b_in, b_out, act, impl)
+    return DroplessOut(out=out, l_aux=l_aux, z_loss=z_loss, counts=counts)

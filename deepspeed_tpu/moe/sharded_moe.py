@@ -48,111 +48,112 @@ def _load_balance_loss(gates, mask):
     return num_experts * jnp.sum(me * ce)
 
 
+def _replicated_draw(draw_fn):
+    """Run one rng draw pinned REPLICATED under the ambient mesh.
+
+    The gate noise must be a pure function of (seed, step, layer) —
+    byte-identical across EP layouts. With jax's default
+    non-partitionable threefry, the SPMD partitioner may compute
+    DIFFERENT bits for the same key depending on how it shards the
+    generation (observed: an {'expert': 2} mesh axis changes the drawn
+    noise vs the same key on a pure-DP mesh). Pinning the draw's output
+    replicated forces one full layout-independent computation — the
+    noise tensor is [T, X]-small, so the cost is nil and EP=1 == EP=N
+    stays bitwise."""
+    x = draw_fn()
+    from ..platform.mesh import ambient_mesh, manual_axes_of
+
+    mesh = ambient_mesh()
+    if mesh is None or mesh.empty or manual_axes_of(mesh):
+        return x
+    from jax.sharding import PartitionSpec as P
+
+    return jax.lax.with_sharding_constraint(x, P())
+
+
 def _apply_noise(logits, rng, policy: Optional[str]):
     """Noisy gating (ref: sharded_moe.py multiplicative_jitter / RSample
     noisy_gate_policy). No-op when rng is None (eval) or policy unset."""
     if rng is None or policy is None:
         return logits
     if policy == "RSample":
-        return logits + jax.random.normal(rng, logits.shape, logits.dtype)
+        return logits + _replicated_draw(
+            lambda: jax.random.normal(rng, logits.shape, logits.dtype))
     if policy == "Jitter":
         eps = 1e-2
-        return logits * jax.random.uniform(
-            rng, logits.shape, logits.dtype, 1.0 - eps, 1.0 + eps
-        )
+        return logits * _replicated_draw(
+            lambda: jax.random.uniform(
+                rng, logits.shape, logits.dtype, 1.0 - eps, 1.0 + eps))
     raise ValueError(f"unknown noisy_gate_policy {policy!r}")
 
 
-def top1_gating(
+def topk_gating(
     logits,
+    top_k: int,
     capacity_factor: float = 1.0,
     min_capacity: int = 4,
     rng=None,
     noisy_gate_policy: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Switch-style top-1 gating (ref: sharded_moe.py top1gating:180).
+    """Generic capacity-factor top-k gating (Switch at k=1, GShard at
+    k=2 — ref: sharded_moe.py top1gating:180 / top2gating:278 — and the
+    same queue discipline for any k <= n_experts).
 
     logits: [T, X] router outputs (any float dtype; math is fp32).
-    Returns (combine [T,X,C] fp32, dispatch [T,X,C] bool, l_aux scalar).
-    Tokens beyond an expert's capacity are dropped (their combine row is
-    zero — the residual connection around the MoE block carries them).
+    Capacity C = ceil(T/X * factor * k); choice j's queue starts after
+    the tokens the earlier choices actually KEPT per expert — a dropped
+    first-choice token never consumes a slot a later choice could have
+    used. Tokens beyond capacity are dropped (their combine row is
+    zero — the residual around the MoE block carries them).
+
+    Returns (combine [T,X,C] fp32, dispatch [T,X,C] bool, l_aux). k=1
+    combines with the raw softmax mass (Switch); k>=2 renormalizes the
+    kept choices to sum to 1 (GShard).
     """
     T, X = logits.shape
-    C = compute_capacity(T, X, capacity_factor, min_capacity)
+    if not 1 <= top_k <= X:
+        raise ValueError(
+            f"moe top_k must be in [1, {X}] for {X} experts, got {top_k}")
+    C = compute_capacity(T, X, capacity_factor * top_k, min_capacity)
     logits = logits.astype(jnp.float32)
     gates = jax.nn.softmax(logits, axis=-1)
 
     noisy = _apply_noise(logits, rng, noisy_gate_policy)
-    index = jnp.argmax(noisy, axis=-1)  # [T]
-    mask = _one_hot(index, X)  # [T, X]
-
-    l_aux = _load_balance_loss(gates, mask)
-
-    # Position of each token within its expert's queue; drop overflows.
-    locations = jnp.cumsum(mask, axis=0) - mask  # [T, X], fp32 counts
-    locations = jnp.sum(locations * mask, axis=-1).astype(jnp.int32)  # [T]
-    keep = (locations < C) & (mask.sum(-1) > 0).astype(bool)
-    gate_val = jnp.sum(gates * mask, axis=-1)  # [T]
-
-    dispatch = (
-        mask[:, :, None] * _one_hot(locations, C)[:, None, :]
-    ) * keep[:, None, None]
-    combine = dispatch * gate_val[:, None, None]
-    return combine, dispatch > 0, l_aux
-
-
-def top2_gating(
-    logits,
-    capacity_factor: float = 1.0,
-    min_capacity: int = 4,
-    rng=None,
-    noisy_gate_policy: Optional[str] = None,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """GShard-style top-2 gating (ref: sharded_moe.py top2gating:278).
-
-    Second choice is the argmax after masking the first; gate values of
-    the two kept experts are renormalized to sum to 1.
-    """
-    T, X = logits.shape
-    C = compute_capacity(T, X, capacity_factor * 2.0, min_capacity)
-    logits = logits.astype(jnp.float32)
-    gates = jax.nn.softmax(logits, axis=-1)
-
-    noisy = _apply_noise(logits, rng, noisy_gate_policy)
-    index1 = jnp.argmax(noisy, axis=-1)
-    mask1 = _one_hot(index1, X)
-    masked = jnp.where(mask1 > 0, -jnp.inf, noisy)
-    index2 = jnp.argmax(masked, axis=-1)
-    mask2 = _one_hot(index2, X)
-
-    l_aux = _load_balance_loss(gates, mask1)
-
-    loc1 = jnp.cumsum(mask1, axis=0) - mask1
-    # Second-choice tokens queue after all first-choice tokens per expert.
-    loc2 = jnp.cumsum(mask2, axis=0) - mask2 + jnp.sum(mask1, axis=0, keepdims=True)
-    pos1 = jnp.sum(loc1 * mask1, axis=-1).astype(jnp.int32)
-    pos2 = jnp.sum(loc2 * mask2, axis=-1).astype(jnp.int32)
-    keep1 = pos1 < C
-    keep2 = pos2 < C
-
-    g1 = jnp.sum(gates * mask1, axis=-1) * keep1
-    g2 = jnp.sum(gates * mask2, axis=-1) * keep2
-    denom = jnp.maximum(g1 + g2, jnp.finfo(jnp.float32).eps)
-    g1, g2 = g1 / denom, g2 / denom
-
-    d1 = (mask1[:, :, None] * _one_hot(pos1, C)[:, None, :]) * keep1[:, None, None]
-    d2 = (mask2[:, :, None] * _one_hot(pos2, C)[:, None, :]) * keep2[:, None, None]
-    combine = d1 * g1[:, None, None] + d2 * g2[:, None, None]
-    dispatch = (d1 + d2) > 0
+    masked = noisy
+    kept = jnp.zeros((1, X), jnp.float32)  # KEPT tokens per expert so far
+    l_aux = None
+    gs, ds = [], []
+    for _ in range(top_k):
+        mask_j = _one_hot(jnp.argmax(masked, axis=-1), X)  # [T, X]
+        masked = jnp.where(mask_j > 0, -jnp.inf, masked)
+        if l_aux is None:  # the reference computes l_aux on mask1
+            l_aux = _load_balance_loss(gates, mask_j)
+        loc_j = jnp.cumsum(mask_j, axis=0) - mask_j + kept
+        pos_j = jnp.sum(loc_j * mask_j, axis=-1).astype(jnp.int32)  # [T]
+        keep_j = pos_j < C
+        kept = kept + jnp.sum(mask_j * keep_j[:, None], axis=0,
+                              keepdims=True)
+        gs.append(jnp.sum(gates * mask_j, axis=-1) * keep_j)
+        ds.append(
+            (mask_j[:, :, None] * _one_hot(pos_j, C)[:, None, :])
+            * keep_j[:, None, None])
+    if top_k > 1:
+        denom = jnp.maximum(sum(gs), jnp.finfo(jnp.float32).eps)
+        gs = [g / denom for g in gs]
+    combine = sum(d * g[:, None, None] for d, g in zip(ds, gs))
+    dispatch = sum(ds) > 0
     return combine, dispatch, l_aux
 
 
-def topk_gating(logits, top_k: int, **kw):
-    if top_k == 1:
-        return top1_gating(logits, **kw)
-    if top_k == 2:
-        return top2_gating(logits, **kw)
-    raise ValueError(f"moe top_k must be 1 or 2, got {top_k}")
+def top1_gating(logits, **kw):
+    """Switch-style top-1 gating (topk_gating at k=1)."""
+    return topk_gating(logits, 1, **kw)
+
+
+def top2_gating(logits, **kw):
+    """GShard-style top-2 gating (topk_gating at k=2; capacity is
+    2x the top-1 factor and the kept pair renormalizes to sum 1)."""
+    return topk_gating(logits, 2, **kw)
 
 
 def moe_ffn(

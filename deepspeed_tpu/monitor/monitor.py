@@ -190,7 +190,16 @@ def serving_events(scheduler, step: int,
     (session pins broken by a drain, re-pinned at next submit).
     Per-SLO-class degradation: `fleet/shed_<class>` and
     `fleet/deadline_rejections_<class>` — the autoscaler's
-    premium-impact signal."""
+    premium-impact signal.
+
+    MoE serving feed (docs/moe.md; present when the engine serves an
+    MoE model with InferenceConfig.moe_census on): per-scheduler
+    `moe_census_tokens` (cumulative routed assignments across layers
+    and steps), `moe_expert_<i>_share` (each expert's fraction of the
+    census — the utilization histogram), and `moe_imbalance` (max/mean
+    expert load; 1.0 = perfectly balanced router, rising values mean
+    hot experts serialize the grouped GEMM and the load-balance loss
+    deserves a look)."""
     metrics = scheduler.metrics()
     return [(f"{prefix}/{name}", float(value), step)
             for name, value in sorted(metrics.items())]

@@ -15,6 +15,11 @@ compiled on the virtual 8-device CPU mesh, no step executed:
 
   train_step        the zero-3 + TP fused training step
                     (engine.sanitize's compiled artifact)
+  train_step_moe    the dropless MoE zero-3 + EP + TP training step
+                    (moe/dropless.py, docs/moe.md): expert weights
+                    sharded over their own 'expert' mesh axis, the
+                    dispatch/combine all-to-all pair over the expert
+                    groups in this entry's collective ledger
   serving_decode_w8 the width-8 paged-KV decode program
                     (the serving warmup footprint unit)
   serving_decode_w8_int8
@@ -80,6 +85,28 @@ def build_reports():
     tree = engine.state.master if engine._use_master else engine.state.params
     live = int(sum(x.nbytes for x in jax.tree.leaves(tree)))
 
+    # dropless MoE zero-3 + EP + TP train step: the expert-parallel
+    # canonical program — S005/S007/S009 must keep attributing its
+    # dispatch/combine all-to-all pair with 'expert' replica groups
+    moe_cfg = T.TransformerConfig(
+        vocab_size=128, n_layers=2, n_heads=4, d_model=64, max_seq=32,
+        variant="llama", use_flash=False, n_experts=4, moe_top_k=2,
+        moe_dropless=True, moe_z_loss_coef=1e-3)
+    moe_engine = ds.initialize(
+        {"train_micro_batch_size_per_gpu": 1,
+         "gradient_accumulation_steps": 2,
+         "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+         "zero_optimization": {"stage": 3, "param_persistence_threshold": 64},
+         "bf16": {"enabled": True},
+         "mesh": {"data": 2, "expert": 2, "model": 2},
+         "steps_per_print": 10**9},
+        loss_fn=T.make_loss_fn(moe_cfg),
+        param_init_fn=lambda k: T.init(moe_cfg, k),
+        param_logical_specs=T.logical_specs(moe_cfg))
+    moe_batch = {"tokens": np.zeros(
+        (moe_engine.config.train_batch_size, 33), np.int32)}
+    moe_san = moe_engine.sanitize(moe_batch)
+
     from deepspeed_tpu.inference import init_inference
     import jax.numpy as jnp
     import warnings
@@ -138,6 +165,8 @@ def build_reports():
     reports = {}
     if san.cost is not None:
         reports["train_step"] = san.cost
+    if moe_san.cost is not None:
+        reports["train_step_moe"] = moe_san.cost
     if decode_cost is not None:
         reports["serving_decode_w8"] = decode_cost
     if quant_cost is not None:

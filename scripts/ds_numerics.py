@@ -15,6 +15,10 @@ compressed wire — fails here before pytest ever runs. Canonical
 programs, compiled on the virtual 8-device CPU mesh, no step executed:
 
   train_step         the zero-3 + TP bf16 fused training step
+  train_step_moe     the dropless MoE zero-3 + EP + TP bf16 step — the
+                     ledger pins the fp32 gate chain (router dot,
+                     softmax, z-loss logsumexp) against the bf16
+                     compute dtype, and the all-to-all payload dtype
   train_step_fp16    the fp16 dynamic-loss-scaled training step
   train_step_onebit  the 1-bit Adam compressed-momentum step
   serving_decode_w8  the width-8 paged-KV decode program
@@ -89,8 +93,9 @@ def _train_artifacts(engine, batch, fn=None):
     return compiled, lowered, batch
 
 
-ALL_PROGRAMS = ("train_step", "train_step_fp16", "train_step_onebit",
-                "serving_decode_w8", "serving_decode_w8_int8")
+ALL_PROGRAMS = ("train_step", "train_step_moe", "train_step_fp16",
+                "train_step_onebit", "serving_decode_w8",
+                "serving_decode_w8_int8")
 
 
 def build_programs(only=None):
@@ -129,6 +134,26 @@ def build_programs(only=None):
                eng._numerics_checks(compiled, lowered, "train_step",
                                     master=eng.state.master,
                                     opt=eng.state.opt))
+
+    # dropless MoE zero-3 + EP + TP bf16 step (docs/moe.md): fp32 gate
+    # math under a bf16 compute dtype, expert a2a payloads on the wire
+    if "train_step_moe" in only:
+        moe_cfg = T.TransformerConfig(
+            vocab_size=128, n_layers=2, n_heads=4, d_model=64,
+            max_seq=32, variant="llama", use_flash=False, n_experts=4,
+            moe_top_k=2, moe_dropless=True, moe_z_loss_coef=1e-3)
+        engm = _engine(moe_cfg,
+                       zero_optimization={"stage": 3,
+                                          "param_persistence_threshold": 64},
+                       bf16={"enabled": True},
+                       mesh={"data": 2, "expert": 2, "model": 2})
+        batchm = {"tokens": np.zeros(
+            (engm.config.train_batch_size, 33), np.int32)}
+        cm, lm, _ = _train_artifacts(engm, batchm)
+        record("train_step_moe", cm, lm,
+               engm._numerics_checks(cm, lm, "train_step_moe",
+                                     master=engm.state.master,
+                                     opt=engm.state.opt))
 
     # fp16 dynamic-loss-scaled step
     if "train_step_fp16" in only:

@@ -469,7 +469,14 @@ class TestServingBudget:
 # ds_budget CLI gate
 # ----------------------------------------------------------------------
 
+@pytest.mark.slow
 class TestDsBudgetScript:
+    """Slow lane: each subprocess rebuilds EVERY canonical program
+    (two engine compiles + two inference compiles since the MoE
+    program joined) — and the pre-test gate lane already runs
+    `ds_budget.py --check --strict` on every PR, so the fast lane
+    carries no coverage gap."""
+
     def _run(self, *args):
         env = dict(os.environ)
         env.pop("XLA_FLAGS", None)  # the script sets its own device count
@@ -504,7 +511,8 @@ class TestDsBudgetScript:
         r = self._run("--capture", "--baseline", str(out))
         assert r.returncode == 0, r.stdout + r.stderr
         doc = json.loads(out.read_text())
-        assert set(doc["programs"]) == {"train_step", "serving_decode_w8",
+        assert set(doc["programs"]) == {"train_step", "train_step_moe",
+                                        "serving_decode_w8",
                                         "serving_decode_w8_int8"}
         assert all(p["peak_hbm_bytes"] > 0
                    for p in doc["programs"].values())

@@ -60,6 +60,21 @@ preemption, within the plan's reassociation budget after), and
 rollback/reconstruction stay within budget. scripts/ds_elastic.py
 gates this in CI (docs/fault_tolerance.md, docs/elasticity.md).
 
+`python bench.py --pipe-sim [plan]` (plan = 'default' = PIPE.json,
+or a path) runs the INTERLEAVED-PIPELINE lane on the virtual
+8-device CPU mesh (docs/pipeline.md): bitwise loss identity across
+pipeline layouts (P=1 == P=2 == P=2 interleaved V=2 on the noiseless
+fp32 path), measured bubble fraction equal to the (P-1)/(V*M+P-1)
+closed form and beating the non-interleaved bound, the zero-3 +
+{data,pipe,model} + bf16 V=2 step projecting faster than V=1 on the
+S009 schedule analysis AND the v5p roofline, and a stage-host
+preemption chaos sub-lane (peer-mirrored stage slices, zero disk
+restores, byte-exact ledger, 'pipe.permute' boundary faults healed
+and charged to the per-stage skew feed). Exit is non-zero unless
+every gate holds, steady state compiles one program per layout, a
+rerun is byte-identical, and the ledger matches the committed
+PIPE.json. scripts/ds_pipe.py gates this in CI.
+
 `python bench.py --sdc-chaos [plan]` (plan = 'default' =
 SDCCHAOS.json, or a path) runs the SILENT-DATA-CORRUPTION lane:
 elastic training and the disaggregated serving fleet, clean and then
@@ -1151,6 +1166,391 @@ def _train_chaos(plan_arg: str):
     }
     print(json.dumps(out))
     return 0 if all(gates.values()) else 1
+
+
+# ---------------------------------------------------------------------------
+# pipeline lane: interleaved 3D parallelism — identity, bubble, projection,
+# stage-host chaos (scripts/ds_pipe.py gates this; docs/pipeline.md)
+# ---------------------------------------------------------------------------
+
+def _default_pipe_plan() -> dict:
+    """The CI pipeline plan (scripts/ds_pipe.py gates on it; the
+    committed PIPE.json carries this dict plus the expected ledger).
+    Four lanes on the virtual 8-device CPU mesh:
+
+    - identity: the SAME noiseless fp32 run at P=1, P=2, and P=2
+      interleaved V=2 (fixed data axis, pipelined loss throughout) —
+      losses must be BITWISE identical across pipeline layouts;
+    - bubble: the measured schedule accounting (iteration-count
+      replay, runtime/pipe.simulate_schedule) must equal the
+      interleaved closed form (P-1)/(V*M+P-1) and beat the
+      non-interleaved (P-1)/(M+P-1) bound;
+    - projection: the zero-3 + {data,pipe,model} + bf16 interleaved
+      step at V=2 must project FASTER than V=1 on both the S009
+      schedule step time and the v5p roofline (fixed M — the
+      interleave bubble saving is wasted-FLOP/byte reduction in the
+      SPMD program);
+    - chaos: a stage HOST (logical grid rank stage*dp+shard) is
+      preempted mid-run — recovery must come from peer-mirrored
+      stage slices with zero disk restores and a byte-exact ledger;
+      a transient 'pipe.permute' boundary fault must heal in the
+      guard's bounded retry and an injected stage delay must show in
+      the per-stage skew feed."""
+    return {
+        "name": "pipe-default",
+        "seed": 0,
+        "budget": {
+            "max_rollback_steps": 2,
+            "max_loss_rel_diff": 1e-3,
+            "max_reconstruction_s": 60.0,
+            "max_disk_restores": 0,
+            "projection_tolerance": 0.10,
+        },
+        "workload": {
+            "stages": 2, "interleave": 2, "gas": 8, "micro": 2,
+            # identity lane runs micro=1: with >1 rows per microbatch
+            # the within-microbatch token-mean reassociates across
+            # layouts (data-sharded rows), which is the documented
+            # reassociation budget, not the bitwise-pinned path
+            "ident_micro": 1, "ident_steps": 4,
+            "proj": {"d_model": 64, "n_layers": 4, "seq": 128},
+            "chaos": {"world": 2, "total_steps": 8, "every_k": 2,
+                      "regrow_at": 6, "regrow_to": 2},
+        },
+        "faults": [
+            # stage 1 / shard 0's host (logical grid rank 1*2+0 = 2)
+            # preempted at the dispatch of step 5; state is at the
+            # step-4 mirror boundary — recovery reassembles every
+            # (stage, shard) slice from surviving peers, dp 2 -> 1
+            {"point": "engine.step", "kind": "raise",
+             "error": "preempted", "value": 2, "where": {"step": 5},
+             "at": 1, "times": 1},
+            # transient stage-boundary link failure: the pipe.permute
+            # guard's bounded retry must heal it silently
+            {"point": "pipe.permute", "kind": "raise", "error": "io",
+             "where": {"stage": 1, "step": 3}, "at": 1, "times": 1},
+            # slow stage-1 boundary at step 7: charged to that stage's
+            # skew counter (engine.pipe_stage_delay_s), surfaced by
+            # monitor.training_events
+            {"point": "pipe.permute", "kind": "delay", "value": 0.25,
+             "where": {"stage": 1, "step": 7}, "at": 1, "times": 1},
+        ],
+    }
+
+
+def _pipe_sim(plan_arg: str, capture=None):
+    """Pipeline gate (scripts/ds_pipe.py; docs/pipeline.md): identity,
+    bubble, pod projection, and stage-host chaos lanes for the
+    interleaved virtual-stage pipeline composed with ZeRO-3/TP."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_threefry_partitionable", True)
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.elasticity import ElasticTrainer
+    from deepspeed_tpu.models import transformer as T
+    from deepspeed_tpu.monitor.monitor import training_events
+    from deepspeed_tpu.platform.accelerator import chip_roofline
+    from deepspeed_tpu.platform.mesh import build_mesh
+    from deepspeed_tpu.resilience import FaultPlan, armed
+    from deepspeed_tpu.runtime.dataloader import (
+        DeepSpeedTPUDataLoader,
+        RepeatingLoader,
+    )
+    from deepspeed_tpu.runtime.pipe import bubble_fraction, simulate_schedule
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    committed_path = os.path.join(root, "PIPE.json")
+    if plan_arg == "default":
+        raw = (json.load(open(committed_path))
+               if os.path.exists(committed_path) else _default_pipe_plan())
+    else:
+        raw = json.load(open(plan_arg))
+    plan = FaultPlan.from_dict(raw)
+    budget = {**_default_pipe_plan()["budget"], **plan.budget}
+    wk = {**_default_pipe_plan()["workload"], **raw.get("workload", {})}
+    expected = raw.get("expected")
+
+    P = int(wk["stages"])
+    V = int(wk["interleave"])
+    gas = int(wk["gas"])
+    micro = int(wk["micro"])
+    ident_steps = int(wk["ident_steps"])
+    VOCAB = 128
+
+    def model_cfg(stages, virtual, d_model=64, n_layers=4, seq=32):
+        return T.TransformerConfig(
+            vocab_size=VOCAB, n_layers=n_layers, n_heads=4,
+            d_model=d_model, max_seq=seq, variant="llama",
+            use_flash=False, pipeline_stages=stages,
+            pipeline_virtual_stages=virtual)
+
+    def build(stages, virtual, *, zero=1, model=1, bf16=False,
+              d_model=64, n_layers=4, seq=32, data=2, micro_bs=None):
+        mcfg = model_cfg(stages, virtual, d_model, n_layers, seq)
+        mesh = build_mesh(
+            {"pipe": stages, "data": data, "model": model},
+            devices=jax.devices()[:stages * data * model])
+        cfg = {"train_micro_batch_size_per_gpu": (
+                   micro if micro_bs is None else micro_bs),
+               "gradient_accumulation_steps": gas,
+               "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+               "zero_optimization": {"stage": zero,
+                                     "param_persistence_threshold": 64},
+               "seed": 7, "steps_per_print": 10**9}
+        if bf16:
+            cfg["bf16"] = {"enabled": True}
+        return ds.initialize(
+            cfg, loss_fn=T.make_pipelined_loss_fn(mcfg),
+            param_init_fn=lambda k: T.init(mcfg, k),
+            param_logical_specs=T.logical_specs(mcfg),
+            mesh=mesh, pipelined=True, pipeline_virtual_stages=virtual)
+
+    def batches(n, engine, seq=32, seed=3):
+        r = np.random.default_rng(seed)
+        return [{"tokens": r.integers(
+            0, VOCAB, (engine.config.train_batch_size, seq + 1)
+        ).astype(np.int32)} for _ in range(n)]
+
+    # ---- lane 1: bitwise loss identity across pipeline layouts -------
+    def ident_losses(stages, virtual):
+        eng = build(stages, virtual, micro_bs=int(wk["ident_micro"]))
+        ls = [float(eng.train_batch(b)["loss"])
+              for b in batches(ident_steps, eng)]
+        rec = eng._recompile_tracker.report()
+        return ls, len(rec.findings), len(eng._train_compiled_cache)
+
+    l_p1, rec1, prog1 = ident_losses(1, 1)
+    l_p2, rec2, prog2 = ident_losses(P, 1)
+    l_v2, recv, progv = ident_losses(P, V)
+
+    # ---- lane 2: bubble accounting -----------------------------------
+    sim_v = simulate_schedule(gas, P, V)
+    sim_1 = simulate_schedule(gas, P, 1)
+    closed_v = bubble_fraction(gas, P, V)
+    bound_1 = bubble_fraction(gas, P, 1)
+
+    # ---- lane 3: 3D composition + pod-projected step time ------------
+    proj = wk["proj"]
+    tol = float(budget["projection_tolerance"])
+
+    def project(virtual):
+        eng = build(P, virtual, zero=3, model=2, bf16=True,
+                    d_model=int(proj["d_model"]),
+                    n_layers=int(proj["n_layers"]), seq=int(proj["seq"]))
+        rep = eng.sanitize({"tokens": np.zeros(
+            (eng.config.train_batch_size, int(proj["seq"]) + 1),
+            np.int32)})
+        cost = rep.cost
+        peak, hbm = chip_roofline("v5p")
+        return {
+            "sanitize_ok": bool(rep.ok),
+            "step_time_us": round(cost.step_time_s * 1e6, 3),
+            "v5p_us": round(max(cost.flops / peak,
+                                cost.bytes_accessed / hbm) * 1e6, 3),
+        }
+
+    proj_v1 = project(1)
+    proj_v2 = project(V)
+
+    # ---- lane 4: stage-host preemption chaos -------------------------
+    ck = wk["chaos"]
+    world, total_steps = int(ck["world"]), int(ck["total_steps"])
+    chaos_cfg = model_cfg(P, V)
+    elastic_block = {
+        "enabled": True, "max_train_batch_size": 16,
+        "micro_batch_sizes": [2, 4], "min_gpus": 1, "max_gpus": 16,
+    }
+
+    def make_engine(w):
+        mesh = build_mesh({"pipe": P, "data": w},
+                          devices=jax.devices()[:P * w])
+        return ds.initialize(
+            {"optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+             "elasticity": dict(elastic_block),
+             "zero_optimization": {"stage": 1},
+             "seed": 7, "steps_per_print": 10**9},
+            loss_fn=T.make_pipelined_loss_fn(chaos_cfg),
+            param_init_fn=lambda k: T.init(chaos_cfg, k),
+            param_logical_specs=T.logical_specs(chaos_cfg),
+            mesh=mesh, pipelined=True, pipeline_virtual_stages=V)
+
+    class _Toy:
+        def __init__(self, n=64):
+            r = np.random.default_rng(5)
+            self.items = [
+                {"tokens": r.integers(0, VOCAB, (33,)).astype(np.int32)}
+                for _ in range(n)]
+
+        def __len__(self):
+            return len(self.items)
+
+        def __getitem__(self, i):
+            return self.items[i]
+
+    def make_loader():
+        return RepeatingLoader(DeepSpeedTPUDataLoader(
+            _Toy(), batch_size=16, shuffle=True, seed=11))
+
+    def run_lane(armed_plan):
+        tr = ElasticTrainer(
+            make_engine, world, make_loader(),
+            every_k_steps=int(ck["every_k"]),
+            elastic_block=elastic_block)
+        if armed_plan is not None:
+            with armed(armed_plan):
+                tr.run(total_steps, regrow_at=ck.get("regrow_at"),
+                       regrow_to=ck.get("regrow_to"))
+        else:
+            tr.run(total_steps)
+        return tr
+
+    clean = run_lane(None)
+    chaos = run_lane(plan)
+
+    steps = list(range(1, total_steps + 1))
+
+    def ledger_bytes(tr):
+        return json.dumps([[s, tr.ledger[s][0], list(tr.ledger[s][1])]
+                           for s in sorted(tr.ledger)]).encode()
+
+    kill_steps = [int(f.where["step"]) for f in plan.faults
+                  if f.point == "engine.step" and f.kind == "raise"
+                  and "step" in f.where]
+    prefix_end = (min(kill_steps) - 1) if kill_steps else total_steps
+    rel = {s: abs(clean.history[s] - chaos.history[s])
+           / max(abs(clean.history[s]), 1e-12) for s in steps}
+    max_rel = max(rel.values()) if rel else 0.0
+    metrics = chaos.resilience_metrics()
+    events = dict((n, v) for n, v, _ in training_events(
+        chaos.engine, total_steps, chaos))
+    permute_fired = sum(
+        1 for entry in plan.fired if "pipe.permute" in str(entry))
+    has_permute_delay = any(
+        f.point == "pipe.permute" and f.kind == "delay"
+        for f in plan.faults)
+
+    # ---- rerun byte-identity (the determinism gate) ------------------
+    l_p1_re, _, _ = ident_losses(1, 1)
+
+    sched = chaos.engine.pipeline_schedule_stats()
+    gates = {
+        # lane 1
+        "loss_identity_bitwise_p1_p2": l_p1 == l_p2,
+        "loss_identity_bitwise_p1_interleaved": l_p1 == l_v2,
+        "zero_recompiles": rec1 == rec2 == recv == 0
+        and prog1 == prog2 == progv == 1,
+        # lane 2
+        "measured_bubble_matches_closed_form":
+            abs(sim_v["bubble_fraction"] - closed_v) < 1e-12,
+        "interleaved_bubble_beats_v1_bound":
+            sim_v["bubble_fraction"] < bound_1
+            and sim_1["bubble_fraction"] == bound_1,
+        # lane 3
+        "pipe3d_sanitize_clean": proj_v1["sanitize_ok"]
+        and proj_v2["sanitize_ok"],
+        "s009_step_time_improves_with_v":
+            proj_v2["step_time_us"] < proj_v1["step_time_us"],
+        "v5p_projection_improves_with_v":
+            proj_v2["v5p_us"] < proj_v1["v5p_us"],
+        # lane 4
+        "stage_host_recovered_from_peer_shards":
+            chaos.reconstructions >= 1 if kill_steps else True,
+        "zero_disk_restore": metrics["disk_restores"]
+        <= budget["max_disk_restores"],
+        "data_order_ledger_byte_exact":
+            ledger_bytes(clean) == ledger_bytes(chaos),
+        "loss_prefix_bitwise_identical": all(
+            clean.history[s] == chaos.history[s]
+            for s in range(1, prefix_end + 1)),
+        "loss_trajectory_within_budget": max_rel
+        <= budget["max_loss_rel_diff"],
+        "rollback_within_mirror_cadence": chaos.last_rollback_steps
+        <= budget["max_rollback_steps"],
+        "world_restored": chaos.world == world,
+        "stage_mirror_bytes_counted":
+            metrics.get("stage_mirror_bytes", 0) > 0,
+        "permute_faults_exercised": permute_fired >= 2,
+        "monitor_pipeline_feed":
+            "train/pipeline/bubble_fraction" in events
+            and "train/pipeline/straggler_stage" in events
+            and abs(events["train/pipeline/bubble_fraction"]
+                    - sched["bubble_fraction"]) < 1e-12,
+        # determinism
+        "rerun_byte_identical": l_p1 == l_p1_re,
+    }
+    if has_permute_delay:
+        gates["stage_skew_charged"] = (
+            max(chaos.engine.pipe_stage_delay_s.values(), default=0.0)
+            > 0.0 and events.get("train/pipeline/stage_time_skew", 1.0)
+            > 1.0)
+
+    measured = {
+        "ident_losses_p1": l_p1,
+        "chaos_history": {str(s): chaos.history[s]
+                          for s in sorted(chaos.history)},
+        "bubble": {"measured": sim_v["bubble_fraction"],
+                   "closed_form": closed_v,
+                   "noninterleaved_bound": bound_1,
+                   "schedule_steps": sim_v["total_steps"]},
+        "projection": {"v1": proj_v1, "v2": proj_v2},
+    }
+    if expected is not None:
+        gates["ledger_matches_committed"] = (
+            expected["ident_losses_p1"] == l_p1
+            and expected["chaos_history"] == measured["chaos_history"]
+            and expected["bubble"] == measured["bubble"]
+            and all(
+                abs(expected["projection"][k][f] - measured[
+                    "projection"][k][f])
+                <= tol * abs(expected["projection"][k][f]) + 1.0
+                for k in ("v1", "v2")
+                for f in ("step_time_us", "v5p_us")))
+
+    out = {
+        "metric": "pipe_interleaved_bubble_fraction",
+        "value": round(sim_v["bubble_fraction"], 6),
+        "unit": "fraction",
+        "vs_baseline": round(sim_v["bubble_fraction"] / bound_1, 6),
+        "plan": {"name": plan.name, "faults": len(plan.faults),
+                 "fired": plan.fired, "budget": budget, "workload": wk},
+        "gates": gates,
+        "measured": measured,
+        "chaos": {
+            "generations": int(chaos.generation),
+            "reconstructions": int(chaos.reconstructions),
+            "rollback_steps": int(chaos.last_rollback_steps),
+            "disk_restores": int(metrics["disk_restores"]),
+            "stage_mirror_bytes": int(
+                metrics.get("stage_mirror_bytes", 0)),
+            "pipe_stage_delay_s": {
+                str(k): v for k, v in sorted(
+                    chaos.engine.pipe_stage_delay_s.items())},
+        },
+        "platform": jax.default_backend(),
+    }
+    print(json.dumps(out))
+    ok = all(gates.values())
+    if capture is not None:
+        if not ok:
+            print(json.dumps({"error": "gates failed; baseline not "
+                                       "written"}), file=sys.stderr)
+            return 1
+        doc = dict(_default_pipe_plan() if plan_arg == "default" else raw)
+        doc.pop("expected", None)
+        doc["expected"] = measured
+        with open(capture, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(json.dumps({"captured": capture}), file=sys.stderr)
+        return 0
+    return 0 if ok else 1
 
 
 # ---------------------------------------------------------------------------
@@ -3282,6 +3682,12 @@ if __name__ == "__main__":
         plan = (argv[i + 1] if i + 1 < len(argv)
                 and not argv[i + 1].startswith("-") else "default")
         sys.exit(_moe_sim(plan))
+    if "--pipe-sim" in sys.argv[1:]:
+        argv = sys.argv[1:]
+        i = argv.index("--pipe-sim")
+        plan = (argv[i + 1] if i + 1 < len(argv)
+                and not argv[i + 1].startswith("-") else "default")
+        sys.exit(_pipe_sim(plan))
     if "--overload-sim" in sys.argv[1:]:
         argv = sys.argv[1:]
         i = argv.index("--overload-sim")

@@ -99,7 +99,13 @@ _HOT_FILES = ("runtime/engine.py", "inference/engine.py",
               # dropless MoE dispatch runs INSIDE every train step and
               # serving decode/prefill program — a host sync here would
               # serialize the grouped GEMM per layer per step
-              "moe/dropless.py")
+              "moe/dropless.py",
+              # the pipeline schedule body is traced into every
+              # pipelined train step (scan over v*M+P-1 chunk-steps,
+              # one collective-permute per step) — a host sync or an
+              # unrolled-loop collective here multiplies by the whole
+              # schedule length (docs/pipeline.md)
+              "runtime/pipe.py")
 _HOT_FN_PREFIXES = (
     "train_batch", "eval_batch", "_dispatch", "decode", "_decode",
     "generate", "put", "step", "_sample", "prefill", "_prefill",
@@ -128,6 +134,11 @@ _HOT_FN_PREFIXES = (
     # per step in both engines
     "dropless_", "grouped_mm", "sort_by_expert", "expert_counts",
     "router_z_loss", "_ragged_wire", "_a2a_wire", "_expert_mlp",
+    # interleaved pipeline (runtime/pipe.py): the schedule body and
+    # its helpers trace into every pipelined step; the host-side
+    # boundary guard runs once per stage per dispatch
+    "pipeline_apply", "partition_layers", "unpartition_layers",
+    "stage_slice_keys", "pipe_permute_tick", "simulate_schedule",
 )
 _SYNC_CALLS = ("block_until_ready", "device_get")
 # serving_readback: the scheduler loop's one named readback point

@@ -396,15 +396,27 @@ def check_exposed_comm(
 # check S008: hierarchy-aware placement
 # ----------------------------------------------------------------------
 
+def _permute_cut_stats(node: CollectiveNode, topology: PodTopology
+                       ) -> Tuple[int, int, int]:
+    """(total pairs, DCN-straddling pairs, minimum achievable cuts) for
+    a collective-permute's source-target pairs under `topology`. A
+    pipeline ring whose stages sit in CONTIGUOUS slice blocks (mesh.py
+    lays 'pipe' outermost exactly for this) crosses the DCN boundary
+    once per slice it touches — that ring-wraparound count is the
+    placement lower bound; every cut beyond it is a stage->slice
+    placement that interleaves slices and pays DCN on steady-state hops
+    ICI could carry."""
+    cuts = sum(1 for a, b in node.pairs
+               if topology.slice_of(a) != topology.slice_of(b))
+    touched = len({topology.slice_of(d) for p in node.pairs for d in p})
+    min_cuts = touched if touched > 1 else 0
+    return len(node.pairs), cuts, min_cuts
+
+
 def _group_slice_stats(node: CollectiveNode, topology: PodTopology,
                        n_devices: int) -> Tuple[int, int]:
     """(group size, max slices one group spans) for a collective under
-    `topology`. Flat/unstated groups span the whole projected world;
-    collective-permute classifies by its source-target pairs."""
-    if node.pairs:
-        spans = max((1 + (topology.slice_of(a) != topology.slice_of(b))
-                     for a, b in node.pairs), default=1)
-        return 2, spans
+    `topology`. Flat/unstated groups span the whole projected world."""
     groups = node.groups
     if not groups:
         world = (topology.num_slices or 1) * topology.slice_devices \
@@ -440,6 +452,40 @@ def check_hierarchy_placement(
     targets = [int(t) for t in (target_devices or [])
                if int(t) > topology.slice_devices]
     for c in analysis.collectives:
+        if c.pairs:
+            # collective-permute (the pipeline rotate / ring-attention
+            # hop): hierarchy here is stage->slice PLACEMENT, not group
+            # decomposition — flag when the permute crosses the DCN
+            # boundary more often than a contiguous stage layout would
+            # (docs/pipeline.md; mesh.py lays 'pipe' outermost so
+            # steady-state hops stay on ICI)
+            n_pairs, cuts, min_cuts = _permute_cut_stats(c, topology)
+            if n_pairs == 0 or cuts <= min_cuts:
+                continue
+            per_pair = c.payload_bytes  # each pair moves the payload once
+            t_now = per_pair * cuts / max(topology.dcn_bandwidth, 1.0)
+            t_min = per_pair * min_cuts / max(topology.dcn_bandwidth, 1.0)
+            if (t_now - t_min) * 1e6 < topology.min_saving_us:
+                continue
+            out.findings.append(Finding(
+                rule="S008", path=label, line=0, severity="error",
+                message=(
+                    f"collective-permute '{c.name}' crosses the DCN "
+                    f"boundary on {cuts} of {n_pairs} source-target "
+                    f"pairs where a contiguous stage->slice placement "
+                    f"needs only {min_cuts} ring-wraparound cut(s) — "
+                    f"{(cuts - min_cuts) * per_pair / 2**20:.1f} MiB of "
+                    "steady-state stage-boundary traffic pays the DCN "
+                    f"tier per step ({t_now * 1e6:.0f}us vs "
+                    f"{t_min * 1e6:.0f}us contiguous)"),
+                fix_hint=(
+                    "keep the 'pipe' mesh axis outermost (contiguous "
+                    "device block per stage, platform/mesh.MESH_AXES "
+                    "order) and size slices to a multiple of the "
+                    "per-stage device count so consecutive stages "
+                    "share a slice"),
+            ))
+            continue
         g, spans = _group_slice_stats(c, topology, analysis.n_devices)
         if spans <= 1:
             continue  # whole group on ICI: nothing to decompose

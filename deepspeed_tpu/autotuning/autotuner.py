@@ -21,7 +21,7 @@ by the batch triangle.
 import json
 import os
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..utils.logging import log_dist, logger
 
@@ -35,8 +35,18 @@ class Autotuner:
         param_logical_specs: Any = None,
         make_batch: Optional[Callable[[int], Any]] = None,
         results_dir: Optional[str] = None,
+        make_pipelined: Optional[Callable[[int, int], Dict[str, Any]]] = None,
     ):
-        """make_batch(global_batch_size) -> host batch pytree for one step."""
+        """make_batch(global_batch_size) -> host batch pytree for one step.
+
+        make_pipelined(pipe_stages, interleave) -> {'loss_fn',
+        'param_init_fn', 'param_logical_specs'}: the pipeline-parallel
+        variant of the model for candidates carrying a 'pipe_stages'
+        axis (the layer stack partitions [P, L/P] / [v, P, lc] at init,
+        so the flat loss/init cannot serve those candidates — e.g.
+        models.transformer.make_pipelined_loss_fn over a
+        pipeline_stages=P config). Without it, pipe candidates score
+        infeasible instead of raising mid-search."""
         self.base_config = dict(base_config)
         at_block = self.base_config.pop("autotuning", {}) or {}
         self.metric = at_block.get("metric", "throughput")
@@ -48,6 +58,7 @@ class Autotuner:
         self.param_init_fn = param_init_fn
         self.param_logical_specs = param_logical_specs
         self.make_batch = make_batch
+        self.make_pipelined = make_pipelined
         self.results: List[Dict[str, Any]] = []
 
     # ------------------------------------------------------------------
@@ -63,16 +74,41 @@ class Autotuner:
         n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(shapes))
         return {"num_params": n_params}
 
-    def _measure(self, config: Dict[str, Any], steps: int) -> Dict[str, Any]:
+    def _build_engine(self, config: Dict[str, Any],
+                      cand: Optional[Dict[str, Any]] = None):
+        """Construct the candidate's engine: the flat model, or (when
+        the candidate carries pipe_stages > 1) the pipelined variant
+        from the make_pipelined hook — pipeline depth is one more
+        search dimension, not a separate tuner."""
         import deepspeed_tpu as ds
 
-        t_build = time.perf_counter()
-        engine = ds.initialize(
+        P = int((cand or {}).get("pipe_stages") or 1)
+        V = int((cand or {}).get("interleave") or 1)
+        if P > 1:
+            if self.make_pipelined is None:
+                raise ValueError(
+                    "candidate has pipe_stages > 1 but the Autotuner "
+                    "was built without make_pipelined")
+            parts = self.make_pipelined(P, V)
+            return ds.initialize(
+                config,
+                loss_fn=parts["loss_fn"],
+                param_init_fn=parts["param_init_fn"],
+                param_logical_specs=parts.get("param_logical_specs"),
+                pipelined=True,
+                pipeline_virtual_stages=V,
+            )
+        return ds.initialize(
             config,
             loss_fn=self.loss_fn,
             param_init_fn=self.param_init_fn,
             param_logical_specs=self.param_logical_specs,
         )
+
+    def _measure(self, config: Dict[str, Any], steps: int,
+                 cand: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        t_build = time.perf_counter()
+        engine = self._build_engine(config, cand)
         batch = self.make_batch(engine.config.train_batch_size)
         engine.train_batch(batch)  # compile + warmup
         compile_s = time.perf_counter() - t_build
@@ -110,6 +146,16 @@ class Autotuner:
             cfg.setdefault("zero_optimization", {})["offload_optimizer"] = {
                 "device": cand["offload_optimizer"]
             }
+        if int(cand.get("pipe_stages") or 1) > 1:
+            # pipeline depth axis: carve a 'pipe' mesh dim; without an
+            # explicit candidate mesh the data axis absorbs the rest of
+            # the devices (wildcard). The engine is built through the
+            # make_pipelined hook (see _build_engine).
+            mesh = dict(cfg.get("mesh") or {})
+            mesh.setdefault("pipe", int(cand["pipe_stages"]))
+            if "data" not in mesh:
+                mesh["data"] = -1
+            cfg["mesh"] = mesh
         return cfg
 
     # ------------------------------------------------------------------
@@ -139,16 +185,9 @@ class Autotuner:
         aot_step_time_s / aot_exposed_comm_s (or aot_error).
         Infeasible candidates — failed compile, or an S004
         over-budget finding at the target — score 0."""
-        import deepspeed_tpu as ds
-
         exp = dict(cand)
         try:
-            engine = ds.initialize(
-                self._apply_candidate(cand),
-                loss_fn=self.loss_fn,
-                param_init_fn=self.param_init_fn,
-                param_logical_specs=self.param_logical_specs,
-            )
+            engine = self._build_engine(self._apply_candidate(cand), cand)
             batch = self.make_batch(engine.config.train_batch_size)
             rep = engine.sanitize(
                 batch, hbm_budget_bytes=hbm_budget_bytes,
@@ -202,6 +241,7 @@ class Autotuner:
         micro_batch_sizes: Sequence[int] = (1, 2),
         mesh_shapes: Optional[Sequence[Dict[str, int]]] = None,
         gas_values: Optional[Sequence[int]] = None,
+        pipe_configs: Optional[Sequence[Tuple[int, int]]] = None,
         top_k: int = 3,
         steps: int = 3,
         trial: bool = True,
@@ -209,23 +249,35 @@ class Autotuner:
         hbm_budget_bytes: Optional[int] = None,
     ) -> Dict[str, Any]:
         """AOT-first search: enumerate (zero stage x micro-batch x mesh
-        x gas) candidates (or take them verbatim), rank them all by the
-        S009 projection without executing a step, then trial-execute
-        only the top_k (trial=False skips even that and returns the
-        best projected config). Returns the tuned config dict; the
-        ranked ledger (including infeasibles) lands in
-        <results_dir>/exps.jsonl like every other strategy."""
+        x gas x pipeline depth) candidates (or take them verbatim),
+        rank them all by the S009 projection without executing a step,
+        then trial-execute only the top_k (trial=False skips even that
+        and returns the best projected config). Returns the tuned
+        config dict; the ranked ledger (including infeasibles) lands in
+        <results_dir>/exps.jsonl like every other strategy.
+
+        pipe_configs: (pipe_stages P, interleave V) pairs — pipeline
+        depth as one more search dimension (docs/pipeline.md; needs
+        the make_pipelined hook for P > 1 entries). For pipelined
+        candidates the gas axis IS the microbatch count M of the
+        (P, V, M) schedule triple, so the three pipeline knobs are all
+        searchable; candidates are scored by the same S009 projection
+        (the interleave bubble saving shows up as fewer wasted-FLOP
+        scan steps) and pruned by S004 exactly like every other axis."""
         if self.make_batch is None:
             raise ValueError("Autotuner needs make_batch to generate step data")
         if candidates is None:
             meshes = list(mesh_shapes) if mesh_shapes else [None]
             gases = list(gas_values) if gas_values else [None]
+            pipes = list(pipe_configs) if pipe_configs else [(1, 1)]
             candidates = [
                 {"zero_stage": st, "micro_batch_size": mb,
                  **({"mesh": m} if m is not None else {}),
-                 **({"gas": g} if g is not None else {})}
+                 **({"gas": g} if g is not None else {}),
+                 **({"pipe_stages": int(p), "interleave": int(v)}
+                    if int(p) > 1 else {})}
                 for st in zero_stages for mb in micro_batch_sizes
-                for m in meshes for g in gases
+                for m in meshes for g in gases for (p, v) in pipes
             ]
         ranked = self.aot_rank(candidates, target_devices=target_devices,
                                hbm_budget_bytes=hbm_budget_bytes)
@@ -266,7 +318,8 @@ class Autotuner:
     def _run_exp(self, cand: Dict[str, Any], steps: int) -> Dict[str, Any]:
         exp = dict(cand)
         try:
-            exp.update(self._measure(self._apply_candidate(cand), steps))
+            exp.update(self._measure(self._apply_candidate(cand), steps,
+                                     cand=cand))
             exp["ok"] = True
         except Exception as e:  # OOM / infeasible shape / bad combo
             exp.update({"ok": False, "error": f"{type(e).__name__}: {e}"})

@@ -118,6 +118,63 @@ def _guarded_collective(op: str, fn: Callable, replica_group: str,
             time.sleep(delay)
 
 
+def pipe_permute_tick(n_stages: int, step: Optional[int] = None,
+                      timeout_s: Optional[float] = None,
+                      retries: int = 2, backoff_s: float = 0.05):
+    """Host-side guard for the pipeline's stage-boundary comm.
+
+    The rotate itself is a compiler-scheduled collective-permute inside
+    the compiled step (runtime/pipe.py) — XLA collectives carry no
+    timeout and cannot be interposed per hop, so this tick is the
+    HOST-side representative of the step's stage-boundary traffic: it
+    fires the 'pipe.permute' fault point once per stage (ctx: stage,
+    step) under the same timeout/retry semantics as the
+    comm.collective guard, BEFORE the step dispatches. Chaos plans
+    target one stage's boundary with where={'stage': s}:
+
+      raise error='io'            transient boundary-link failure —
+                                  heals inside `retries` with
+                                  exponential backoff
+      delay value < deadline      a slow stage link; the seconds are
+                                  RETURNED per stage ({stage: s}) for
+                                  the caller to charge (virtual
+                                  clocks) or sleep (real runs) — the
+                                  per-stage skew feed
+                                  (monitor.training_events) reads them
+      delay value >= deadline     a wedged stage peer: deterministic
+                                  CollectiveTimeoutError carrying
+                                  op='pipe.permute' and the stage's
+                                  replica group, no real hang
+
+    Returns {stage: injected_delay_s} (empty outside chaos runs —
+    one global None-check per stage when disarmed)."""
+    if timeout_s is None:
+        timeout_s = collective_timeout_from_env()
+    delays: dict = {}
+    for s in range(int(n_stages)):
+        for attempt in range(retries + 1):
+            try:
+                act = fault_point("pipe.permute", stage=s, step=step)
+                if act is not None and act.kind == "delay":
+                    if timeout_s and act.value >= timeout_s:
+                        raise CollectiveTimeoutError(
+                            "pipe.permute", f"pipe-stage{s}", timeout_s)
+                    delays[s] = delays.get(s, 0.0) + float(act.value)
+                break
+            except CollectiveTimeoutError:
+                raise
+            except OSError as e:
+                if attempt == retries:
+                    raise
+                delay = backoff_s * (2 ** attempt)
+                logger.warning(
+                    f"pipe.permute guard at stage {s} hit transient "
+                    f"error ({e!r}); retry {attempt + 1}/{retries} in "
+                    f"{delay:.2f}s")
+                time.sleep(delay)
+    return delays
+
+
 def init_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,

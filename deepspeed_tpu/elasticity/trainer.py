@@ -47,9 +47,10 @@ from ..resilience.integrity import (
 from ..resilience.redundancy import (
     PeerRedundantStore,
     UnrecoverableWorldError,
-    assemble_tree,
+    assemble_state,
     export_rank_payloads,
     reshard_state,
+    stage_payload_bytes,
 )
 from ..utils.logging import log_dist
 from .agent import WorldDegradedError
@@ -101,9 +102,18 @@ class ElasticTrainer:
         self.world = int(world)
         self.generation = 0
         self.engine = self._launch(self.world)
+        # pipeline-parallel engines mirror a GRID of logical ranks:
+        # stage-major rank r = s*dp + d owns stage s's slice of ZeRO
+        # shard d, so a preempted stage HOST recovers from peer mirrors
+        # exactly like a ZeRO rank (docs/pipeline.md). The pipe degree
+        # is a property of the model config — make_engine(world) keeps
+        # it fixed while the dp world resizes.
+        self.pipe_world = int(self.engine.mesh.shape.get("pipe", 1))
         self._past_mirror_integrity = 0  # failures of replaced stores
+        self.stage_mirror_bytes = 0
+        store_world = self.world * self.pipe_world
         self.store = PeerRedundantStore(
-            self.world, spare=min(self.spare, self.world - 1))
+            store_world, spare=min(self.spare, store_world - 1))
 
         # -- SDC guardian (docs/fault_tolerance.md SDC section) --------
         # guardian: an AnomalyDetector, a dict of its kwargs (plus
@@ -160,8 +170,9 @@ class ElasticTrainer:
         store's digest-mismatch count into the trainer-lifetime
         `mirror_integrity_failures` metric."""
         self._past_mirror_integrity += self.store.integrity_failures
+        store_world = world * self.pipe_world
         self.store = PeerRedundantStore(
-            world, spare=min(self.spare, world - 1))
+            store_world, spare=min(self.spare, store_world - 1))
 
     @property
     def mirror_integrity_failures(self) -> int:
@@ -188,6 +199,8 @@ class ElasticTrainer:
         payloads, dims = export_rank_payloads(self.engine)
         shared = {"loader": self.loader.state_dict(), "dims": dims}
         self.store.snapshot(self.engine.global_steps, payloads, shared)
+        if self.pipe_world > 1:
+            self.stage_mirror_bytes += stage_payload_bytes(payloads, dims)
         from .. import comm
 
         # mirrors must be exchanged before the next step may commit —
@@ -220,7 +233,15 @@ class ElasticTrainer:
         t0 = self.clock()
         before = self.engine.global_steps
         self.store.lose(lost_ranks)
-        new_world = self._compatible_world(self.world - len(set(lost_ranks)))
+        # lost ranks are LOGICAL grid ranks (stage-major s*dp + d under
+        # pipeline parallelism; plain ZeRO ranks otherwise). The dp
+        # world shrinks by the number of distinct shard COLUMNS that
+        # lost a host — the pipe degree is fixed by the model config,
+        # so a dead stage host retires its whole dp column's capacity
+        # while every surviving (stage, shard) slice still feeds the
+        # reconstruction.
+        dp_lost = {int(r) % self.world for r in set(lost_ranks)}
+        new_world = self._compatible_world(self.world - len(dp_lost))
         try:
             step, payloads, shared = self.store.reconstruct()
         except UnrecoverableWorldError:
@@ -228,10 +249,7 @@ class ElasticTrainer:
                 raise
             self._disk_fallback(new_world)
             return
-        dims = shared["dims"]
-        full = {k: assemble_tree({r: payloads[r][k] for r in payloads},
-                                 dims[k])
-                for k in dims}
+        full = assemble_state(payloads, shared["dims"])
         self.generation += 1
         self.world = new_world
         self.engine = self._launch(new_world)
@@ -398,10 +416,7 @@ class ElasticTrainer:
                 raise
             self._disk_fallback(self.world)
             return
-        dims = shared["dims"]
-        full = {k: assemble_tree({r: payloads[r][k] for r in payloads},
-                                 dims[k])
-                for k in dims}
+        full = assemble_state(payloads, shared["dims"])
         # same world, same mesh: lay the verified state straight onto
         # the live engine (no rebuild, no recompile) and rewind
         reshard_state(self.engine, full, global_steps=step)
@@ -482,4 +497,10 @@ class ElasticTrainer:
         }
         for r, n in sorted(self.straggler_ranks.items()):
             out[f"rank{r}/straggler_flags"] = float(n)
+        if self.pipe_world > 1:
+            # pipeline feed: the stage-mirror byte counter plus the
+            # grid geometry (the bubble/skew half of the pipeline feed
+            # lives in monitor.training_events, which reads the engine)
+            out["pipe_world"] = float(self.pipe_world)
+            out["stage_mirror_bytes"] = float(self.stage_mirror_bytes)
         return out

@@ -205,6 +205,65 @@ def serving_events(scheduler, step: int,
             for name, value in sorted(metrics.items())]
 
 
+def training_events(engine, step: int, trainer=None,
+                    prefix: str = "train/pipeline") -> List[Event]:
+    """Pipeline feed for a training engine (docs/pipeline.md) — same
+    event contract as serving_events:
+
+        monitor.write_events(training_events(engine, step))
+        monitor.write_events(training_events(engine, step, trainer))
+
+    Empty for non-pipelined engines. For a pipelined one, emits the
+    schedule accounting of engine.pipeline_schedule_stats():
+    `stages`/`interleave`/`microbatches`/`schedule_steps` and
+    `bubble_fraction` — the MEASURED bubble replayed from the exact
+    iteration counts the compiled scan runs — next to the two closed
+    forms it is gated against (`bubble_closed_form` =
+    (P-1)/(V*M+P-1), `bubble_noninterleaved_bound` = (P-1)/(M+P-1)).
+
+    Per-stage stage-boundary skew rides the 'pipe.permute' guard
+    (comm.pipe_permute_tick): `stage<s>/boundary_delay_s` is the
+    injected/observed extra time charged to stage s's boundary comm
+    and `stage_time_skew` the (median step + worst stage delay) /
+    median step ratio — 1.0 when no stage lags.
+
+    With an ElasticTrainer passed, the PR-8 per-rank straggler flags
+    fold into the stage view: `stage<s>/straggler_flags` groups the
+    trainer's logical-rank flags by the rank's stage (stage-major
+    grid, s = rank // dp) and `straggler_stage` names the worst stage
+    (-1 when none flagged)."""
+    stats = engine.pipeline_schedule_stats() if hasattr(
+        engine, "pipeline_schedule_stats") else None
+    if stats is None:
+        return []
+    events: List[Event] = [(f"{prefix}/{name}", float(value), step)
+                           for name, value in sorted(stats.items())]
+    delays = dict(getattr(engine, "pipe_stage_delay_s", {}) or {})
+    for s, d in sorted(delays.items()):
+        events.append((f"{prefix}/stage{int(s)}/boundary_delay_s",
+                       float(d), step))
+    skew = 1.0
+    if trainer is not None and getattr(trainer, "_step_times", None):
+        import numpy as np
+
+        med = float(np.median(trainer._step_times))
+        if med > 0 and delays:
+            steps_run = max(1, len(trainer._step_times))
+            skew = (med + max(delays.values()) / steps_run) / med
+    events.append((f"{prefix}/stage_time_skew", float(skew), step))
+    if trainer is not None:
+        dp = max(1, int(getattr(trainer, "world", 1)))
+        by_stage: dict = {}
+        for r, n in getattr(trainer, "straggler_ranks", {}).items():
+            by_stage[int(r) // dp] = by_stage.get(int(r) // dp, 0) + int(n)
+        for s, n in sorted(by_stage.items()):
+            events.append((f"{prefix}/stage{s}/straggler_flags",
+                           float(n), step))
+        worst = max(by_stage, key=by_stage.get) if by_stage else -1
+        events.append((f"{prefix}/straggler_stage", float(worst), step))
+    return events
+
+
 def training_resilience_events(trainer, step: int,
                                prefix: str = "train/resilience") -> List[Event]:
     """Turn an ElasticTrainer's resilience counters
@@ -228,7 +287,16 @@ def training_resilience_events(trainer, step: int,
     nothing committed, EMA untouched); `mirror_integrity_failures` —
     peer-mirror copies whose blake2b digest failed at reconstruct
     (each fell over to the next holder; a nonzero count with
-    disk_restores still 0 is the fallover WORKING)."""
+    disk_restores still 0 is the fallover WORKING).
+
+    Pipeline feed (docs/pipeline.md; pipelined engines only):
+    `pipe_world` — the stage degree of the mirrored logical-rank grid
+    (stage-major rank = stage*dp + shard) — and `stage_mirror_bytes`
+    — cumulative bytes of pipeline-STAGE-sliced leaves (the layer
+    stacks' stage dim) shipped through mirror rounds, the stage half
+    of the mirror traffic next to `bytes_mirrored`'s total. The
+    schedule/bubble half of the pipeline feed is
+    monitor.training_events."""
     metrics = trainer.resilience_metrics()
     return [(f"{prefix}/{name}", float(value), step)
             for name, value in sorted(metrics.items())]

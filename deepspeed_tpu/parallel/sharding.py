@@ -130,6 +130,35 @@ def tree_logical_to_mesh(
     )
 
 
+def pipe3d_specs(param_logical_specs, shapes, mesh: Mesh, zero_config,
+                 rules: Optional[Dict[str, MeshAxes]] = None):
+    """One-call 3D (pipeline x ZeRO x TP) spec derivation — the
+    combined-layout authority the interleaved pipeline composes with
+    (docs/pipeline.md).
+
+    Layer 1 — the rules table places logical names on mesh axes:
+    'pipe_stage' rides 'pipe' (the stage dim of a [P, L/P, ...] or
+    [v, P, lc, ...] stack), TP names ('heads', 'mlp', ...) ride
+    'model', 'pipe_virtual' stays replicated (every stage holds all v
+    of its own chunks). Layer 2 — runtime/zero.py adds ZeRO sharding
+    on top: storage specs (stage-3 param sharding over the data axes),
+    optimizer-state specs (stage >= 1), and the gradient-constraint
+    specs. One mesh, three orthogonal axis families; XLA derives the
+    stage collective-permute, the TP psums, and the ZeRO
+    gather/reduce-scatter pair from these specs alone.
+
+    Returns {"tp": ..., "storage": ..., "opt": ..., "grads": ...}
+    (pytrees of PartitionSpec matching `shapes`)."""
+    from ..runtime import zero
+
+    tp = tree_logical_to_mesh(
+        param_logical_specs, make_rules(rules), mesh, shapes=shapes)
+    storage = zero.derive_param_storage_specs(tp, shapes, mesh, zero_config)
+    opt = zero.derive_optimizer_specs(tp, shapes, mesh, zero_config)
+    grads = zero.derive_grad_specs(storage, opt, zero_config)
+    return {"tp": tp, "storage": storage, "opt": opt, "grads": grads}
+
+
 def tree_shardings(specs, mesh: Mesh):
     """PartitionSpec pytree → NamedSharding pytree."""
     return jax.tree.map(

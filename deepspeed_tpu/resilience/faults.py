@@ -51,6 +51,21 @@ Fault points registered across the tree (ctx keys in parens):
                                   timeout = deterministic
                                   CollectiveTimeoutError without a
                                   real hang
+  pipe.permute        (stage,     stage-boundary pipeline comm guard
+                       step)      (comm/comm.py pipe_permute_tick,
+                                  fired once per stage before every
+                                  pipelined step dispatch — the host-
+                                  side representative of the compiled
+                                  collective-permute ring) — raise
+                                  error='io' = transient boundary-link
+                                  failure (bounded retry heals);
+                                  delay < the comm deadline = a slow
+                                  stage link charged to that stage's
+                                  skew counter (engine.
+                                  pipe_stage_delay_s); delay >= the
+                                  deadline = a wedged stage peer
+                                  (deterministic
+                                  CollectiveTimeoutError)
   dataloader.fetch    (epoch,     batch fetch (runtime/dataloader.py,
                        index)     BEFORE the loader position advances
                                   so a retry re-fetches the same

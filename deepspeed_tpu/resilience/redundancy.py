@@ -40,7 +40,8 @@ from ..utils.logging import log_dist
 
 __all__ = [
     "RedundancyError", "UnrecoverableWorldError", "PeerRedundantStore",
-    "slice_tree", "assemble_tree", "engine_shard_dims",
+    "slice_tree", "assemble_tree", "assemble_state", "split_dims",
+    "stage_payload_bytes", "engine_shard_dims",
     "export_rank_payloads", "reshard_state",
 ]
 
@@ -113,6 +114,74 @@ def assemble_tree(payloads: Dict[int, Any], dims):
             out.append(np.concatenate(
                 [leaves[r][i] for r in range(world)], axis=int(d)))
     return jax.tree.unflatten(jax.tree.structure(dims), out)
+
+
+def split_dims(dims):
+    """(zero_dims_by_key, pipe_dims_by_key | None, pipe_world,
+    dp_world | None) for both dims formats: the legacy flat
+    {'params'/'master'/'opt': dim-tree} ZeRO contract, and the
+    pipeline grid format engine_shard_dims emits under a pipe > 1
+    mesh ({'zero': ..., 'pipe': ..., 'pipe_world': P, 'dp_world': d})."""
+    if isinstance(dims, dict) and "pipe_world" in dims:
+        return (dims["zero"], dims["pipe"], int(dims["pipe_world"]),
+                int(dims["dp_world"]))
+    return dims, None, 1, None
+
+
+def _zdims_without_pipe_overlap(zdims, pdims):
+    """Zero-dim tree with any leaf whose zero dim COINCIDES with its
+    pipe dim masked to -1 (cannot happen for pipe-led stage dims —
+    zero never lands on a dim whose local extent is 1 — but the guard
+    keeps a future layout change safe rather than silently
+    double-slicing one dim)."""
+    import jax
+
+    return jax.tree.map(
+        lambda z, p: -1 if (int(z) >= 0 and int(z) == int(p)) else int(z),
+        zdims, pdims)
+
+
+def assemble_state(payloads: Dict[int, Any], dims) -> Dict[str, Any]:
+    """Full host state from a COMPLETE logical-rank payload map, either
+    dims format. Legacy (pipe_world == 1): rank r is a ZeRO rank and
+    leaves concatenate along their zero dim. Pipeline grid: logical
+    rank r = s*dp + d (stage-major) carries stage s's slice of ZeRO
+    shard d — zero assembles within each stage row first, then the
+    stage rows concatenate along each leaf's pipe dim."""
+    zdims, pdims, pipe_world, dp = split_dims(dims)
+    if pipe_world <= 1:
+        return {k: assemble_tree({r: payloads[r][k] for r in payloads},
+                                 zdims[k])
+                for k in zdims}
+    out = {}
+    for k in zdims:
+        zmask = _zdims_without_pipe_overlap(zdims[k], pdims[k])
+        rows = {}
+        for s in range(pipe_world):
+            rows[s] = assemble_tree(
+                {d: payloads[s * dp + d][k] for d in range(dp)}, zmask)
+        out[k] = assemble_tree(rows, pdims[k])
+    return out
+
+
+def stage_payload_bytes(payloads: Dict[int, Any], dims) -> int:
+    """Bytes of PIPELINE-STAGE-sliced leaves across one payload map —
+    the stage-mirror traffic counter of
+    monitor.training_resilience_events (0 under a pipe-less mesh,
+    where no leaf carries a stage dim)."""
+    import jax
+
+    _zdims, pdims, pipe_world, _dp = split_dims(dims)
+    if pipe_world <= 1 or pdims is None:
+        return 0
+    total = 0
+    for payload in payloads.values():
+        for k, tree in payload.items():
+            leaves = jax.tree.leaves(tree)
+            dls = jax.tree.leaves(pdims[k])
+            total += sum(int(x.nbytes) for x, d in zip(leaves, dls)
+                         if int(d) >= 0)
+    return int(total)
 
 
 # ---------------------------------------------------------------------------
@@ -292,10 +361,21 @@ class PeerRedundantStore:
 # ---------------------------------------------------------------------------
 
 def engine_shard_dims(engine) -> Dict[str, Any]:
-    """Per-leaf ZeRO-sharded dims for a fused-path engine's state trees
+    """Per-leaf sharded dims for a fused-path engine's state trees
     (params / master / opt), the slicing contract for its shards. The
     worker-major 1-bit/0-1-Adam layouts and the host/NVMe offload tiers
-    hold state outside the fused TrainState — not covered here."""
+    hold state outside the fused TrainState — not covered here.
+
+    Under a pipe-less mesh: the legacy flat ZeRO format
+    ({'params'/'master'/'opt': dim-tree}). Under pipeline parallelism
+    (mesh pipe > 1): the GRID format — logical rank r = s*dp + d is a
+    stage host holding stage s's slice of ZeRO shard d, so each state
+    key carries a zero-dim tree AND a pipe-dim tree
+    (runtime/zero.axis_sharded_dims: the leading-'pipe' stage dim of
+    the [P, L/P, ...] / [v, P, lc, ...] layer stacks) plus the two
+    world factors. A preempted stage host then recovers from peers
+    exactly like a ZeRO rank: its (stage, shard) slice survives on its
+    mirror holders (docs/pipeline.md)."""
     import jax
 
     from ..runtime import zero
@@ -316,7 +396,20 @@ def engine_shard_dims(engine) -> Dict[str, Any]:
         dims["master"] = leaf_dims
     if engine.state.opt is not None:
         dims["opt"] = {k: leaf_dims for k in engine.state.opt}
-    return dims
+    pipe_world = int(engine.mesh.shape.get("pipe", 1))
+    if pipe_world <= 1:
+        return dims
+    pipe_param = zero.axis_sharded_dims(
+        engine.param_specs, shapes, engine.mesh, axis="pipe")
+    pipe_opt = zero.axis_sharded_dims(
+        engine.opt_specs, shapes, engine.mesh, axis="pipe")
+    pipe: Dict[str, Any] = {"params": pipe_param}
+    if engine.state.master is not None:
+        pipe["master"] = pipe_opt
+    if engine.state.opt is not None:
+        pipe["opt"] = {k: pipe_opt for k in engine.state.opt}
+    return {"zero": dims, "pipe": pipe, "pipe_world": pipe_world,
+            "dp_world": int(engine.dp_world_size)}
 
 
 def export_rank_payloads(engine) -> Tuple[Dict[int, Any], Dict[str, Any]]:
@@ -327,17 +420,33 @@ def export_rank_payloads(engine) -> Tuple[Dict[int, Any], Dict[str, Any]]:
     import jax
 
     dims = engine_shard_dims(engine)
+    zdims, pdims, pipe_world, _ = split_dims(dims)
     world = int(engine.dp_world_size)
     host: Dict[str, Any] = {
         "params": jax.device_get(engine.state.params)}
-    if "master" in dims:
+    if "master" in zdims:
         host["master"] = jax.device_get(engine.state.master)
-    if "opt" in dims:
+    if "opt" in zdims:
         host["opt"] = jax.device_get(engine.state.opt)
-    payloads = {
-        r: {k: slice_tree(host[k], dims[k], r, world) for k in dims}
-        for r in range(world)
-    }
+    if pipe_world <= 1:
+        payloads = {
+            r: {k: slice_tree(host[k], zdims[k], r, world) for k in zdims}
+            for r in range(world)
+        }
+        return payloads, dims
+    # pipeline grid: logical rank s*dp + d owns stage s's slice of ZeRO
+    # shard d — pipe slice first (the leading stage dim), zero slice
+    # within it (the two dims are distinct by construction; the overlap
+    # mask guards a future layout change)
+    payloads = {}
+    for s in range(pipe_world):
+        for d in range(world):
+            payloads[s * world + d] = {
+                k: slice_tree(
+                    slice_tree(host[k], pdims[k], s, pipe_world),
+                    _zdims_without_pipe_overlap(zdims[k], pdims[k]),
+                    d, world)
+                for k in zdims}
     return payloads, dims
 
 

@@ -222,25 +222,35 @@ class DeepSpeedTPUEngine:
             # TrainState (state.master/opt stay None)
             self._use_master = not self._offload_nvme
 
-        # --- sharding derivation (the ZeRO core) -------------------------
+        # --- sharding derivation (the ZeRO core; pipeline x ZeRO x TP
+        # compose through one emitter, parallel/sharding.pipe3d_specs) --
         shapes = jax.tree.map(lambda p: tuple(p.shape), params)
+        zcfg = config.zero_optimization
         if param_logical_specs is None:
             tp_specs = jax.tree.map(lambda p: P(), params)
+            combined = {
+                "tp": tp_specs,
+                "storage": zero.derive_param_storage_specs(
+                    tp_specs, shapes, self.mesh, zcfg),
+                "opt": zero.derive_optimizer_specs(
+                    tp_specs, shapes, self.mesh, zcfg),
+            }
+            combined["grads"] = zero.derive_grad_specs(
+                combined["storage"], combined["opt"], zcfg)
         else:
-            tp_specs = shd.tree_logical_to_mesh(
-                param_logical_specs, shd.make_rules(rules), self.mesh, shapes=shapes
-            )
-        zcfg = config.zero_optimization
-        self.tp_specs = tp_specs
-        self.param_specs = zero.derive_param_storage_specs(tp_specs, shapes, self.mesh, zcfg)
-        self.opt_specs = zero.derive_optimizer_specs(tp_specs, shapes, self.mesh, zcfg)
-        self.grad_specs = zero.derive_grad_specs(self.param_specs, self.opt_specs, zcfg)
+            combined = shd.pipe3d_specs(
+                param_logical_specs, shapes, self.mesh, zcfg, rules)
+        self.tp_specs = combined["tp"]
+        self.param_specs = combined["storage"]
+        self.opt_specs = combined["opt"]
+        self.grad_specs = combined["grads"]
         zero.validate_no_conflicts(self.param_specs)
         zero.validate_no_conflicts(self.opt_specs)
         # ZeRO++ qwZ: int8-quantized weight all-gather for zero-sharded
         # leaves (ref: zeropp.md qwZ; partition_parameters.py:725).
         self._qwz_apply = (
-            zero.make_qwz_gather(self.param_specs, tp_specs, shapes, self.mesh)
+            zero.make_qwz_gather(self.param_specs, self.tp_specs, shapes,
+                                 self.mesh)
             if zcfg.zero_quantized_weights
             else None
         )
@@ -407,6 +417,10 @@ class DeepSpeedTPUEngine:
         # (elasticity/trainer.py) gates on it staying zero
         self.fault_delay_s = 0.0
         self.disk_restores = 0
+        # per-stage injected boundary-comm delay (the 'pipe.permute'
+        # guarded fault point, comm.pipe_permute_tick) — the per-stage
+        # step-time-skew feed of monitor.training_events reads it
+        self.pipe_stage_delay_s: Dict[int, float] = {}
 
         # elastic-agent integration (ref: elasticity/elastic_agent.py:28
         # DSElasticAgent): when launched under run_elastic, beat the
@@ -1597,6 +1611,34 @@ class DeepSpeedTPUEngine:
         d, self.fault_delay_s = self.fault_delay_s, 0.0
         return d
 
+    def pipeline_schedule_stats(self) -> Optional[Dict[str, float]]:
+        """Schedule accounting of THIS engine's pipeline (None when the
+        loss is not pipelined): stage count P, interleave degree V,
+        microbatch count M (the gradient-accumulation depth — the
+        pipelined loss consumes all M in one call), the MEASURED bubble
+        fraction replayed from the exact iteration counts the compiled
+        scan runs (runtime/pipe.simulate_schedule), and the two closed
+        forms it is gated against — (P-1)/(V*M+P-1) for this schedule
+        and the non-interleaved (P-1)/(M+P-1) bound. The
+        monitor.training_events pipeline feed emits these."""
+        if not self.pipelined:
+            return None
+        from .pipe import bubble_fraction, simulate_schedule
+
+        P = int(self.mesh.shape.get("pipe", 1))
+        V = self._pipe_virtual_stages()
+        M = int(self.config.gradient_accumulation_steps or 1)
+        sim = simulate_schedule(M, P, V)
+        return {
+            "stages": float(P),
+            "interleave": float(V),
+            "microbatches": float(M),
+            "schedule_steps": float(sim["total_steps"]),
+            "bubble_fraction": float(sim["bubble_fraction"]),
+            "bubble_closed_form": bubble_fraction(M, P, V),
+            "bubble_noninterleaved_bound": bubble_fraction(M, P, 1),
+        }
+
     def _dispatch_step(self, batch) -> Dict[str, Any]:
         # chaos fault point 'engine.step' fires BEFORE any dispatch: an
         # injected preemption raises with no state half-mutated (the
@@ -1606,6 +1648,20 @@ class DeepSpeedTPUEngine:
                           step=self.global_steps + 1)
         if act is not None and act.kind == "delay":
             self.fault_delay_s += act.value
+        if self.pipelined and self.mesh.shape.get("pipe", 1) > 1:
+            # stage-boundary comm guard: the host-side representative
+            # of this step's collective-permute ring (comm/comm.py
+            # pipe_permute_tick) — training-chaos plans target one
+            # stage's boundary; injected delays accrue per stage AND to
+            # the step's fault_delay_s
+            from ..comm.comm import pipe_permute_tick
+
+            for s, d in pipe_permute_tick(
+                    int(self.mesh.shape["pipe"]),
+                    step=self.global_steps + 1).items():
+                self.pipe_stage_delay_s[s] = (
+                    self.pipe_stage_delay_s.get(s, 0.0) + d)
+                self.fault_delay_s += d
         metrics = self._dispatch_step_inner(batch)
         # chaos fault point 'engine.grads' fires AFTER the compiled
         # step, BEFORE the caller can commit anything: kind='corrupt'

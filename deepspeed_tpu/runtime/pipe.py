@@ -73,7 +73,7 @@ def num_stages(stage_params) -> int:
 
 
 def partition_layers(stacked_params, n_stages: int, method: str = "uniform",
-                     virtual: int = 1):
+                     virtual: int = 1, interleave: Optional[int] = None):
     """[L, ...] layer-stacked pytree → stage-partitioned.
 
     The LayerSpec partitioner analog (ref: runtime/pipe/module.py
@@ -88,7 +88,17 @@ def partition_layers(stacked_params, n_stages: int, method: str = "uniform",
     on physical stage p at round r, the Megatron interleaved placement
     (ref: runtime/pipe/module.py interleave docs; bubble shrinks ~v, see
     pipeline_apply_circular).
+
+    `interleave` is the documented name for the virtual-stage degree
+    (docs/pipeline.md); it is an alias of `virtual` and the two may not
+    disagree.
     """
+    if interleave is not None:
+        if virtual not in (1, int(interleave)):
+            raise ValueError(
+                f"interleave={interleave} conflicts with virtual={virtual}"
+            )
+        virtual = int(interleave)
     if method != "uniform":
         raise NotImplementedError(
             f"partition method '{method}' — scanned layer stacks are "
@@ -221,18 +231,68 @@ def pipeline_apply(
 
 def circular_schedule_len(M: int, n_stage: int, virtual: int) -> int:
     """Scan steps the circular schedule runs: microbatches enter the
-    P-slot ring in waves of P, each occupying its slot for v*P steps;
-    the last microbatch exits at the START of step v*M + P - 1 (at
-    M = k*P), so the scan runs T = v*P*ceil(M/P) + P steps of which
-    T - 1 compute.
+    P-slot ring in waves of P, each occupying its slot for v*P
+    chunk-steps; a microbatch's LAST chunk runs at slot P-1, where its
+    output is collected post-compute — no wraparound rotate, so the
+    scan runs T = v*P*ceil(M/P) + P - 1 steps, every one of them
+    computing.
 
     Bubble math (the point of the interleave, ref: Megatron interleaved
     schedule / runtime/pipe/module.py docs): one chunk-step costs
     tau/v (a stage's per-microbatch work tau split over v rounds), so
     wall-clock at M = k*P is (v*M + P - 1) * tau/v = M*tau +
     (P-1)*tau/v — the (P-1)*tau warmup/drain bubble of the plain
-    schedule divided by v."""
-    return virtual * n_stage * -(-M // n_stage) + n_stage
+    schedule divided by v, i.e. bubble fraction (P-1)/(v*M + P-1).
+    The SPMD dual of that wall-clock win is wasted-FLOP reduction:
+    idle-slot garbage compute drops from (P-1)·L layer-applications
+    per wave (plain) to (P-1)·L/v (interleaved)."""
+    return virtual * n_stage * -(-M // n_stage) + n_stage - 1
+
+
+def bubble_fraction(M: int, n_stage: int, virtual: int = 1) -> float:
+    """Closed-form pipeline bubble fraction: the idle share of every
+    stage's timeline. Plain (v=1): (P-1)/(M+P-1); interleaved:
+    (P-1)/(v*M+P-1) at M = k*P — the Megatron interleaved-1F1B bound
+    the ds_pipe gate pins the measured schedule against."""
+    return (n_stage - 1) / (virtual * M + n_stage - 1)
+
+
+def simulate_schedule(M: int, n_stage: int, virtual: int = 1):
+    """MEASURED schedule accounting from iteration counts: replay the
+    exact entry/exit calendar the compiled scan runs (the same rotation
+    arithmetic, host-side) and count live vs total slot-steps. Returns
+    {total_steps, slot_steps, live_slot_steps, bubble_fraction,
+    wall_tau} where bubble_fraction = 1 - live/total slot-steps (each
+    live chunk-step is useful work; everything else is warmup/drain
+    garbage whose output is discarded) and wall_tau is the wall-clock
+    in units of one stage's full per-microbatch work tau
+    (total_steps / v). Equals the closed form at M = k*P; strictly
+    worse when the last wave is padded."""
+    P, v = int(n_stage), int(virtual)
+    if v <= 1:
+        T = M + P - 1
+        live = M * P
+        total = T * P
+        return {
+            "total_steps": T, "slot_steps": total,
+            "live_slot_steps": live,
+            "bubble_fraction": (total - live) / total,
+            "wall_tau": float(T),
+        }
+    T = circular_schedule_len(M, P, v)
+    # occupancy replay: slot s is live at step t iff some microbatch m
+    # entered it at e = v*P*(m//P) + m%P and t - e in [0, v*P)
+    live = 0
+    for m in range(M):
+        e = v * P * (m // P) + (m % P)
+        live += min(v * P, T - e)
+    total = T * P
+    return {
+        "total_steps": T, "slot_steps": total,
+        "live_slot_steps": live,
+        "bubble_fraction": (total - live) / total,
+        "wall_tau": T / v,
+    }
 
 
 def pipeline_apply_circular(
@@ -252,7 +312,10 @@ def pipeline_apply_circular(
     Megatron interleaved-1F1B bubble reduction expressed as SPMD
     (ref: runtime/pipe/schedule.py TrainSchedule + Megatron interleaving;
     here the schedule is the rotation arithmetic, not an instruction
-    list).
+    list). A microbatch's output is collected at slot P-1 the moment its
+    LAST chunk computes (no wraparound rotate back to slot 0), so the
+    scan runs exactly circular_schedule_len = v*P*ceil(M/P) + P - 1
+    steps and the bubble fraction is (P-1)/(v*M + P-1) at M = k*P.
 
     stage_fn(stage_chunks, carry, mb_key, stage_idx, round) -> carry':
     applies chunk `round` of this stage's [v, lc, ...] local stack.
@@ -267,16 +330,19 @@ def pipeline_apply_circular(
     T = circular_schedule_len(M, n_stage, v)
 
     # Static entry/exit calendar: microbatch m enters stage 0 at
-    # t = v*P*(m//P) + m%P and exits (arrives back at slot 0 with
-    # round == v) exactly v*P steps later.
+    # t = v*n_stage*(m//n_stage) + m%n_stage; its LAST chunk runs at
+    # slot n_stage-1 exactly v*n_stage - 1 steps later, where the
+    # output is read post-compute (pre-rotate) — the final wraparound
+    # rotate of the old calendar was a whole wasted stage-step.
     import numpy as np
 
     entry_step = np.full((T,), Mp, np.int32)   # Mp = "no entry" sentinel
     exit_step = np.full((T,), -1, np.int32)
     for m in range(Mp):
         e = v * n_stage * (m // n_stage) + (m % n_stage)
-        entry_step[e] = m
-        xe = e + v * n_stage
+        if e < T:
+            entry_step[e] = m
+        xe = e + v * n_stage - 1
         if xe < T and m < M:
             exit_step[xe] = m
     entry_idx = jnp.asarray(entry_step)
@@ -327,21 +393,6 @@ def pipeline_apply_circular(
         h_state, k_state, rounds, out_acc = carry
         ent, ext = entry_idx[t_idx], exit_idx[t_idx]
         done = rounds[0] >= v
-        # Exit: a slot arriving at stage 0 with round == v carries a
-        # finished microbatch (predicated no-op write when ext < 0).
-        out_acc = jax.tree.map(
-            lambda acc, s: jax.lax.dynamic_update_index_in_dim(
-                acc,
-                jnp.where(
-                    done & (ext >= 0),
-                    s[0],
-                    jax.lax.dynamic_index_in_dim(acc, jnp.maximum(ext, 0), 0,
-                                                 keepdims=False),
-                ),
-                jnp.maximum(ext, 0), 0,
-            ),
-            out_acc, h_state,
-        )
         # LoadMicroBatch into the freed slot (ent == Mp means no entry
         # this step; the slot stays marked empty).
         fresh = jax.tree.map(
@@ -367,6 +418,24 @@ def pipeline_apply_circular(
                 live.reshape((n_stage,) + (1,) * (n.ndim - 1)), n, o
             ),
             new_state, h_state,
+        )
+        # Exit: the slot at stage P-1 on its LAST round just computed a
+        # finished microbatch — collect it post-compute, pre-rotate
+        # (predicated no-op write when ext < 0), saving the wraparound
+        # rotate and the whole stage-step it used to cost.
+        take = (ext >= 0) & (rounds[n_stage - 1] == v - 1)
+        out_acc = jax.tree.map(
+            lambda acc, s: jax.lax.dynamic_update_index_in_dim(
+                acc,
+                jnp.where(
+                    take,
+                    s[n_stage - 1],
+                    jax.lax.dynamic_index_in_dim(acc, jnp.maximum(ext, 0), 0,
+                                                 keepdims=False),
+                ),
+                jnp.maximum(ext, 0), 0,
+            ),
+            out_acc, new_state,
         )
         # Rotate one stage; the slot wrapping P-1 -> 0 advances a round.
         h_state = constrain(jax.tree.map(

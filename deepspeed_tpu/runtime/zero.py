@@ -189,6 +189,34 @@ def zero_sharded_dims(store_specs, gathered_specs, shapes, mesh: Mesh):
     )
 
 
+def axis_sharded_dims(specs, shapes, mesh: Mesh, axis: str = "pipe"):
+    """Pytree of per-leaf dim indices whose spec entry is LED by `axis`
+    (-1 = the leaf is not sharded over it). The stage-slicing contract
+    of the pipeline peer-redundancy path (resilience/redundancy.py):
+    stage s of a pipe world of P owns [s*d/P, (s+1)*d/P) along this dim
+    — exactly the XLA shard geometry of a leading-'pipe' PartitionSpec
+    entry ([P, L/P, ...] plain stacks: dim 0; [v, P, lc, ...] circular
+    stacks: dim 1). Dims where `axis` is a trailing co-axis (e.g. vocab
+    over ('model', 'pipe')) are NOT stage-sliced: the slice order would
+    interleave with the major axis, so those leaves stay whole in every
+    payload — conservative, always reassemblable."""
+    if mesh.shape.get(axis, 1) <= 1:
+        return jax.tree.map(
+            lambda s, shp: -1, specs, shapes,
+            is_leaf=lambda x: isinstance(x, P))
+
+    def dim_of(spec, shp):
+        dims = _spec_dims(spec, len(shp))
+        for i, d in enumerate(dims):
+            ax = _axes_of(d)
+            if ax and ax[0] == axis:
+                return i
+        return -1
+
+    return jax.tree.map(
+        dim_of, specs, shapes, is_leaf=lambda x: isinstance(x, P))
+
+
 def make_qwz_gather(store_specs, gathered_specs, shapes, mesh: Mesh):
     """ZeRO++ qwZ: int8-quantized weight all-gather.
 

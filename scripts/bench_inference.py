@@ -10,10 +10,13 @@ dominated by the ~90ms host-readback round trip of the logits, which
 real deployments don't pay per token). Prints one JSON line."""
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
@@ -101,6 +104,39 @@ def main():
     eng.put([0], [np.asarray([2])])
     put_ms = (time.perf_counter() - t0) * 1e3
 
+    # int8 per-block-quantized KV decode (docs/paged_attention.md):
+    # time the same-width engine decode step over the quantized pool
+    # and record the tok/s delta vs the bf16 path AND vs the committed
+    # 18.6k b64 bf16 device trajectory number (ROADMAP item 1's
+    # leftover device-bench datum; on CPU the delta-vs-bf16 is the
+    # meaningful signal and the trajectory ratio is reported for the
+    # device-bench run to overwrite)
+    BF16_TRAJECTORY_TOK_S = 18600.0
+    del eng
+    eng_q = init_inference(
+        params, mcfg,
+        {"max_batch_size": batch, "max_seq_len": 2048,
+         "kv_block_size": 128, "num_kv_blocks": blocks,
+         "max_tracked_sequences": batch + 1, "kv_cache_dtype": "int8"},
+    )
+    NBq = eng_q.config.blocks_per_seq
+    toks_q = eng_q._dev(rng.integers(
+        0, mcfg.vocab_size, batch).astype(np.int32))
+    tables_q = eng_q._dev(
+        rng.integers(0, blocks, (batch, NBq)).astype(np.int32))
+    ctx_q = eng_q._dev(np.full((batch,), ctx_len, np.int32))
+    dq = eng_q._decode_fn(batch, True)
+    cache_q = eng_q.cache
+    logits_q, cache_q = dq(eng_q.params, cache_q, toks_q, tables_q, ctx_q)
+    readback(logits_q)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        logits_q, cache_q = dq(eng_q.params, cache_q, toks_q, tables_q,
+                               ctx_q)
+    readback(logits_q)
+    dt_q = (time.perf_counter() - t0) / steps
+    tok_s_q = batch / dt_q
+
     print(json.dumps({
         "metric": "llama_350m_decode_tokens_per_sec",
         "value": round(tok_s, 1), "unit": "tokens/s",
@@ -108,6 +144,16 @@ def main():
         "decode_step_ms": round(dt * 1e3, 2),
         "prefill_ms": round(ttft * 1e3, 1),
         "engine_put_roundtrip_ms": round(put_ms, 1),
+        "int8_kv": {
+            "tok_s": round(tok_s_q, 1),
+            "decode_step_ms": round(dt_q * 1e3, 2),
+            "delta_vs_bf16": round(tok_s_q - tok_s, 1),
+            "ratio_vs_bf16": round(tok_s_q / max(tok_s, 1e-9), 4),
+            "delta_vs_bf16_trajectory": round(
+                tok_s_q - BF16_TRAJECTORY_TOK_S, 1),
+            "trajectory_tok_s": BF16_TRAJECTORY_TOK_S,
+            "device_run": on_tpu,
+        },
     }))
 
 

@@ -20,6 +20,13 @@ compiled on the virtual 8-device CPU mesh, no step executed:
                     sharded over their own 'expert' mesh axis, the
                     dispatch/combine all-to-all pair over the expert
                     groups in this entry's collective ledger
+  train_step_pipe3d the interleaved-pipeline 3D training step
+                    (runtime/pipe.py, docs/pipeline.md): zero-3 +
+                    {data, pipe, model} mesh, circular V=2 schedule —
+                    the stage collective-permute ring rides this
+                    entry's ledger, and its SCHEDULE.json entry
+                    additionally pins the V=2-beats-V=1 step-time
+                    projection (the interleave bubble saving)
   serving_decode_w8 the width-8 paged-KV decode program
                     (the serving warmup footprint unit)
   serving_decode_w8_int8
@@ -107,6 +114,45 @@ def build_reports():
         (moe_engine.config.train_batch_size, 33), np.int32)}
     moe_san = moe_engine.sanitize(moe_batch)
 
+    # interleaved-pipeline 3D train step (docs/pipeline.md): zero-3 x
+    # pipeline x TP on one mesh, circular V=2 schedule at seq 128 (the
+    # flops/bytes regime where the interleave's wasted-work division
+    # is visible — the V=1 twin is compiled alongside and the pair's
+    # S009 projections ride SCHEDULE.json as the committed
+    # interleave-wins pin)
+    def _pipe_engine(v):
+        pcfg = T.TransformerConfig(
+            vocab_size=128, n_layers=4, n_heads=4, d_model=64,
+            max_seq=128, variant="llama", use_flash=False,
+            pipeline_stages=2, pipeline_virtual_stages=v)
+        eng_p = ds.initialize(
+            {"train_micro_batch_size_per_gpu": 2,
+             "gradient_accumulation_steps": 8,
+             "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+             "zero_optimization": {"stage": 3,
+                                   "param_persistence_threshold": 64},
+             "bf16": {"enabled": True},
+             "mesh": {"pipe": 2, "data": 2, "model": 2},
+             "steps_per_print": 10**9},
+            loss_fn=T.make_pipelined_loss_fn(pcfg),
+            param_init_fn=lambda k: T.init(pcfg, k),
+            param_logical_specs=T.logical_specs(pcfg),
+            pipelined=True, pipeline_virtual_stages=v)
+        batch_p = {"tokens": np.zeros(
+            (eng_p.config.train_batch_size, 129), np.int32)}
+        return eng_p.sanitize(batch_p)
+
+    pipe_san = _pipe_engine(2)
+    pipe_v1_san = _pipe_engine(1)
+    if pipe_san.cost is not None and pipe_v1_san.cost is not None:
+        s2 = getattr(pipe_san.cost, "_schedule", None)
+        s1 = getattr(pipe_v1_san.cost, "_schedule", None)
+        if s1 is not None and s2 is not None:
+            pipe_san.cost._pipe_projection = {
+                "v1_step_time_us": round(s1.step_time_s * 1e6, 3),
+                "v2_step_time_us": round(s2.step_time_s * 1e6, 3),
+            }
+
     from deepspeed_tpu.inference import init_inference
     import jax.numpy as jnp
     import warnings
@@ -167,6 +213,8 @@ def build_reports():
         reports["train_step"] = san.cost
     if moe_san.cost is not None:
         reports["train_step_moe"] = moe_san.cost
+    if pipe_san.cost is not None:
+        reports["train_step_pipe3d"] = pipe_san.cost
     if decode_cost is not None:
         reports["serving_decode_w8"] = decode_cost
     if quant_cost is not None:

@@ -19,6 +19,10 @@ programs, compiled on the virtual 8-device CPU mesh, no step executed:
                      ledger pins the fp32 gate chain (router dot,
                      softmax, z-loss logsumexp) against the bf16
                      compute dtype, and the all-to-all payload dtype
+  train_step_pipe3d  the interleaved-pipeline 3D bf16 step (zero-3 +
+                     {data,pipe,model}, circular V=2 —
+                     docs/pipeline.md): pins the stage register's
+                     dtype flow through the collective-permute ring
   train_step_fp16    the fp16 dynamic-loss-scaled training step
   train_step_onebit  the 1-bit Adam compressed-momentum step
   serving_decode_w8  the width-8 paged-KV decode program
@@ -93,9 +97,9 @@ def _train_artifacts(engine, batch, fn=None):
     return compiled, lowered, batch
 
 
-ALL_PROGRAMS = ("train_step", "train_step_moe", "train_step_fp16",
-                "train_step_onebit", "serving_decode_w8",
-                "serving_decode_w8_int8")
+ALL_PROGRAMS = ("train_step", "train_step_moe", "train_step_pipe3d",
+                "train_step_fp16", "train_step_onebit",
+                "serving_decode_w8", "serving_decode_w8_int8")
 
 
 def build_programs(only=None):
@@ -154,6 +158,40 @@ def build_programs(only=None):
                engm._numerics_checks(cm, lm, "train_step_moe",
                                      master=engm.state.master,
                                      opt=engm.state.opt))
+
+    # interleaved-pipeline 3D bf16 step (docs/pipeline.md): zero-3 x
+    # pipeline x TP, circular V=2 schedule — the ledger pins the stage
+    # register's dtype flow (bf16 activations through the
+    # collective-permute ring, fp32 grad accumulation under the
+    # declared policy) so a precision leak into the rotate shows as a
+    # new dtype key
+    if "train_step_pipe3d" in only:
+        import deepspeed_tpu as ds
+
+        pcfg = T.TransformerConfig(
+            vocab_size=128, n_layers=4, n_heads=4, d_model=64,
+            max_seq=32, variant="llama", use_flash=False,
+            pipeline_stages=2, pipeline_virtual_stages=2)
+        engp = ds.initialize(
+            {"train_micro_batch_size_per_gpu": 1,
+             "gradient_accumulation_steps": 4,
+             "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+             "zero_optimization": {"stage": 3,
+                                   "param_persistence_threshold": 64},
+             "bf16": {"enabled": True},
+             "mesh": {"pipe": 2, "data": 2, "model": 2},
+             "steps_per_print": 10**9},
+            loss_fn=T.make_pipelined_loss_fn(pcfg),
+            param_init_fn=lambda k: T.init(pcfg, k),
+            param_logical_specs=T.logical_specs(pcfg),
+            pipelined=True, pipeline_virtual_stages=2)
+        batchp = {"tokens": np.zeros(
+            (engp.config.train_batch_size, 33), np.int32)}
+        cp, lp, _ = _train_artifacts(engp, batchp)
+        record("train_step_pipe3d", cp, lp,
+               engp._numerics_checks(cp, lp, "train_step_pipe3d",
+                                     master=engp.state.master,
+                                     opt=engp.state.opt))
 
     # fp16 dynamic-loss-scaled step
     if "train_step_fp16" in only:

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""ds-pipe CLI — interleaved-pipeline 3D-parallelism gate (PIPE.json).
+
+Usage:
+    python scripts/ds_pipe.py                    # check the committed plan
+    python scripts/ds_pipe.py --capture          # rerun + write PIPE.json
+    python scripts/ds_pipe.py --plan my.json     # custom plan
+    python scripts/ds_pipe.py --strict           # identical today; kept
+                                                 # for gate-CLI symmetry
+
+The twelfth tier-1 pre-test gate (.claude/skills/verify/SKILL.md): runs
+`bench.py --pipe-sim` — four lanes on the virtual 8-device CPU mesh
+(docs/pipeline.md) — and fails unless every gate holds:
+
+  loss_identity_bitwise_*          the SAME noiseless fp32 run at
+                                   P=1, P=2, and P=2 interleaved V=2
+                                   commits BITWISE-identical losses —
+                                   pipeline layout is a pure
+                                   performance knob, never a numerics
+                                   change
+  measured_bubble_* / interleaved_bubble_beats_v1_bound
+                                   the schedule replayed from exact
+                                   iteration counts matches the
+                                   (P-1)/(V*M+P-1) closed form and
+                                   beats the non-interleaved
+                                   (P-1)/(M+P-1) bound
+  s009_step_time_improves_with_v / v5p_projection_improves_with_v
+                                   the zero-3 + {data,pipe,model} +
+                                   bf16 V=2 step projects faster than
+                                   V=1 at fixed M, on the S009
+                                   schedule analysis AND the
+                                   v5p-roofline projection
+  stage_host_recovered_from_peer_shards / zero_disk_restore / ...
+                                   a preempted stage host (logical
+                                   grid rank stage*dp+shard) recovers
+                                   from peer-mirrored STAGE slices
+                                   with no disk restore, a byte-exact
+                                   data-order ledger, and a bitwise
+                                   loss prefix; 'pipe.permute'
+                                   boundary faults heal/charge the
+                                   per-stage skew feed
+  zero_recompiles / rerun_byte_identical / ledger_matches_committed
+                                   steady state compiles one program
+                                   per layout, a rerun is
+                                   byte-identical, and the measured
+                                   ledger equals the committed
+                                   PIPE.json baseline
+
+Everything is seeded and deterministic on the CPU mesh: a red gate is
+a pipeline regression, never flake.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+DEFAULT_PATH = os.path.join(_REPO, "PIPE.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--plan", default="default",
+                    help="'default' (the committed PIPE.json) or a "
+                         "FaultPlan JSON path with workload/budget "
+                         "blocks")
+    ap.add_argument("--capture", action="store_true",
+                    help="run the lanes and write the measured ledger "
+                         f"into {DEFAULT_PATH}")
+    ap.add_argument("--check", action="store_true",
+                    help="explicit check mode (the default)")
+    ap.add_argument("--strict", action="store_true",
+                    help="accepted for symmetry with the other gates "
+                         "(every pipe gate is already hard)")
+    args = ap.parse_args(argv)
+
+    import bench
+
+    rc = bench._pipe_sim(args.plan,
+                         capture=DEFAULT_PATH if args.capture else None)
+    print(json.dumps({"ok": rc == 0, "gate": "ds_pipe",
+                      "plan": args.plan}), file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,12 @@ programs — compiled on the virtual 8-device CPU mesh, no step executed
 (same pair as ds_budget):
 
   train_step        the zero-3 + TP fused training step
+  train_step_moe    the dropless MoE zero-3 + EP + TP training step
+  train_step_pipe3d the interleaved-pipeline 3D training step
+                    (zero-3 + {data,pipe,model}, circular V=2 —
+                    docs/pipeline.md); its entry additionally commits
+                    the interleave-wins pin: the V=2 schedule's S009
+                    projection must stay below its V=1 twin's
   serving_decode_w8 the width-8 paged-KV decode program
   serving_decode_w8_int8
                     the width-8 FUSED Pallas decode program over the
@@ -80,6 +86,13 @@ def _entry(rep, sched):
         "n_async": d["n_async"],
         "n_sync": d["n_sync"],
     }
+    proj = getattr(rep, "_pipe_projection", None)
+    if proj is not None:
+        # the interleave-wins pin (docs/pipeline.md): the V=2 circular
+        # schedule's S009 projection must stay BELOW its V=1 twin's —
+        # a schedule change that grows the interleaved program's
+        # critical path past the plain pipeline fails --check
+        e["pipe_projection"] = proj
     bound = getattr(rep, "_s006_bound", None)
     if bound is not None:
         # the fused int8-KV decode program's committed S006 verdict
@@ -182,6 +195,24 @@ def check(path: str, strict: bool) -> int:
                         f"gather (limit {limit}) — the per-step "
                         "block-table gather is back; decode must index "
                         "paged KV blocks in place")})
+        if "pipe_projection" in entry:
+            proj = getattr(rep, "_pipe_projection", None)
+            if proj is None:
+                findings.append({
+                    "rule": "S009", "severity": "warning",
+                    "program": name,
+                    "message": "pipe projection pair was not rebuilt; "
+                               "re-capture"})
+            elif proj["v2_step_time_us"] >= proj["v1_step_time_us"]:
+                findings.append({
+                    "rule": "S009", "severity": "error", "program": name,
+                    "message": (
+                        f"interleaved (V=2) step-time projection "
+                        f"{proj['v2_step_time_us']:.1f}us no longer "
+                        f"beats the V=1 schedule "
+                        f"({proj['v1_step_time_us']:.1f}us) — the "
+                        "circular schedule's bubble saving regressed "
+                        "(docs/pipeline.md)")})
         checks = [
             check_exposed_comm(sched, baseline=entry,
                                min_exposed_us=floor, tolerance=tol,

@@ -512,6 +512,7 @@ class TestDsBudgetScript:
         assert r.returncode == 0, r.stdout + r.stderr
         doc = json.loads(out.read_text())
         assert set(doc["programs"]) == {"train_step", "train_step_moe",
+                                        "train_step_pipe3d",
                                         "serving_decode_w8",
                                         "serving_decode_w8_int8"}
         assert all(p["peak_hbm_bytes"] > 0

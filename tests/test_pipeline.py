@@ -229,19 +229,30 @@ class TestCircularPipeline:
                                        err_msg=f"P={P_} v={v}")
 
     def test_schedule_len_bubble_math(self):
-        from deepspeed_tpu.runtime.pipe import circular_schedule_len
+        from deepspeed_tpu.runtime.pipe import (
+            bubble_fraction,
+            circular_schedule_len,
+            simulate_schedule,
+        )
 
         # plain schedule: M + P - 1 full-stage steps; circular: each
-        # chunk-step is tau/v and the last of T steps computes nothing,
-        # so wall-clock is (Mv + P - 1) chunk-steps =
-        # M*tau + (P-1)*tau/v — bubble divided by v
+        # chunk-step is tau/v, every one of the T steps computes (the
+        # output is collected at slot P-1 post-compute), so wall-clock
+        # is (Mv + P - 1) chunk-steps = M*tau + (P-1)*tau/v — bubble
+        # divided by v
         M, P_ = 8, 4
         for v in (1, 2, 4):
             T_ = circular_schedule_len(M, P_, v)
-            assert T_ == v * P_ * (M // P_) + P_
-            wall_in_tau = (T_ - 1) / v
+            assert T_ == v * P_ * (M // P_) + P_ - 1
+            wall_in_tau = T_ / v
             bubble = wall_in_tau - M
             np.testing.assert_allclose(bubble, (P_ - 1) / v)
+            # the measured (iteration-count) accounting agrees with the
+            # closed form at M = k*P
+            sim = simulate_schedule(M, P_, v)
+            np.testing.assert_allclose(sim["bubble_fraction"],
+                                       bubble_fraction(M, P_, v))
+            np.testing.assert_allclose(sim["wall_tau"], wall_in_tau)
 
     def test_partition_circular_roundtrip(self):
         w = jnp.arange(48.0).reshape(8, 3, 2)

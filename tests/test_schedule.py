@@ -803,12 +803,17 @@ class TestDsScheduleScript:
         assert r.returncode == 0, r.stdout + r.stderr
         doc = json.loads(out.read_text())
         assert set(doc["programs"]) == {"train_step", "train_step_moe",
+                                        "train_step_pipe3d",
                                         "serving_decode_w8",
                                         "serving_decode_w8_int8"}
         assert all(p["step_time_us"] > 0
                    for p in doc["programs"].values())
         assert doc["programs"]["train_step"]["n_collectives"] > 0
         assert doc["programs"]["train_step_moe"]["n_collectives"] > 0
+        # the interleaved-pipeline entry commits the interleave-wins
+        # pin: V=2's projection strictly below its V=1 twin's
+        pp = doc["programs"]["train_step_pipe3d"]["pipe_projection"]
+        assert pp["v2_step_time_us"] < pp["v1_step_time_us"]
         # the fused int8-KV decode entry commits its S006 verdict and
         # the gather-materialization probe
         q = doc["programs"]["serving_decode_w8_int8"]

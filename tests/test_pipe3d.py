@@ -346,7 +346,7 @@ class TestPermuteGuard:
 class TestAutotunerPipeAxes:
     """Pipeline depth as a tune_aot search dimension."""
 
-    def _tuner(self, **kw):
+    def _tuner(self, tmp_path, **kw):
         from deepspeed_tpu.autotuning.autotuner import Autotuner
 
         return Autotuner(
@@ -357,16 +357,17 @@ class TestAutotunerPipeAxes:
             loss_fn=lambda p, b, r: 0.0,
             param_init_fn=lambda k: {"w": jnp.zeros((4, 4))},
             make_batch=lambda n: {"tokens": np.zeros((n, 9), np.int32)},
+            results_dir=str(tmp_path),
             **kw)
 
-    def test_apply_candidate_carves_pipe_mesh(self):
-        t = self._tuner()
+    def test_apply_candidate_carves_pipe_mesh(self, tmp_path):
+        t = self._tuner(tmp_path)
         cfg = t._apply_candidate({"zero_stage": 1, "pipe_stages": 2,
                                   "interleave": 2})
         assert cfg["mesh"]["pipe"] == 2 and cfg["mesh"]["data"] == -1
 
-    def test_candidate_enumeration_includes_pipe_axes(self):
-        t = self._tuner()
+    def test_candidate_enumeration_includes_pipe_axes(self, tmp_path):
+        t = self._tuner(tmp_path)
         # enumerate without running: trial=False + stubbed rank
         seen = {}
 
@@ -383,8 +384,8 @@ class TestAutotunerPipeAxes:
         assert {"zero_stage": 1, "micro_batch_size": 1,
                 "pipe_stages": 2, "interleave": 2} in cands
 
-    def test_pipe_candidate_without_hook_scores_infeasible(self):
-        t = self._tuner()
+    def test_pipe_candidate_without_hook_scores_infeasible(self, tmp_path):
+        t = self._tuner(tmp_path)
         exp = t.aot_score({"pipe_stages": 2, "interleave": 2})
         assert exp["aot_ok"] is False
         assert "make_pipelined" in exp["aot_error"]

@@ -1,6 +1,6 @@
 """Static analysis for compiled TPU programs and the codebase itself.
 
-Three prongs (see docs/static_analysis.md):
+Six prongs (see docs/static_analysis.md):
 
   sanitizer — ground-truth checks on compiled/lowered artifacts:
               donation aliasing (S001), PartitionSpec survival (S002),
@@ -23,8 +23,14 @@ Three prongs (see docs/static_analysis.md):
               quantized-collective sanity (N004). Dtype ledgers
               persist to NUMERICS.json
               (`python scripts/ds_numerics.py --capture / --check`).
-  lint      — `ds-lint`, an AST pass with project rules R001-R006
+  lint      — `ds-lint`, an AST pass with project rules R001-R007
               (`python scripts/ds_lint.py --strict`).
+  concurrency — interprocedural lockset race detection (C001),
+              lock-order deadlock cycles (C002), and callback-thread
+              escape analysis (C003) over the whole tree at once; the
+              lock ledger persists to CONCURRENCY.json
+              (`python scripts/ds_race.py --capture / --check`). R003
+              is a per-file shim over C001.
 """
 
 from .report import Finding, LintReport, SanitizerReport, merge_reports
@@ -66,6 +72,12 @@ from .numerics import (
     grad_elem_counts,
 )
 from .lint import lint_paths, lint_source, RULES
+from .concurrency import (
+    C_RULES,
+    ConcurrencyReport,
+    analyze_paths,
+    analyze_sources,
+)
 
 __all__ = [
     "Finding",
@@ -104,4 +116,8 @@ __all__ = [
     "lint_paths",
     "lint_source",
     "RULES",
+    "C_RULES",
+    "ConcurrencyReport",
+    "analyze_paths",
+    "analyze_sources",
 ]

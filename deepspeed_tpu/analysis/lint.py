@@ -16,8 +16,13 @@ Rules
         `deepspeed_tpu.utils.sync.host_sync`, the single allowlisted
         choke point
   R003  a shared mutable dict/list on `self`, in a class that touches
-        `io_callback`/threads, mutated outside a `with <lock>:` block
-        (methods named `*_locked` are lock-held by convention)
+        `io_callback`/threads, written with an empty lock intersection
+        across concurrent contexts. Since the concurrency analyzer
+        landed this is a shim over C001's interprocedural lockset pass
+        (analysis/concurrency.py) — same rule id, pragma spelling, and
+        --strict semantics; `*_locked` methods are lock-held by
+        convention, and files without in-file thread roots keep the old
+        conservative every-mutation-needs-a-lock behavior
   R004  `jax.jit(..., donate_argnums=...)` with no nearby comment
         explaining the aliasing story and no sanitizer check call
   R005  `jnp.array`/`jnp.asarray`/`jnp.full` of a bare Python
@@ -105,7 +110,12 @@ _HOT_FILES = ("runtime/engine.py", "inference/engine.py",
               # one collective-permute per step) — a host sync or an
               # unrolled-loop collective here multiplies by the whole
               # schedule length (docs/pipeline.md)
-              "runtime/pipe.py")
+              "runtime/pipe.py",
+              # the concurrency analyzer and the interleaving harness
+              # are imported by the ds_race gate and by lint itself —
+              # a stray host sync here would tax every lint/gate run
+              # and, for the harness, every instrumented lock op
+              "analysis/concurrency.py", "resilience/interleave.py")
 _HOT_FN_PREFIXES = (
     "train_batch", "eval_batch", "_dispatch", "decode", "_decode",
     "generate", "put", "step", "_sample", "prefill", "_prefill",
@@ -151,12 +161,6 @@ _NP_CONVERSIONS = ("asarray", "array")
 # through these is not a traced-value use
 _STATIC_ATTRS = ("shape", "ndim", "dtype", "size", "sharding", "aval",
                  "itemsize")
-
-_MUTATORS = ("append", "extend", "insert", "remove", "pop", "popitem",
-             "clear", "update", "setdefault", "add", "discard")
-_THREAD_MARKERS = ("io_callback", "pure_callback", "Thread",
-                   "ThreadPoolExecutor", "start_new_thread", "Timer")
-
 
 def _dotted(node: ast.AST) -> str:
     """'jax.experimental.io_callback' for an Attribute/Name chain."""
@@ -524,122 +528,21 @@ def _check_r002(ctx: _Ctx, tree: ast.Module) -> None:
 
 
 # ----------------------------------------------------------------------
-# R003: unlocked shared-state mutation
+# R003: unlocked shared-state mutation — a thin shim over the
+# concurrency analyzer's C001 lockset pass (analysis/concurrency.py).
+# Same rule id, pragma spelling, and --strict semantics as the old
+# heuristic, but with real path sensitivity: in files that register
+# their own thread roots (Thread targets, io_callback bodies, atexit
+# handlers) only genuinely multi-context unlocked state fires; files
+# whose roots live elsewhere fall back to the conservative
+# every-method-is-concurrent mode (the old behavior). The cross-file
+# picture — roots registered in ANOTHER module — is the ds_race gate's
+# job (scripts/ds_race.py, the 13th tier-1 gate).
 # ----------------------------------------------------------------------
 
-def _shared_attrs(cls: ast.ClassDef) -> Set[str]:
-    """self.X initialized to a mutable container in __init__."""
-    out: Set[str] = set()
-    for fn in cls.body:
-        if not (isinstance(fn, ast.FunctionDef) and fn.name == "__init__"):
-            continue
-        for node in ast.walk(fn):
-            # plain and annotated assignment both count
-            # (`self._inflight: Dict[...] = {}` is an AnnAssign)
-            if isinstance(node, ast.Assign):
-                targets, v = node.targets, node.value
-            elif isinstance(node, ast.AnnAssign) and node.value is not None:
-                targets, v = [node.target], node.value
-            else:
-                continue
-            is_container = (
-                isinstance(v, (ast.Dict, ast.List, ast.Set))
-                or (isinstance(v, ast.Call)
-                    and _dotted(v.func).split(".")[-1] in
-                    ("dict", "list", "set", "defaultdict", "OrderedDict",
-                     "deque"))
-                or (isinstance(v, ast.BinOp) and isinstance(v.op, ast.Mult)
-                    and (isinstance(v.left, ast.List)
-                         or isinstance(v.right, ast.List)))
-            )
-            if not is_container:
-                continue
-            for tgt in targets:
-                if isinstance(tgt, ast.Attribute) and \
-                        isinstance(tgt.value, ast.Name) and \
-                        tgt.value.id == "self":
-                    out.add(tgt.attr)
-    return out
-
-
-def _is_lock_expr(node: ast.AST) -> bool:
-    d = _dotted(node).lower()
-    return "lock" in d or "mutex" in d
-
-
-def _mutation_of(node: ast.AST, attrs: Set[str]) -> Optional[str]:
-    """Attr name when `node` mutates self.<attr> (subscript store/del,
-    augassign, or a mutating method call)."""
-    def self_attr(e: ast.AST) -> Optional[str]:
-        if isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name) \
-                and e.value.id == "self" and e.attr in attrs:
-            return e.attr
-        return None
-
-    if isinstance(node, (ast.Assign, ast.AugAssign)):
-        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
-        for t in targets:
-            if isinstance(t, ast.Subscript):
-                a = self_attr(t.value)
-                if a:
-                    return a
-    if isinstance(node, ast.Delete):
-        for t in node.targets:
-            if isinstance(t, ast.Subscript):
-                a = self_attr(t.value)
-                if a:
-                    return a
-    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
-            and node.func.attr in _MUTATORS:
-        return self_attr(node.func.value)
-    return None
-
-
 def _check_r003(ctx: _Ctx, tree: ast.Module) -> None:
-    module_threaded = any(
-        isinstance(n, (ast.Import, ast.ImportFrom)) and any(
-            "thread" in (a.name or "").lower() for a in n.names)
-        for n in ast.walk(tree))
-    for cls in ast.walk(tree):
-        if not isinstance(cls, ast.ClassDef):
-            continue
-        markers = {
-            _dotted(n).split(".")[-1]
-            for n in ast.walk(cls)
-            if isinstance(n, (ast.Name, ast.Attribute))
-        }
-        threaded = bool(markers & set(_THREAD_MARKERS)) or (
-            module_threaded and any("lock" in m.lower() for m in markers))
-        if not threaded:
-            continue
-        shared = _shared_attrs(cls)
-        if not shared:
-            continue
-        for fn in (n for n in cls.body if isinstance(n, ast.FunctionDef)):
-            if fn.name == "__init__" or fn.name.endswith("_locked"):
-                continue  # init is pre-concurrency; *_locked = caller holds
-            locked_nodes: Set[int] = set()
-            for w in ast.walk(fn):
-                if isinstance(w, ast.With) and any(
-                        _is_lock_expr(item.context_expr)
-                        for item in w.items):
-                    locked_nodes.update(id(x) for x in ast.walk(w))
-            for node in ast.walk(fn):
-                if id(node) in locked_nodes:
-                    continue
-                attr = _mutation_of(node, shared)
-                if attr:
-                    ctx.emit(
-                        "R003", node,
-                        f"self.{attr} (shared mutable container in a "
-                        f"threaded class) mutated in {fn.name}() outside a "
-                        "`with <lock>:` block — io_callback threads arrive "
-                        "unordered (the NvmeLayerStore._inflight race class)",
-                        "guard the mutation with the class lock, rename the "
-                        "method *_locked if the caller holds it, or annotate "
-                        "single-threaded phases with "
-                        "`# ds-lint: ok R003 <why>`",
-                    )
+    from .concurrency import r003_findings
+    ctx.findings.extend(r003_findings(tree, ctx.relpath))
 
 
 # ----------------------------------------------------------------------

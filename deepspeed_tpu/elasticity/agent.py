@@ -163,7 +163,11 @@ class HealthMonitor:
         self.interval_s = interval_s
         self.generation = generation
         self.on_degraded = on_degraded
-        self.failed_ranks: List[int] = []
+        # written by the monitor thread, read by the training loop's
+        # check() — both sides go through _lock (C001; the monitor
+        # flips the list exactly once per degradation)
+        self._failed: List[int] = []
+        self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -181,6 +185,11 @@ class HealthMonitor:
 
     # -- queries --------------------------------------------------------
     @property
+    def failed_ranks(self) -> List[int]:
+        with self._lock:
+            return list(self._failed)
+
+    @property
     def degraded(self) -> bool:
         return bool(self.failed_ranks)
 
@@ -194,16 +203,30 @@ class HealthMonitor:
     def _run(self) -> None:
         tracker = StalenessTracker(self.timeout_s)
         while not self._stop.wait(self.interval_s):
-            hbs = scan_heartbeats(self.hb_dir, self.world, self.generation)
-            hbs.pop(self.rank, None)
-            failed = tracker.observe(hbs, time.monotonic())
-            if failed and not self.failed_ranks:
-                self.failed_ranks = failed
-                if self.on_degraded is not None:
-                    try:
-                        self.on_degraded(failed)
-                    except Exception:  # callback must not kill the scanner
-                        pass
+            self._scan_once(tracker)
+
+    def _scan_once(self, tracker: "StalenessTracker",
+                   now: Optional[float] = None) -> None:
+        """One heartbeat sweep (the _run loop body; the interleaving
+        harness drives it directly — tests/test_concurrency.py)."""
+        hbs = scan_heartbeats(self.hb_dir, self.world, self.generation)
+        hbs.pop(self.rank, None)
+        failed = tracker.observe(
+            hbs, time.monotonic() if now is None else now)
+        newly = False
+        if failed:
+            with self._lock:
+                if not self._failed:
+                    self._failed = list(failed)
+                    newly = True
+        # user callback OUTSIDE the lock: a callback that reads
+        # failed_ranks (or takes its own locks) must not nest under
+        # ours (C002 lock-order discipline)
+        if newly and self.on_degraded is not None:
+            try:
+                self.on_degraded(failed)
+            except Exception:  # callback must not kill the scanner
+                pass
 
 
 # ---------------------------------------------------------------------------

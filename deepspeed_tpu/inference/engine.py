@@ -687,7 +687,7 @@ class InferenceEngine:
             # donated: the paged KV cache aliases the returned cache
             # (same PagedCache layout in and out); compile caches below
             # are only ever touched by the host dispatch thread
-            self._prefill_batch_fns[key] = jax.jit(step, donate_argnums=(1,))  # ds-lint: ok R003 host dispatch thread only
+            self._prefill_batch_fns[key] = jax.jit(step, donate_argnums=(1,))
         return self._prefill_batch_fns[key]
 
     def _census_cb(self):
@@ -726,7 +726,7 @@ class InferenceEngine:
                 )
 
             # donated: the KV cache aliases the returned cache in-place
-            self._decode_fns[key] = jax.jit(step, donate_argnums=(1,))  # ds-lint: ok R003 host dispatch thread only
+            self._decode_fns[key] = jax.jit(step, donate_argnums=(1,))
         return self._decode_fns[key]
 
     def decode_multi_fn(self, s: int, n_steps: int, sampling=None,
@@ -1514,7 +1514,7 @@ class InferenceEngine:
                 rep = build_cost_report(compiled,
                                         label=f"serving_decode[w{w}]")
                 if rep is not None:
-                    self.warmup_footprints[w] = {  # ds-lint: ok R003 warmup runs on the host dispatch thread only
+                    self.warmup_footprints[w] = {
                         "peak_hbm_bytes": float(rep.peak_hbm_bytes),
                         "arg_bytes": float(rep.arg_bytes),
                         "temp_bytes": float(rep.temp_bytes),

@@ -263,7 +263,7 @@ class NvmeLayerStore:
         # staging is strictly single-threaded and precedes any serving
         # read (finish_staging is the barrier) — no lock needed here
         self._manifest[l] = rows  # ds-lint: ok R003 single-threaded staging phase
-        self._spec_tree[l] = jax.tree_util.tree_unflatten(  # ds-lint: ok R003 single-threaded staging phase
+        self._spec_tree[l] = jax.tree_util.tree_unflatten(
             treedef,
             [jax.ShapeDtypeStruct(r[2], r[3]) for r in rows],
         )

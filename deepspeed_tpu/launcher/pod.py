@@ -70,13 +70,20 @@ def build_worker_command(
 
 
 def _stream(proc: subprocess.Popen, tag: str, sink) -> None:
-    for line in proc.stdout:  # type: ignore[union-attr]
-        # the forced pty (-tt) CRLF-terminates remote output
-        line = line.rstrip("\r\n") + "\n"
-        sys.stdout.write(f"[{tag}] {line}")
-        sys.stdout.flush()
+    try:
+        for line in proc.stdout:  # type: ignore[union-attr]
+            # the forced pty (-tt) CRLF-terminates remote output
+            line = line.rstrip("\r\n") + "\n"
+            sys.stdout.write(f"[{tag}] {line}")
+            sys.stdout.flush()
+            if sink is not None:
+                sink.write(line)
+    finally:
+        # the reader owns its sink: closing at pipe EOF (not in the
+        # joining main thread) removes the write-after-close window
+        # when a join is cut short under fail-fast termination
         if sink is not None:
-            sink.write(line)
+            sink.close()
 
 
 def run_on_pod(
@@ -97,7 +104,7 @@ def run_on_pod(
     """
     targets = [workers] if workers == "all" else [
         w.strip() for w in workers.split(",") if w.strip()]
-    procs, threads, sinks = [], [], []
+    procs, threads = [], []
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
     for w in targets:
@@ -113,7 +120,6 @@ def run_on_pod(
         t.start()
         procs.append(p)
         threads.append(t)
-        sinks.append(sink)
     # fail-fast (launch.py terminate-on-failure semantics): poll ALL
     # workers; the first nonzero exit terminates the rest (pty-backed
     # ssh, so the HUP reaches the remote processes — see
@@ -131,10 +137,10 @@ def run_on_pod(
                 for q in live:
                     q.terminate()
         time.sleep(0.05)
-    for t, sink in zip(threads, sinks):
-        t.join()
-        if sink is not None:
-            sink.close()
+    # bounded join: a wedged ssh keeping the pipe open must not hang
+    # the launcher — the daemon reader closes its own sink at EOF
+    for t in threads:
+        t.join(timeout=30)
     return rc
 
 

@@ -9,7 +9,8 @@ Python file I/O when no toolchain exists (functional, not async).
 """
 
 import ctypes
-from typing import Optional
+import threading
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -43,6 +44,13 @@ class AsyncIOHandle:
     def __init__(self, n_threads: int = 4, block_size: int = 1 << 20):
         self._lib = _load()
         self._h: Optional[int] = None
+        # pin registry: submitted buffers must stay alive until
+        # wait/drain. Submissions arrive from the main staging path and
+        # waits from io_callback threads concurrently, so the registry
+        # takes its own lock — a lost pin here is a use-after-free
+        # inside the native thread pool (C001, docs/concurrency.md)
+        self._inflight: Dict[int, np.ndarray] = {}
+        self._lock = threading.Lock()
         if self._lib is not None:
             self._h = self._lib.ds_aio_create(n_threads, block_size)
 
@@ -61,11 +69,10 @@ class AsyncIOHandle:
         if self._h is None:
             arr.tofile(path)
             return 0
-        # keep the buffer alive until wait/drain
-        self._inflight = getattr(self, "_inflight", {})
         t = self._lib.ds_aio_submit_pwrite(
             self._h, path.encode(), arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes)
-        self._inflight[t] = arr
+        with self._lock:
+            self._inflight[t] = arr
         return t
 
     def async_pread(self, arr: np.ndarray, path: str) -> int:
@@ -77,17 +84,18 @@ class AsyncIOHandle:
                 path, dtype=arr.dtype, count=arr.size
             ).reshape(arr.shape)
             return 0
-        self._inflight = getattr(self, "_inflight", {})
         t = self._lib.ds_aio_submit_pread(
             self._h, path.encode(), arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes)
-        self._inflight[t] = arr
+        with self._lock:
+            self._inflight[t] = arr
         return t
 
     def wait(self, ticket: int) -> None:
         if self._h is None or ticket == 0:
             return
         err = self._lib.ds_aio_wait(self._h, ticket)
-        getattr(self, "_inflight", {}).pop(ticket, None)
+        with self._lock:
+            self._inflight.pop(ticket, None)
         if err:
             raise OSError(err, f"aio request {ticket} failed")
 
@@ -95,7 +103,8 @@ class AsyncIOHandle:
         if self._h is None:
             return
         err = self._lib.ds_aio_drain(self._h)
-        self._inflight = {}
+        with self._lock:
+            self._inflight.clear()
         if err:
             raise OSError(err, "aio drain failed")
 

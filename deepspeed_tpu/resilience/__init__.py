@@ -8,7 +8,10 @@ training (elasticity/trainer.py consumes it; the ds_elastic chaos
 gate proves it). Training-side failure detection lives in
 elasticity/agent.py (heartbeats); crash-consistent checkpointing in
 runtime/checkpoint.py (commit markers + verified-tag fallback) — both
-carry fault points from here."""
+carry fault points from here. `interleave` is the deterministic
+interleaving harness (seeded cooperative scheduler + instrumented
+locks) the ds_race gate and tests/test_concurrency.py replay real
+control-plane schedules under (docs/concurrency.md)."""
 
 from .faults import (
     CheckpointCrashError,
@@ -48,6 +51,13 @@ from .integrity import (
     payload_digest,
     tree_digest,
 )
+from .interleave import (
+    CooperativeScheduler,
+    DeadlockError,
+    InstrumentedLock,
+    ScheduleError,
+    run_interleaved,
+)
 from .redundancy import (
     PeerRedundantStore,
     RedundancyError,
@@ -67,4 +77,6 @@ __all__ = [
     "IntegrityError", "MirrorIntegrityError", "HandoffIntegrityError",
     "PersistentAnomalyError", "AnomalyDetector", "flip_bits",
     "corrupt_tree", "corrupt_payload", "tree_digest", "payload_digest",
+    "CooperativeScheduler", "DeadlockError", "InstrumentedLock",
+    "ScheduleError", "run_interleaved",
 ]

@@ -274,8 +274,13 @@ class FaultPlan:
         self.reset()
 
     def reset(self) -> "FaultPlan":
-        self._matched = [0] * len(self.faults)
-        self.fired: List[str] = []   # human-readable injection log
+        # counters + fire-log swap under the lock: reset() races
+        # in-flight _hit()s arriving on io_callback threads (a reset
+        # between _hit's read-modify-write would resurrect the old
+        # counter list; C001, docs/concurrency.md)
+        with self._lock:
+            self._matched = [0] * len(self.faults)
+            self.fired: List[str] = []   # human-readable injection log
         return self
 
     # -- construction -----------------------------------------------------

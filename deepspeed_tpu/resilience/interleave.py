@@ -1,0 +1,449 @@
+"""Deterministic interleaving harness: seeded cooperative scheduling
+for the serving control plane's real threads.
+
+The concurrency analyzer (analysis/concurrency.py) proves lockset
+properties statically; this module is its dynamic twin. It replays
+PERMUTED thread schedules of real code — the scheduler step vs the
+router pump vs the autoscaler tick vs offload-store I/O threads — under
+a seeded scheduler, so a race that depends on a particular interleaving
+is reproduced on demand instead of once a month in production. CHESS
+(Musuvathi et al.) is the lineage: enumerate/sample schedules at
+synchronization points, one task runnable at a time, and the schedule
+is a pure function of the seed.
+
+Design
+  - Tasks are REAL `threading.Thread`s, but all of them are gated by a
+    single `threading.Condition`: exactly one task holds the baton at
+    any moment, so every shared-memory access is sequentially
+    consistent and the interleaving is exactly the recorded trace.
+  - A task hands the baton back at `yield_point(op)` calls. Instrumented
+    locks call `yield_point` on every acquire/release, so lock-ordering
+    bugs surface without hand-sprinkled yields; code under test can add
+    explicit `sched.yield_point("tag")` choke points for finer slicing.
+  - The next runnable task is `random.Random(seed).choice(sorted(...))`
+    — same seed, same schedule, byte-identical trace, every run.
+  - `InstrumentedLock` tracks owner + waiters. When every live task is
+    blocked on a lock, the harness raises `DeadlockError` carrying the
+    full held/waiting map — the dynamic confirmation of a C002 cycle.
+    Acquiring a non-reentrant instrumented lock twice from the same
+    task raises immediately (a real `threading.Lock` would self-
+    deadlock silently).
+  - `instrument(obj, attrs)` swaps named `threading.Lock` attributes on
+    a live object for instrumented ones, so production classes run
+    unmodified under the harness.
+  - `trace_digest()` is a blake2b over the `task:op` lines — the
+    ds_race gate pins these digests per (lane, seed) in
+    CONCURRENCY.json, so a schedule change is a reviewed diff.
+
+Usage
+    sched = CooperativeScheduler(seed=7)
+    sched.instrument(store, ["_lock"])
+    sched.spawn("writer", lambda: store.put(k, v))
+    sched.spawn("reader", lambda: store.get(k))
+    sched.run()                      # raises the first task exception
+    sched.trace_digest()             # stable for a given seed
+
+See docs/concurrency.md for the lane catalog the gate replays.
+"""
+
+import hashlib
+import random
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "CooperativeScheduler",
+    "DeadlockError",
+    "InstrumentedLock",
+    "ScheduleError",
+]
+
+
+class ScheduleError(RuntimeError):
+    """Harness misuse or runaway schedule (max_switches exceeded)."""
+
+
+class _Aborted(BaseException):
+    """Internal: unparks victim tasks after a fatal schedule error so
+    their threads exit instead of hanging to the join timeout. Never
+    surfaces from run() when a real cause exists."""
+
+
+class DeadlockError(RuntimeError):
+    """Every live task is blocked on an instrumented lock.
+
+    `held` maps task -> locks it owns; `waiting` maps task -> the lock
+    it is blocked on. Together they spell out the cycle (the dynamic
+    face of a C002 finding)."""
+
+    def __init__(self, held: Dict[str, List[str]],
+                 waiting: Dict[str, str]) -> None:
+        self.held = held
+        self.waiting = waiting
+        parts = [
+            f"{t} holds {sorted(held.get(t, []))} wants {waiting[t]}"
+            for t in sorted(waiting)
+        ]
+        super().__init__("deadlock: all live tasks blocked — "
+                         + "; ".join(parts))
+
+
+# task lifecycle states
+_READY = "ready"       # runnable, waiting for the baton
+_RUNNING = "running"   # holds the baton
+_BLOCKED = "blocked"   # parked on an instrumented lock
+_DONE = "done"
+
+
+class _Task:
+    def __init__(self, name: str, fn: Callable[[], None]) -> None:
+        self.name = name
+        self.fn = fn
+        self.state = _READY
+        self.thread: Optional[threading.Thread] = None
+        self.exc: Optional[BaseException] = None
+        self.waiting_on: Optional[str] = None
+        self.held: List[str] = []
+
+
+class InstrumentedLock:
+    """A lock whose acquire/release are scheduler yield points.
+
+    Context-manager compatible with `threading.Lock`/`RLock`, so it can
+    be swapped onto a live object via `CooperativeScheduler.instrument`.
+    No real lock is needed underneath: the scheduler's baton already
+    serializes all tasks, so this object only has to model BLOCKING —
+    who owns it, who waits, and when a waiter may proceed."""
+
+    def __init__(self, sched: "CooperativeScheduler", name: str,
+                 reentrant: bool = False) -> None:
+        self._sched = sched
+        self.name = name
+        self.reentrant = reentrant
+        self.owner: Optional[str] = None
+        self._depth = 0
+
+    # -- threading.Lock surface -------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        return self._sched._lock_acquire(self, blocking)
+
+    def release(self) -> None:
+        self._sched._lock_release(self)
+
+    def locked(self) -> bool:
+        return self.owner is not None
+
+    def __enter__(self) -> "InstrumentedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class CooperativeScheduler:
+    """Seeded cooperative scheduler over real threads.
+
+    `spawn` registers tasks; `run` starts them and drives the baton
+    until every task finishes, re-raising the first task exception
+    (after letting remaining tasks run to completion where possible).
+    The schedule is a pure function of `seed` and the tasks' yield
+    structure: identical seeds produce byte-identical traces."""
+
+    def __init__(self, seed: int = 0, max_switches: int = 100_000) -> None:
+        self.seed = seed
+        self.max_switches = max_switches
+        self._rng = random.Random(seed)
+        self._cond = threading.Condition()
+        self._tasks: Dict[str, _Task] = {}
+        self._order: List[str] = []
+        self._current: Optional[str] = None
+        self._started = False
+        self.trace: List[Tuple[str, str]] = []
+        self._switches = 0
+        self._abort = False
+        self._local = threading.local()
+
+    # ------------------------------------------------------------------
+    # task registration / instrumentation
+    # ------------------------------------------------------------------
+    def spawn(self, name: str, fn: Callable[..., None], *args,
+              **kwargs) -> None:
+        if self._started:
+            raise ScheduleError("spawn() after run()")
+        if name in self._tasks:
+            raise ScheduleError(f"duplicate task name {name!r}")
+        # spawn() precedes run() (the _started guard above), and
+        # Thread.start() publishes _tasks with a happens-before edge
+        # the lockset model cannot see (Eraser's init-state gap):
+        # ds-lint: ok C001 init-before-share, published by Thread.start
+        self._tasks[name] = _Task(
+            name, (lambda: fn(*args, **kwargs)) if (args or kwargs) else fn)
+        self._order.append(name)
+
+    def make_lock(self, name: str, reentrant: bool = False) -> InstrumentedLock:
+        return InstrumentedLock(self, name, reentrant=reentrant)
+
+    def instrument(self, obj: object,
+                   attrs: Sequence[str] = ("_lock",)) -> object:
+        """Swap `threading.Lock`-like attributes on a live object for
+        instrumented ones. Lock names are `ClassName.attr`, matching the
+        analyzer's C002 node spelling, so a dynamic DeadlockError names
+        the same edges the static cycle report does."""
+        for a in attrs:
+            cur = getattr(obj, a)
+            reentrant = "RLock" in type(cur).__name__
+            setattr(obj, a, self.make_lock(
+                f"{type(obj).__name__}.{a}", reentrant=reentrant))
+        return obj
+
+    # ------------------------------------------------------------------
+    # the baton
+    # ------------------------------------------------------------------
+    def _task_name(self) -> str:
+        name = getattr(self._local, "task", None)
+        if name is None:
+            raise ScheduleError(
+                "yield_point()/instrumented lock used outside a "
+                "scheduler task")
+        return name
+
+    def _record(self, task: str, op: str) -> None:
+        # every _record call site holds _cond; main reads trace only
+        # after run() has joined every task (join-after-fini, the dual
+        # of the init-before-share gap):
+        # ds-lint: ok C001 guarded by _cond at all call sites, read post-join
+        self.trace.append((task, op))
+
+    def _pick_next(self) -> Optional[str]:
+        ready = sorted(n for n, t in self._tasks.items()
+                       if t.state == _READY)
+        if ready:
+            return self._rng.choice(ready)
+        return None
+
+    def _live(self) -> List[_Task]:
+        return [t for t in self._tasks.values() if t.state != _DONE]
+
+    def _dispatch_locked(self) -> None:
+        """Pick the next READY task and hand it the baton. Caller holds
+        self._cond. Raises DeadlockError when live tasks exist but none
+        are runnable."""
+        self._switches += 1
+        if self._switches > self.max_switches:
+            raise ScheduleError(
+                f"schedule exceeded max_switches={self.max_switches} "
+                "(livelock or missing termination)")
+        nxt = self._pick_next()
+        if nxt is None:
+            live = self._live()
+            if live:  # all blocked on locks — a realized deadlock
+                raise DeadlockError(
+                    held={t.name: list(t.held) for t in live},
+                    waiting={t.name: t.waiting_on or "?" for t in live
+                             if t.waiting_on},
+                )
+            self._current = None  # everything finished
+        else:
+            self._tasks[nxt].state = _RUNNING
+            self._current = nxt
+        self._cond.notify_all()
+
+    def yield_point(self, op: str = "yield") -> None:
+        """Record `op` and hand the baton to a (seeded-)random READY
+        task. Instrumented locks call this on every acquire/release;
+        tasks may also call it directly to expose extra interleavings."""
+        me = self._task_name()
+        with self._cond:
+            self._record(me, op)
+            self._tasks[me].state = _READY
+            self._dispatch_locked()
+            while self._current != me:
+                if self._abort:
+                    raise _Aborted()
+                self._cond.wait()
+
+    # ------------------------------------------------------------------
+    # instrumented-lock protocol (called from task threads)
+    # ------------------------------------------------------------------
+    def _outside_idle(self) -> bool:
+        """True when no schedule is live — before run() or after every
+        task finished. Instrumented locks touched then (e.g. a post-run
+        assertion reading through a guarded property) degrade to
+        trivial single-threaded acquire/release instead of erroring."""
+        return (not self._started
+                or all(t.state == _DONE for t in self._tasks.values()))
+
+    def _lock_acquire(self, lock: InstrumentedLock, blocking: bool) -> bool:
+        if getattr(self._local, "task", None) is None \
+                and self._outside_idle():
+            return True
+        me = self._task_name()
+        task = self._tasks[me]
+        with self._cond:
+            if lock.owner == me:
+                if lock.reentrant:
+                    lock._depth += 1
+                    self._record(me, f"reacquire:{lock.name}")
+                    return True
+                raise ScheduleError(
+                    f"{me} re-acquired non-reentrant lock {lock.name} "
+                    "(self-deadlock on a real threading.Lock)")
+            # yield BEFORE taking the lock: this is the interleaving
+            # point where another task may slip in between check and
+            # acquisition — the schedule permutes exactly here
+            self._record(me, f"acquire:{lock.name}")
+            task.state = _READY
+            self._dispatch_locked()
+            while True:
+                if self._current == me and lock.owner is None:
+                    lock.owner = me
+                    lock._depth = 1
+                    task.held.append(lock.name)
+                    task.waiting_on = None
+                    task.state = _RUNNING
+                    return True
+                if self._current == me and lock.owner is not None:
+                    if not blocking:
+                        task.state = _RUNNING
+                        self._record(me, f"tryfail:{lock.name}")
+                        return False
+                    # park: give the baton away until the owner releases
+                    task.state = _BLOCKED
+                    task.waiting_on = lock.name
+                    self._record(me, f"block:{lock.name}")
+                    self._dispatch_locked()
+                if self._abort:
+                    raise _Aborted()
+                self._cond.wait()
+
+    def _lock_release(self, lock: InstrumentedLock) -> None:
+        if getattr(self._local, "task", None) is None \
+                and self._outside_idle():
+            return
+        me = self._task_name()
+        task = self._tasks[me]
+        with self._cond:
+            if lock.owner != me:
+                raise ScheduleError(
+                    f"{me} released {lock.name} owned by {lock.owner}")
+            lock._depth -= 1
+            if lock._depth > 0:  # reentrant inner release
+                self._record(me, f"rerelease:{lock.name}")
+                return
+            lock.owner = None
+            task.held.remove(lock.name)
+            self._record(me, f"release:{lock.name}")
+            # wake lock waiters: they become READY and re-contend
+            for t in self._tasks.values():
+                if t.state == _BLOCKED and t.waiting_on == lock.name:
+                    t.state = _READY
+                    t.waiting_on = None
+            task.state = _READY
+            self._dispatch_locked()
+            while self._current != me:
+                if self._abort:
+                    raise _Aborted()
+                self._cond.wait()
+
+    # ------------------------------------------------------------------
+    # driving
+    # ------------------------------------------------------------------
+    def _task_main(self, task: _Task) -> None:
+        self._local.task = task.name
+        with self._cond:
+            # wait for the baton before the first user instruction runs
+            while self._current != task.name:
+                if self._abort:
+                    task.state = _DONE
+                    self._cond.notify_all()
+                    return
+                self._cond.wait()
+        try:
+            task.fn()
+        except _Aborted:
+            pass  # unparked by a fatal error elsewhere; run() reports it
+        except BaseException as e:  # noqa: BLE001 — surfaced in run()
+            task.exc = e
+        finally:
+            with self._cond:
+                # drop any locks an excepting task still holds, so the
+                # remaining tasks aren't wedged by the failure itself
+                for t_lock_name in list(task.held):
+                    for other in self._tasks.values():
+                        if other.state == _BLOCKED and \
+                                other.waiting_on == t_lock_name:
+                            other.state = _READY
+                            other.waiting_on = None
+                task.held.clear()
+                task.state = _DONE
+                self._record(task.name, "exit")
+                if task.exc is not None:
+                    # a task raised: abort survivors rather than let
+                    # them run against half-mutated state
+                    self._abort = True
+                    self._current = None
+                    self._cond.notify_all()
+                elif not self._abort:
+                    try:
+                        self._dispatch_locked()
+                    except BaseException as e:  # deadlock among survivors
+                        task.exc = e
+                        self._abort = True
+                        self._current = None
+                        self._cond.notify_all()
+                else:
+                    self._cond.notify_all()
+
+    def run(self) -> None:
+        if self._started:
+            raise ScheduleError("run() called twice")
+        if not self._tasks:
+            return
+        self._started = True
+        for name in self._order:
+            t = self._tasks[name]
+            t.thread = threading.Thread(
+                target=self._task_main, args=(t,),
+                name=f"interleave-{name}", daemon=True)
+            t.thread.start()
+        with self._cond:
+            self._dispatch_locked()
+        for name in self._order:
+            th = self._tasks[name].thread
+            assert th is not None
+            th.join(timeout=60)
+            if th.is_alive():
+                raise ScheduleError(
+                    f"task {name!r} failed to finish (wedged schedule)")
+        for name in self._order:
+            exc = self._tasks[name].exc
+            if exc is not None:
+                raise exc
+
+    # ------------------------------------------------------------------
+    # reporting
+    # ------------------------------------------------------------------
+    def trace_lines(self) -> List[str]:
+        return [f"{t}:{op}" for t, op in self.trace]
+
+    def trace_digest(self) -> str:
+        h = hashlib.blake2b(digest_size=16)
+        for line in self.trace_lines():
+            h.update(line.encode())
+            h.update(b"\n")
+        return h.hexdigest()
+
+
+def run_interleaved(seed: int, tasks: Sequence[Tuple[str, Callable[[], None]]],
+                    instrument: Sequence[Tuple[object, Sequence[str]]] = (),
+                    max_switches: int = 100_000) -> CooperativeScheduler:
+    """One-call wrapper: build a scheduler, instrument objects, spawn
+    the named tasks, run, and return the scheduler (trace + digest)."""
+    sched = CooperativeScheduler(seed=seed, max_switches=max_switches)
+    for obj, attrs in instrument:
+        sched.instrument(obj, attrs)
+    for name, fn in tasks:
+        sched.spawn(name, fn)
+    sched.run()
+    return sched

@@ -1,0 +1,498 @@
+#!/usr/bin/env python
+"""ds-race CLI — concurrency gate (CONCURRENCY.json).
+
+Usage:
+    python scripts/ds_race.py                  # check vs the committed ledger
+    python scripts/ds_race.py --capture        # rerun + write CONCURRENCY.json
+    python scripts/ds_race.py --check --strict # CI spelling (suppression
+                                               # drift also fails)
+    python scripts/ds_race.py --static-only    # analyzer pass only (fast)
+
+The thirteenth tier-1 pre-test gate (.claude/skills/verify/SKILL.md).
+Two halves, both deterministic:
+
+STATIC — the interprocedural lockset analyzer (analysis/concurrency.py)
+over the whole deepspeed_tpu/ tree at once: C001 empty-lockset races
+across thread/callback/atexit roots, C002 lock-order cycles, C003
+callback-thread escapes. ANY active finding fails the gate in every
+mode — there is no baseline for races, only zero. The per-class lock
+ledger (locks, roots, guarded/unguarded shared attrs, pragma
+suppressions) is compared against CONCURRENCY.json: a class gaining an
+unguarded attr, losing a lock, or growing a suppression is a reviewed
+diff, not a silent drift.
+
+DYNAMIC — the interleaving harness (resilience/interleave.py) replays
+the REAL control-plane code under seeded cooperative schedules, two
+distinct seeds per lane:
+
+  spill_store     HostKvSpillStore put/get/discard from three tasks
+                  interleaved inside the critical sections: used_bytes
+                  must equal the byte-sum of the surviving entries and
+                  the counters must balance, under every schedule
+  fault_plan      two hitter tasks drive fault_point() through an armed
+                  FaultPlan while a third task reset()s it mid-flight:
+                  matched totals stay coherent (the faults.py reset
+                  race fix, pinned)
+  aio_inflight    AsyncIOHandle writers/readers over a tmpdir: payload
+                  round-trip is byte-identical and the pin registry
+                  (_inflight) is empty after the last wait (the aio.py
+                  lost-pin fix, pinned)
+  serving_plane   two real engines under a ServingRouter: scheduler
+                  steps, router pump, autoscaler ticks, and a spill
+                  task permuted against each other — emitted tokens
+                  must be IDENTICAL to the single-threaded oracle and
+                  across seeds (control-plane tick order is a pure
+                  performance knob, never an output change)
+
+Per (lane, seed) the harness trace digest is pinned in the ledger; the
+lane coherence assertions are hard in every mode. Everything is seeded:
+a red gate is a concurrency regression (or an unreviewed schedule
+change), never flake.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+DEFAULT_PATH = os.path.join(_REPO, "CONCURRENCY.json")
+SEEDS = (11, 23)
+
+
+# ----------------------------------------------------------------------
+# dynamic lanes — each returns (trace_digest, outcome_dict); the
+# outcome must be identical across seeds (asserted by the driver)
+# ----------------------------------------------------------------------
+
+def _lane_spill_store(seed: int):
+    import numpy as np
+    from deepspeed_tpu.inference.offload_store import HostKvSpillStore
+    from deepspeed_tpu.resilience.interleave import CooperativeScheduler
+
+    sched = CooperativeScheduler(seed=seed)
+    store = HostKvSpillStore(capacity_bytes=1 << 16)
+    sched.instrument(store, ["_lock"])
+    payload = {"k": np.zeros(512, np.uint8)}  # 512 B/entry, cap = 128
+
+    def producer(base):
+        def fn():
+            for i in range(8):
+                store.put((base, i), dict(payload))
+                sched.yield_point(f"put:{base}")
+        return fn
+
+    def consumer():
+        got = 0
+        while got < 8:
+            for i in range(8):
+                if store.get(("a", i)) is not None:
+                    got += 1
+            sched.yield_point("sweep")
+
+    def discarder():
+        for i in range(8):
+            store.discard(("b", i))
+            sched.yield_point("discard")
+
+    sched.spawn("prod_a", producer("a"))
+    sched.spawn("prod_b", producer("b"))
+    sched.spawn("cons", consumer)
+    sched.spawn("disc", discarder)
+    sched.run()
+    # coherence: whatever survived must account for every byte, and
+    # every admitted entry must be consumed, discarded, or resident
+    resident = len(store._entries)
+    assert store.used_bytes == sum(store._bytes.values()), \
+        (store.used_bytes, store._bytes)
+    c = store.counters
+    assert c["puts"] == c["gets"] + c["discards"] + resident, c
+    assert store.peak_bytes >= store.used_bytes
+    return sched.trace_digest(), {
+        "puts": c["puts"], "gets": c["gets"],
+        "rejects": c["rejects"],
+        "final_used_plus_discarded_bytes":
+            store.used_bytes + 512 * c["discards"],
+    }
+
+
+def _lane_fault_plan(seed: int):
+    from deepspeed_tpu.resilience import FaultPlan, armed, fault_point
+    from deepspeed_tpu.resilience.interleave import CooperativeScheduler
+
+    n = 12
+    plan = FaultPlan([{"point": "race.lane", "kind": "skip",
+                       "at": 1, "times": -1}], seed=0)
+    sched = CooperativeScheduler(seed=seed)
+    sched.instrument(plan, ["_lock"])
+    skips = {"x": 0, "y": 0}
+
+    def hitter(name):
+        def fn():
+            for _ in range(n):
+                act = fault_point("race.lane", lane=name)
+                if act is not None and act.kind == "skip":
+                    skips[name] += 1
+                sched.yield_point(f"hit:{name}")
+        return fn
+
+    def resetter():
+        for _ in range(3):
+            plan.reset()
+            sched.yield_point("reset")
+
+    with armed(plan):
+        sched.spawn("hit_x", hitter("x"))
+        sched.spawn("hit_y", hitter("y"))
+        sched.spawn("reset", resetter)
+        sched.run()
+    # coherence: a times=-1 skip spec fires on EVERY match no matter
+    # how reset() interleaves — a lost increment would break this
+    assert skips["x"] == n and skips["y"] == n, skips
+    assert plan._matched[0] + 3 * 0 <= 2 * n  # resets only shrink
+    return sched.trace_digest(), {"skips_per_hitter": n,
+                                  "resets": 3}
+
+
+def _lane_aio_inflight(seed: int):
+    import numpy as np
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+    from deepspeed_tpu.resilience.interleave import CooperativeScheduler
+
+    with tempfile.TemporaryDirectory(prefix="ds_race_aio_") as d:
+        h = AsyncIOHandle(n_threads=2)
+        sched = CooperativeScheduler(seed=seed)
+        sched.instrument(h, ["_lock"])
+        rng = np.random.default_rng(0)
+        bufs = {i: rng.integers(0, 256, 4096).astype(np.uint8)
+                for i in range(4)}
+        outs = {i: np.empty(4096, np.uint8) for i in range(4)}
+
+        # completion signaling stays INSIDE the harness (baton-
+        # serialized set) rather than polling the filesystem: the
+        # native pool's file visibility lags ds_aio_wait by a beat,
+        # which would make the poll count — and the trace — racy
+        written = set()
+
+        def writer():
+            for i in range(4):
+                h.pwrite(bufs[i], os.path.join(d, f"{i}.bin"))
+                written.add(i)
+                sched.yield_point(f"pwrite:{i}")
+
+        def reader(ids):
+            def fn():
+                for i in ids:
+                    while i not in written:
+                        sched.yield_point(f"wait:{i}")
+                    h.pread(outs[i], os.path.join(d, f"{i}.bin"))
+                    sched.yield_point(f"pread:{i}")
+            return fn
+
+        sched.spawn("writer", writer)
+        sched.spawn("read02", reader((0, 2)))
+        sched.spawn("read13", reader((1, 3)))
+        sched.run()
+        identical = all(bool(np.array_equal(bufs[i], outs[i]))
+                        for i in range(4))
+        assert identical, "aio round-trip corrupted a payload"
+        assert not h._inflight, f"leaked pins: {list(h._inflight)}"
+        return sched.trace_digest(), {"payloads": 4,
+                                      "round_trip_identical": True,
+                                      "native": bool(h.native)}
+
+
+def _serving_fixture():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deepspeed_tpu.inference import init_inference
+    from deepspeed_tpu.models import transformer as T
+
+    mcfg = T.TransformerConfig(
+        vocab_size=128, n_layers=2, n_heads=4, d_model=64,
+        max_seq=64, variant="llama", use_flash=False)
+    params = T.init(mcfg, jax.random.PRNGKey(0))
+
+    def build_engine():
+        return init_inference(
+            params, mcfg,
+            dict(max_seq_len=64, kv_block_size=8, num_kv_blocks=32,
+                 min_prefill_bucket=8, max_batch_size=8),
+            dtype=jnp.float32)
+
+    rng = np.random.default_rng(7)
+    reqs = [(list(rng.integers(1, 128, int(rng.integers(4, 12)))),
+             int(rng.integers(3, 8))) for _ in range(6)]
+    return build_engine, reqs
+
+
+def _serve(build_engine, reqs, seed=None):
+    """Serve `reqs` on a 2-replica router. seed=None: single-threaded
+    oracle. Otherwise: scheduler/pump/autoscaler/spill tasks permuted
+    under the harness at that seed. Returns (tokens, digest|None)."""
+    import numpy as np
+    from deepspeed_tpu.inference import (Autoscaler, RouterFleetAdapter,
+                                         ServingRouter)
+    from deepspeed_tpu.inference.offload_store import HostKvSpillStore
+    from deepspeed_tpu.resilience.interleave import CooperativeScheduler
+
+    router = ServingRouter([build_engine(), build_engine()],
+                           {"mode": "colocated"}, seed=0)
+    gids = [router.submit(p, m) for p, m in reqs]
+
+    def done():
+        return all(router.result(g).done for g in gids)
+
+    if seed is None:
+        while not done():
+            for sj in router.schedulers:
+                if sj.has_work:
+                    sj.step()
+            router.pump()
+        return [list(router.result(g).output) for g in gids], None
+
+    sched = CooperativeScheduler(seed=seed, max_switches=500_000)
+
+    def stepper(j):
+        sj = router.schedulers[j]
+
+        def fn():
+            while not done():
+                if sj.has_work:
+                    sj.step()
+                sched.yield_point(f"step{j}")
+        return fn
+
+    def pump():
+        while not done():
+            router.pump()
+            sched.yield_point("pump")
+
+    def ticker():
+        adapter = RouterFleetAdapter(router, build_engine, join=False)
+        asc = Autoscaler(adapter, dict(
+            enabled=True, min_replicas=2, max_replicas=2,
+            evaluation_interval_s=1.0), clock=lambda: 0.0)
+        t = 0.0
+        while not done():
+            t += 1.0
+            asc.tick(now=t)
+            sched.yield_point("tick")
+        # a min==max fleet must never change size under any schedule
+        assert asc.counters["scale_ups"] == 0
+        assert asc.counters["scale_downs"] == 0
+
+    def spiller():
+        store = HostKvSpillStore(capacity_bytes=1 << 14)
+        sched.instrument(store, ["_lock"])
+        pay = {"k": np.zeros(256, np.uint8)}
+        i = 0
+        while not done():
+            store.put(("s", i), dict(pay))
+            sched.yield_point("spill.put")
+            assert store.get(("s", i)) is not None
+            i += 1
+            sched.yield_point("spill.get")
+        assert store.used_bytes == 0
+
+    sched.spawn("sched0", stepper(0))
+    sched.spawn("sched1", stepper(1))
+    sched.spawn("pump", pump)
+    sched.spawn("autoscaler", ticker)
+    sched.spawn("spill", spiller)
+    sched.run()
+    return [list(router.result(g).output) for g in gids], \
+        sched.trace_digest()
+
+
+def _lane_serving_plane(seed: int, _cache={}):
+    import hashlib
+    if "fixture" not in _cache:
+        _cache["fixture"] = _serving_fixture()
+        build_engine, reqs = _cache["fixture"]
+        _cache["oracle"], _ = _serve(build_engine, reqs, seed=None)
+    build_engine, reqs = _cache["fixture"]
+    tokens, digest = _serve(build_engine, reqs, seed=seed)
+    assert tokens == _cache["oracle"], (
+        "token identity broken: interleaved control plane emitted "
+        "different tokens than the single-threaded oracle")
+    tok_h = hashlib.blake2b(
+        json.dumps(tokens).encode(), digest_size=16).hexdigest()
+    return digest, {"requests": len(reqs),
+                    "tokens_equal_oracle": True,
+                    "token_digest": tok_h}
+
+
+LANES = {
+    "spill_store": _lane_spill_store,
+    "fault_plan": _lane_fault_plan,
+    "aio_inflight": _lane_aio_inflight,
+    "serving_plane": _lane_serving_plane,
+}
+
+
+# ----------------------------------------------------------------------
+# driver
+# ----------------------------------------------------------------------
+
+def _run_all(static_only: bool):
+    from deepspeed_tpu.analysis.concurrency import analyze_paths
+
+    rep = analyze_paths([os.path.join(_REPO, "deepspeed_tpu")],
+                        base=_REPO)
+    measured = {
+        "version": 1,
+        "static": {
+            "files": rep.files_checked,
+            "suppressed": sorted(
+                f"{f.path}:{f.line} {f.rule}" for f in rep.suppressed),
+            "classes": rep.ledger,
+        },
+        "lanes": {},
+    }
+    if not static_only:
+        for name, fn in LANES.items():
+            digests, outcome = {}, None
+            for seed in SEEDS:
+                d, out = fn(seed)
+                digests[str(seed)] = d
+                if outcome is None:
+                    outcome = out
+                elif outcome != out:
+                    raise AssertionError(
+                        f"lane {name}: outcome differs across seeds "
+                        f"{SEEDS}: {outcome} != {out}")
+            assert len(set(digests.values())) == len(SEEDS), \
+                f"lane {name}: seeds {SEEDS} produced identical " \
+                "schedules — the harness is not permuting"
+            measured["lanes"][name] = {"trace_digests": digests,
+                                       "outcome": outcome}
+            print(f"[ds-race] lane {name}: ok "
+                  f"({', '.join(digests.values())})", file=sys.stderr)
+    return rep, measured
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--capture", action="store_true",
+                    help="run analyzer + lanes and write the ledger "
+                         f"into {DEFAULT_PATH}")
+    ap.add_argument("--check", action="store_true",
+                    help="explicit check mode (the default)")
+    ap.add_argument("--strict", action="store_true",
+                    help="also fail on suppression-count growth vs the "
+                         "committed ledger (findings always fail)")
+    ap.add_argument("--static-only", action="store_true",
+                    help="analyzer + ledger diff only, skip the "
+                         "interleave lanes")
+    ap.add_argument("--json", action="store_true",
+                    help="print the measured ledger to stdout")
+    args = ap.parse_args(argv)
+
+    rep, measured = _run_all(args.static_only)
+    print(f"[ds-race] {rep.summary()}", file=sys.stderr)
+    rc = 0
+
+    # races have no baseline: any active finding is red in every mode
+    if rep.findings:
+        for f in rep.findings:
+            print(f"[ds-race] {f.rule} {f.path}:{f.line} {f.message}",
+                  file=sys.stderr)
+        rc = 1
+
+    if args.capture:
+        if rc == 0:
+            with open(DEFAULT_PATH, "w") as fh:
+                json.dump(measured, fh, indent=1, sort_keys=True)
+                fh.write("\n")
+            print(f"[ds-race] wrote {DEFAULT_PATH}", file=sys.stderr)
+    else:
+        if not os.path.exists(DEFAULT_PATH):
+            print(f"[ds-race] no committed ledger at {DEFAULT_PATH} — "
+                  "run --capture first", file=sys.stderr)
+            rc = 1
+        else:
+            with open(DEFAULT_PATH) as fh:
+                committed = json.load(fh)
+            if args.static_only:
+                # compare only the halves we measured
+                committed = {"version": committed.get("version"),
+                             "static": committed.get("static"),
+                             "lanes": {}}
+            if committed != measured:
+                # suppression drift alone is advisory unless --strict:
+                # a new pragma is reviewable in the diff of the file
+                # that carries it, but strict CI pins the full ledger
+                if not args.strict and \
+                        _strip_suppressions(committed) == \
+                        _strip_suppressions(measured):
+                    print("[ds-race] suppression drift (non-strict: "
+                          "warning only) — committed "
+                          f"{(committed.get('static') or {}).get('suppressed')}"
+                          f" -> measured "
+                          f"{measured['static']['suppressed']}",
+                          file=sys.stderr)
+                else:
+                    _diff(committed, measured, args.strict)
+                    rc = 1
+
+    if args.json:
+        print(json.dumps(measured, indent=1, sort_keys=True))
+    print(json.dumps({"ok": rc == 0, "gate": "ds_race",
+                      "strict": bool(args.strict)}), file=sys.stderr)
+    return rc
+
+
+def _strip_suppressions(ledger):
+    """A deep copy with pragma-suppression info removed — the part of
+    the ledger non-strict mode treats as advisory."""
+    out = json.loads(json.dumps(ledger))
+    (out.get("static") or {}).pop("suppressed", None)
+    for cls in ((out.get("static") or {}).get("classes") or {}).values():
+        cls.pop("suppressed", None)
+    return out
+
+
+def _diff(committed, measured, strict: bool) -> None:
+    """Print a targeted ledger diff (classes / lanes / counts)."""
+    cs = (committed.get("static") or {})
+    ms = measured["static"]
+    if cs.get("suppressed") != ms["suppressed"]:
+        print(f"[ds-race] suppression count drift: committed "
+              f"{cs.get('suppressed')} -> measured {ms['suppressed']}",
+              file=sys.stderr)
+    cc = cs.get("classes") or {}
+    mc = ms["classes"]
+    for k in sorted(set(cc) | set(mc)):
+        if cc.get(k) != mc.get(k):
+            print(f"[ds-race] class ledger drift: {k}", file=sys.stderr)
+            print(f"    committed: {json.dumps(cc.get(k), sort_keys=True)}",
+                  file=sys.stderr)
+            print(f"    measured:  {json.dumps(mc.get(k), sort_keys=True)}",
+                  file=sys.stderr)
+    cl = committed.get("lanes") or {}
+    ml = measured["lanes"]
+    for k in sorted(set(cl) | set(ml)):
+        if cl.get(k) != ml.get(k):
+            print(f"[ds-race] lane drift: {k}", file=sys.stderr)
+            print(f"    committed: {json.dumps(cl.get(k), sort_keys=True)}",
+                  file=sys.stderr)
+            print(f"    measured:  {json.dumps(ml.get(k), sort_keys=True)}",
+                  file=sys.stderr)
+    print("[ds-race] ledger drift: rerun with --capture after review "
+          "(races never have a baseline; only the lock ledger and "
+          "schedule digests do)", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -100,6 +100,17 @@ compiles nothing after warmup, the expert-utilization census reaches
 scheduler.metrics(), and a rerun is byte-identical.
 scripts/ds_moe.py gates this in CI.
 
+`python bench.py --overlap-probe` runs the COMM/COMPUTE-OVERLAP probe
+(docs/overlap.md) on the virtual 8-device CPU mesh: the two canonical
+training programs (flat zero-3+TP train_step, interleaved-pipeline
+3D train_step_pipe3d) each compiled overlap_comm on vs off, printing
+the S009 step-time projections, exposed-comm fractions, the projected
+on/off delta, and a wall-clock CPU probe per pair (CPU schedules all
+collectives synchronously, so wall time bounds restructure overhead
+while the projection pair carries the hiding win). Wired behind the
+bench_device_guard infra-flake policy like every device lane;
+scripts/ds_schedule.py gates the committed exposure pin in CI.
+
 `python bench.py --autoscale-sim [plan]` (plan = 'default' =
 AUTOSCALE.json, or a path) runs the ELASTIC-AUTOSCALING lane
 (docs/autoscaling.md), two tiers sharing ONE Autoscaler policy code
@@ -3655,9 +3666,130 @@ def _serving_7b_bench(on_tpu: bool):
         return None
 
 
+def _overlap_probe():
+    """Comm/compute-overlap probe (docs/overlap.md): the two canonical
+    training programs — the flat zero-3+TP train_step and the
+    interleaved-pipeline 3D train_step_pipe3d (V=2) — each compiled
+    twice, overlap_comm on vs off, on the virtual 8-device CPU mesh.
+    Prints ONE JSON line with the S009 step-time projection and
+    exposed-comm fraction for every (program, mode) pair, the
+    projected on/off delta, and a short wall-clock CPU probe (real
+    train_batch steps; CPU compiles every collective synchronously,
+    so the wall numbers bound the restructure's OVERHEAD — the
+    projection pair carries the hiding win). Exit 0 unless the
+    backend yields no schedule artifacts."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+    from deepspeed_tpu.platform.accelerator import bench_device_guard
+
+    rc = bench_device_guard("overlap_probe_step_time_delta")
+    if rc is not None:
+        return rc
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import transformer as T
+
+    def flat_engine(overlap):
+        mcfg = T.TransformerConfig(
+            vocab_size=128, n_layers=2, n_heads=4, d_model=64,
+            max_seq=32, variant="llama", use_flash=False)
+        eng = ds.initialize(
+            {"train_micro_batch_size_per_gpu": 1,
+             "gradient_accumulation_steps": 2,
+             "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+             "zero_optimization": {"stage": 3,
+                                   "param_persistence_threshold": 64,
+                                   "overlap_comm": overlap},
+             "bf16": {"enabled": True},
+             "mesh": {"data": 4, "model": 2},
+             "steps_per_print": 10**9},
+            loss_fn=T.make_loss_fn(mcfg),
+            param_init_fn=lambda k: T.init(mcfg, k),
+            param_logical_specs=T.logical_specs(mcfg))
+        batch = {"tokens": np.zeros(
+            (eng.config.train_batch_size, 33), np.int32)}
+        return eng, batch
+
+    def pipe_engine(overlap):
+        pcfg = T.TransformerConfig(
+            vocab_size=128, n_layers=4, n_heads=4, d_model=64,
+            max_seq=128, variant="llama", use_flash=False,
+            pipeline_stages=2, pipeline_virtual_stages=2)
+        eng = ds.initialize(
+            {"train_micro_batch_size_per_gpu": 2,
+             "gradient_accumulation_steps": 8,
+             "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+             "zero_optimization": {"stage": 3,
+                                   "param_persistence_threshold": 64,
+                                   "overlap_comm": overlap},
+             "bf16": {"enabled": True},
+             "mesh": {"pipe": 2, "data": 2, "model": 2},
+             "steps_per_print": 10**9},
+            loss_fn=T.make_pipelined_loss_fn(pcfg),
+            param_init_fn=lambda k: T.init(pcfg, k),
+            param_logical_specs=T.logical_specs(pcfg),
+            pipelined=True, pipeline_virtual_stages=2)
+        batch = {"tokens": np.zeros(
+            (eng.config.train_batch_size, 129), np.int32)}
+        return eng, batch
+
+    out = {"programs": {}}
+    ok = False
+    for name, build, steps in (("train_step", flat_engine, 3),
+                               ("train_step_pipe3d", pipe_engine, 2)):
+        entry = {}
+        for mode, overlap in (("on", True), ("off", False)):
+            eng, batch = build(overlap)
+            san = eng.sanitize(batch)
+            sched = getattr(san.cost, "_schedule", None) \
+                if san.cost is not None else None
+            eng.train_batch(batch)  # compile + warmup
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                eng.train_batch(batch)
+            wall_ms = (time.perf_counter() - t0) / steps * 1e3
+            rec = {"wall_ms_cpu": round(wall_ms, 2)}
+            if sched is not None:
+                ok = True
+                rec.update({
+                    "s009_step_time_us": round(sched.step_time_s * 1e6, 3),
+                    "exposed_comm_us": round(sched.exposed_s * 1e6, 3),
+                    "exposed_comm_fraction": round(
+                        sched.exposed_comm_fraction, 4),
+                    "n_hidden_sync": sched.n_hidden_sync,
+                })
+            entry[mode] = rec
+        on, off = entry["on"], entry["off"]
+        if "s009_step_time_us" in on and "s009_step_time_us" in off:
+            entry["projected_speedup"] = round(
+                off["s009_step_time_us"] / max(1e-9,
+                                               on["s009_step_time_us"]), 4)
+            entry["exposed_us_hidden"] = round(
+                off["exposed_comm_us"] - on["exposed_comm_us"], 3)
+        out["programs"][name] = entry
+    deltas = [e.get("projected_speedup", 1.0)
+              for e in out["programs"].values()]
+    print(json.dumps({
+        "metric": "overlap_probe_step_time_delta",
+        "value": round(min(deltas), 4) if ok else 0.0,
+        "unit": "x_projected_off_over_on",
+        **out,
+        **({} if ok else {"error": "no schedule artifacts on this "
+                                   "backend; probe inconclusive"}),
+    }))
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
     if "--prefix-microbench" in sys.argv[1:]:
         sys.exit(_prefix_cache_microbench())
+    if "--overlap-probe" in sys.argv[1:]:
+        sys.exit(_overlap_probe())
     if "--train-chaos" in sys.argv[1:]:
         argv = sys.argv[1:]
         i = argv.index("--train-chaos")

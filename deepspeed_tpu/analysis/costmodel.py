@@ -167,11 +167,18 @@ def _is_sharded(sharding: Optional[str]) -> bool:
 
 
 def build_cost_report(compiled: Any, label: str = "program",
+                      hide_sync_slack: bool = True,
                       ) -> Optional[CostReport]:
     """Cost profile of one compiled program, or None when even the HLO
     text is unavailable. Degrades gracefully: without memory_analysis()
     (some backends) the argument footprint is rebuilt from the entry
-    parameters and `estimated` is set."""
+    parameters and `estimated` is set.
+
+    hide_sync_slack feeds the schedule analyzer's latency-hiding
+    credit (analysis/schedule.py): the engine passes
+    `zero_optimization.overlap_comm` here, so an overlap-off engine's
+    S009 projection models serialized execution — the overlap-off twin
+    ds_schedule commits."""
     try:
         text = compiled.as_text()
     except Exception:
@@ -227,7 +234,7 @@ def build_cost_report(compiled: Any, label: str = "program",
         sched = analyze_schedule(
             text, flops=rep.flops, bytes_accessed=rep.bytes_accessed,
             peak_flops=peak, hbm_bandwidth=hbm, n_devices=n_devices,
-            label=label)
+            label=label, hide_sync_slack=hide_sync_slack)
     except Exception:
         sched = None
     if sched is not None:

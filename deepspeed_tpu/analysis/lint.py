@@ -115,7 +115,12 @@ _HOT_FILES = ("runtime/engine.py", "inference/engine.py",
               # are imported by the ds_race gate and by lint itself —
               # a stray host sync here would tax every lint/gate run
               # and, for the harness, every instrumented lock op
-              "analysis/concurrency.py", "resilience/interleave.py")
+              "analysis/concurrency.py", "resilience/interleave.py",
+              # the overlap layer traces into every training step's
+              # forward scan and gradient path (prefetch gathers,
+              # bucketed scatters, barrier pins) — a host sync here
+              # would serialize the very collectives it exists to hide
+              "runtime/overlap.py")
 _HOT_FN_PREFIXES = (
     "train_batch", "eval_batch", "_dispatch", "decode", "_decode",
     "generate", "put", "step", "_sample", "prefill", "_prefill",
@@ -149,6 +154,11 @@ _HOT_FN_PREFIXES = (
     # boundary guard runs once per stage per dispatch
     "pipeline_apply", "partition_layers", "unpartition_layers",
     "stage_slice_keys", "pipe_permute_tick", "simulate_schedule",
+    # comm/compute overlap layer (runtime/overlap.py): the prefetch
+    # scan, bucket launcher, and barrier pins trace into every
+    # overlap-on training step
+    "scan_with_prefetch", "make_prefetch_gather", "bucketed_apply",
+    "bucket_partition", "overlap_stats",
 )
 _SYNC_CALLS = ("block_until_ready", "device_get")
 # serving_readback: the scheduler loop's one named readback point

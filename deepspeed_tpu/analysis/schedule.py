@@ -40,6 +40,7 @@ platform/accelerator.LINKS.
 """
 
 import dataclasses
+import re
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..platform.accelerator import LINKS
@@ -169,17 +170,35 @@ class ScheduleAnalysis:
     def n_collectives(self) -> int:
         return len(self.collectives)
 
+    @property
+    def exposed_comm_fraction(self) -> float:
+        """Exposed share of total wire time, in [0, 1] — the quantity
+        the overlap gate budgets (SCHEDULE.json `overlap` pins): 0
+        means the schedule hides every collective, 1 means fully
+        serialized comm."""
+        return self.exposed_s / self.t_comm_s if self.t_comm_s > 0 else 0.0
+
+    @property
+    def n_hidden_sync(self) -> int:
+        """Sync collectives the slack credit fully hides (wire time
+        > 0, zero exposure) — the overlap layer's scoreboard."""
+        return sum(1 for c in self.collectives
+                   if not c.is_async and c.t_comm_s > 0
+                   and c.exposed_s == 0.0)
+
     def to_dict(self) -> Dict[str, Any]:
         return {
             "n_devices": self.n_devices,
             "n_collectives": self.n_collectives,
             "n_async": self.n_async,
             "n_sync": self.n_sync,
+            "n_hidden_sync": self.n_hidden_sync,
             "compute_us": self.t_compute_s * 1e6,
             "comm_us": self.t_comm_s * 1e6,
             "exposed_us": self.exposed_s * 1e6,
             "slack_us": self.slack_s * 1e6,
             "step_time_us": self.step_time_s * 1e6,
+            "exposed_comm_fraction": self.exposed_comm_fraction,
         }
 
 
@@ -200,6 +219,77 @@ def _window_cost(weights: List[float], prefix: List[float],
     return prefix[hi] - prefix[lo]
 
 
+# ops that FORWARD a value without executing on it: a consumer of this
+# kind does not end a collective's slack window — the window runs on to
+# the first consumer that does real work. optimization_barrier is the
+# load-bearing member: the overlap layer (runtime/overlap.py) pins a
+# prefetched gather's issue slot with a barrier, and the barrier must
+# not read as the gather's "consumer" or every pinned collective would
+# measure zero slack
+_TUPLING_OPS = frozenset(("tuple", "opt-barrier", "optimization-barrier"))
+
+# layout/dtype packaging: ops (and all-packaging fusions) that XLA's
+# TPU pipeline fuses into the eventual consumer — a convert or copy
+# sitting right after an all-gather does not anchor the gather's
+# schedule position, so consumer search traces through them
+_PACKAGING_OPS = frozenset((
+    "parameter", "constant", "iota", "convert", "copy", "bitcast",
+    "reshape", "transpose", "slice", "dynamic-slice", "broadcast",
+    "tuple", "get-tuple-element", "pad", "reverse",
+))
+
+_GTE_INDEX_RE = re.compile(r"index=(\d+)")
+
+
+def _gte_index(ins: Dict[str, Any]) -> Optional[int]:
+    m = _GTE_INDEX_RE.search(ins.get("attrs") or "")
+    return int(m.group(1)) if m else None
+
+
+def _first_real_consumer(instrs: List[Dict[str, Any]], pos: int,
+                         passthru=None) -> int:
+    """Schedule position of the first instruction after `pos` that
+    consumes instrs[pos]'s value and is not a zero-cost forwarder.
+    Forwarding is traced with tuple-position awareness: a barrier/tuple
+    packing the value tracks WHICH elements hold it, and a
+    get-tuple-element extracting a different element is neither a
+    consumer nor a forwarder — so a pinned gather's window is not
+    cut short by the sibling value its barrier orders it against.
+    Returns len(instrs) when the value is only carried out of the
+    computation (root tuple) — the window then spans the rest of the
+    schedule."""
+    # tracked name -> None (whole value) | set of tuple indices holding it
+    tracked: Dict[str, Optional[set]] = {instrs[pos]["name"]: None}
+    for p in range(pos + 1, len(instrs)):
+        ins = instrs[p]
+        ops = ins["operands"]
+        hits = [o for o in ops if o in tracked]
+        if not hits:
+            continue
+        op = ins["op"]
+        if op in _TUPLING_OPS:
+            idxs = {i for i, o in enumerate(ops) if o in tracked}
+            prev = tracked.get(ins["name"])
+            tracked[ins["name"]] = (None if prev is None
+                                    and ins["name"] in tracked
+                                    else idxs | (prev or set()))
+            continue
+        if op == "get-tuple-element":
+            src_idx = tracked[hits[0]]
+            k = _gte_index(ins)
+            if src_idx is None or k is None or k in src_idx:
+                tracked[ins["name"]] = None
+            continue
+        if op == "bitcast":
+            tracked[ins["name"]] = tracked[hits[0]]
+            continue
+        if passthru is not None and passthru(ins):
+            tracked[ins["name"]] = None
+            continue
+        return p
+    return len(instrs)
+
+
 def analyze_schedule(
     hlo_text: str,
     flops: float = 0.0,
@@ -209,6 +299,7 @@ def analyze_schedule(
     ici_bandwidth: Optional[float] = None,
     n_devices: int = 1,
     label: str = "program",
+    hide_sync_slack: bool = True,
 ) -> ScheduleAnalysis:
     """Parse one compiled module's schedule into a ScheduleAnalysis.
 
@@ -219,10 +310,22 @@ def analyze_schedule(
     matter for overlap accounting). Collective wire time is the ring
     model over the replica-group size at `ici_bandwidth` (the LINKS
     authority). Async `-start`/`-done` pairs get their achieved overlap
-    from the compute scheduled inside the window; synchronous
-    collectives are fully exposed and their `slack` — compute between
-    the collective and its first consumer — is what S007 reports as
-    hideable."""
+    from the compute scheduled inside the window; a synchronous
+    collective's `slack` — compute between it and its first real
+    consumer (forwarding tuples/GTEs/barriers traced through) — is
+    what S007 reports as hideable.
+
+    hide_sync_slack=True (the default) additionally CREDITS that slack
+    as achieved overlap, min(slack, wire time) per sync collective: the
+    static projection of XLA's TPU latency-hiding scheduler, which
+    converts a sync collective into an async start/done pair spanning
+    to its first consumer. The CPU test backend compiles every
+    collective synchronous, so without this credit no source-level
+    scheduling change is measurable. hide_sync_slack=False models
+    serialized execution (every sync collective fully exposed) — the
+    engine maps `zero_optimization.overlap_comm: false` onto it, and
+    ds_schedule commits the pair as the overlap-on/overlap-off twin
+    pins (docs/overlap.md)."""
     ici_bw = (LINKS["ici_bytes_per_s"] if ici_bandwidth is None
               else float(ici_bandwidth))
     comps, _entry = parse_hlo_computations(hlo_text)
@@ -231,24 +334,64 @@ def analyze_schedule(
                           bytes_accessed / max(hbm_bandwidth, 1.0))
 
     # one weight list per computation (each body counted once — while
-    # trip counts are not static; call-site ops are zero-cost so a
-    # fusion body is not double-counted against its caller)
+    # trip counts are not static). A fusion's cost is charged to its
+    # CALL SITE rather than its body: fused bodies cannot contain
+    # collectives, and a heavily-fused while body would otherwise
+    # present zero-weight slack windows to the collectives scheduled
+    # between its fusion calls. Fusion-body computations are excluded
+    # from the normalization total so the cost is not double-counted.
+    raw_weight: Dict[str, float] = {}
+    fusion_bodies: set = set()
+    for cname, instrs in comps.items():
+        raw_weight[cname] = sum(
+            0.0 if (i["op"] in _ZERO_COST_OPS
+                    or _base_op(i["op"]) is not None
+                    or i["op"].endswith("-done"))
+            else float(i["nbytes"])
+            for i in instrs)
+        for i in instrs:
+            if i["op"] == "fusion":
+                fusion_bodies.update(i["called"])
     weight_total = 0.0
     comp_weights: Dict[str, List[float]] = {}
     comp_prefix: Dict[str, List[float]] = {}
     for cname, instrs in comps.items():
-        ws = [0.0 if (i["op"] in _ZERO_COST_OPS
-                      or _base_op(i["op"]) is not None
-                      or i["op"].endswith("-done"))
-              else float(i["nbytes"])
-              for i in instrs]
+        ws = []
+        for i in instrs:
+            if i["op"] == "fusion":
+                ws.append(sum(raw_weight.get(c, 0.0) for c in i["called"]))
+            elif (i["op"] in _ZERO_COST_OPS
+                  or _base_op(i["op"]) is not None
+                  or i["op"].endswith("-done")):
+                ws.append(0.0)
+            else:
+                ws.append(float(i["nbytes"]))
         comp_weights[cname] = ws
         pre = [0.0]
         for w in ws:
             pre.append(pre[-1] + w)
         comp_prefix[cname] = pre
-        weight_total += pre[-1]
+        if cname not in fusion_bodies:
+            weight_total += pre[-1]
     unit = (out.t_compute_s / weight_total) if weight_total > 0 else 0.0
+
+    # while-loop bodies: a collective here whose only consumer is the
+    # root carry is consumed NEXT iteration — the window XLA's
+    # collective pipeliner rotates it across (one full body)
+    loop_bodies: set = set()
+    for instrs in comps.values():
+        for i in instrs:
+            if i["op"] == "while":
+                loop_bodies.update(i["called"])
+
+    def _packaging(ins: Dict[str, Any]) -> bool:
+        op = ins["op"]
+        if op in ("convert", "copy"):
+            return True
+        if op == "fusion":
+            return all(j["op"] in _PACKAGING_OPS
+                       for c in ins["called"] for j in comps.get(c, ()))
+        return False
 
     for cname, instrs in comps.items():
         ws, pre = comp_weights[cname], comp_prefix[cname]
@@ -279,15 +422,24 @@ def analyze_schedule(
                 node.overlap_s = _window_cost(ws, pre, pos + 1,
                                               done) * unit
             else:
-                # serialized: zero overlap, but measure the compute
-                # between this collective and its first consumer — the
-                # overlap an async rewrite would win
-                cons = next(
-                    (p for p in range(pos + 1, len(instrs))
-                     if ins["name"] in instrs[p]["operands"]),
-                    len(instrs))
-                node.slack_s = _window_cost(ws, pre, pos + 1,
-                                            cons) * unit
+                # serialized in the artifact: measure the compute
+                # between this collective and its first real consumer —
+                # the window the latency-hiding scheduler spans with an
+                # async rewrite. hide_sync_slack credits it as achieved
+                # overlap; serialized-execution mode leaves it exposed
+                cons = _first_real_consumer(instrs, pos, _packaging)
+                if cons >= len(instrs) and cname in loop_bodies:
+                    # loop-carried (prefetch discipline): spans the
+                    # rest of this body plus the next iteration up to
+                    # the same slot
+                    node.slack_s = (
+                        _window_cost(ws, pre, pos + 1, len(instrs))
+                        + _window_cost(ws, pre, 0, pos)) * unit
+                else:
+                    node.slack_s = _window_cost(ws, pre, pos + 1,
+                                                cons) * unit
+                if hide_sync_slack:
+                    node.overlap_s = min(node.slack_s, node.t_comm_s)
             node.exposed_s = max(0.0, node.t_comm_s - node.overlap_s)
             out.collectives.append(node)
             out.t_comm_s += node.t_comm_s
@@ -301,6 +453,7 @@ def analyze_schedule(
 
 
 def analyze_compiled(compiled: Any, label: str = "program",
+                     hide_sync_slack: bool = True,
                      ) -> Optional[ScheduleAnalysis]:
     """ScheduleAnalysis for a compiled executable (rates from the
     running accelerator), or None when even the HLO text is
@@ -327,7 +480,7 @@ def analyze_compiled(compiled: Any, label: str = "program",
         bytes_accessed=float(cost.get("bytes_accessed", 0.0)),
         peak_flops=peak, hbm_bandwidth=hbm,
         n_devices=int(m.group(1)) if m else 1,
-        label=label)
+        label=label, hide_sync_slack=hide_sync_slack)
 
 
 # ----------------------------------------------------------------------

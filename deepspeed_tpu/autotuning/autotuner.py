@@ -146,6 +146,19 @@ class Autotuner:
             cfg.setdefault("zero_optimization", {})["offload_optimizer"] = {
                 "device": cand["offload_optimizer"]
             }
+        # comm/compute overlap knobs (runtime/overlap.py, docs/overlap.md):
+        # overlap=False builds the serialized twin (collectives scored at
+        # full wire time), prefetch_depth sizes the scan-carried gather
+        # pipeline, bucket_mb the reduce-scatter launch granularity.
+        if cand.get("overlap") is not None:
+            cfg.setdefault("zero_optimization", {})["overlap_comm"] = \
+                bool(cand["overlap"])
+        if cand.get("prefetch_depth") is not None:
+            cfg.setdefault("zero_optimization", {})["prefetch_depth"] = \
+                int(cand["prefetch_depth"])
+        if cand.get("bucket_mb") is not None:
+            cfg.setdefault("zero_optimization", {})["bucket_mb"] = \
+                float(cand["bucket_mb"])
         if int(cand.get("pipe_stages") or 1) > 1:
             # pipeline depth axis: carve a 'pipe' mesh dim; without an
             # explicit candidate mesh the data axis absorbs the rest of
@@ -242,6 +255,8 @@ class Autotuner:
         mesh_shapes: Optional[Sequence[Dict[str, int]]] = None,
         gas_values: Optional[Sequence[int]] = None,
         pipe_configs: Optional[Sequence[Tuple[int, int]]] = None,
+        prefetch_depths: Optional[Sequence[int]] = None,
+        bucket_mbs: Optional[Sequence[float]] = None,
         top_k: int = 3,
         steps: int = 3,
         trial: bool = True,
@@ -263,21 +278,36 @@ class Autotuner:
         (P, V, M) schedule triple, so the three pipeline knobs are all
         searchable; candidates are scored by the same S009 projection
         (the interleave bubble saving shows up as fewer wasted-FLOP
-        scan steps) and pruned by S004 exactly like every other axis."""
+        scan steps) and pruned by S004 exactly like every other axis.
+
+        prefetch_depths / bucket_mbs: the comm/compute-overlap knobs
+        (runtime/overlap.py, docs/overlap.md) as two more axes —
+        prefetch_depth sizes the ZeRO-3 scan-carried gather pipeline,
+        bucket_mb the reduce-scatter launch granularity. Both change
+        WHERE collectives land in the compiled schedule, and the S009
+        projection's slack-credit model prices exactly that, so the
+        overlapped candidate outranks its serialized twin without
+        either running a step (tests/test_overlap.py pins this
+        ordering)."""
         if self.make_batch is None:
             raise ValueError("Autotuner needs make_batch to generate step data")
         if candidates is None:
             meshes = list(mesh_shapes) if mesh_shapes else [None]
             gases = list(gas_values) if gas_values else [None]
             pipes = list(pipe_configs) if pipe_configs else [(1, 1)]
+            depths = list(prefetch_depths) if prefetch_depths else [None]
+            buckets = list(bucket_mbs) if bucket_mbs else [None]
             candidates = [
                 {"zero_stage": st, "micro_batch_size": mb,
                  **({"mesh": m} if m is not None else {}),
                  **({"gas": g} if g is not None else {}),
                  **({"pipe_stages": int(p), "interleave": int(v)}
-                    if int(p) > 1 else {})}
+                    if int(p) > 1 else {}),
+                 **({"prefetch_depth": int(d)} if d is not None else {}),
+                 **({"bucket_mb": float(bk)} if bk is not None else {})}
                 for st in zero_stages for mb in micro_batch_sizes
                 for m in meshes for g in gases for (p, v) in pipes
+                for d in depths for bk in buckets
             ]
         ranked = self.aot_rank(candidates, target_devices=target_devices,
                                hbm_budget_bytes=hbm_budget_bytes)

@@ -66,11 +66,28 @@ class ZeroConfig(ConfigModel):
     zero_quantized_nontrainable_weights: bool = False
     offload_optimizer: OffloadConfig = Field(default_factory=OffloadConfig)
     offload_param: OffloadConfig = Field(default_factory=OffloadConfig)
-    # Accepted no-ops on TPU: grad reduction placement/overlap is scheduled
-    # by the XLA SPMD partitioner (the engine constrains per-micro grads to
-    # the sharded layout inside the accumulation loop, which IS the
-    # reference's overlap_comm; buffers are always contiguous under XLA).
+    # Comm/compute overlap master switch (runtime/overlap.py,
+    # docs/overlap.md): scan-carried ZeRO-3 parameter prefetch, bucketed
+    # gradient reduce-scatter launches, pipeline permute overlap, and the
+    # schedule analyzer's latency-hiding credit. false = the serialized
+    # twin ds_schedule commits (every collective modeled fully exposed,
+    # no prefetch/bucket restructure) — the reference's overlap_comm
+    # semantics (ref: stage_1_and_2.py overlap_comm reduction during bwd).
     overlap_comm: bool = True
+    # How many layers ahead the scanned stack's gathered-weights buffer
+    # runs (ref: partitioned_param_coordinator.py fetch_sub_module +
+    # stage3_prefetch_bucket_size's look-ahead role). 0 disables the
+    # prefetch restructure (per-use gathers at the consumer); >=1 carries
+    # that many gathered layer buffers through the scan. tune_aot
+    # searches this axis.
+    prefetch_depth: int = 1
+    # Gradient reduce-scatter launch-group size in MiB (ref:
+    # stage_1_and_2.py reduce_bucket_size IPG buckets). 0 = one
+    # serialized constraint wall at the accumulation boundary; >0 =
+    # software-pipelined bucket launches (runtime/overlap.bucketed_apply).
+    # tune_aot searches this axis.
+    bucket_mb: float = 32.0
+    # Accepted no-op on TPU: buffers are always contiguous under XLA.
     contiguous_gradients: bool = True
 
 

@@ -630,6 +630,33 @@ def _shard(x, *spec):
     return jax.lax.with_sharding_constraint(x, P(*spec))
 
 
+def _layer_prefetch(cfg: TransformerConfig):
+    """(gather_apply, depth) for the scanned layer stack when the
+    engine's ambient overlap plan carries prefetch specs
+    (runtime/overlap.py — training traces under zero-3 overlap_comm),
+    else None: eval/generation forwards, pipelined stacks (the permute
+    path overlaps instead), and per-period window patterns stay on the
+    plain scan."""
+    if cfg.pipeline_stages > 1 or cfg.attention_window_pattern is not None:
+        return None
+    from ..runtime.overlap import current_plan, make_prefetch_gather
+
+    plan = current_plan()
+    if (plan is None or plan.layer_store_specs is None
+            or plan.prefetch_depth < 1):
+        return None
+    mesh = _ambient_mesh()
+    if mesh is None or mesh.empty:
+        return None
+    from ..platform.mesh import manual_axes_of
+
+    if manual_axes_of(mesh):
+        return None  # partial-manual shard_map traces keep per-use gathers
+    return (make_prefetch_gather(plan.layer_store_specs,
+                                 plan.layer_tp_specs, plan.mesh),
+            plan.prefetch_depth)
+
+
 def _act_quant(x, cfg: TransformerConfig):
     """Fake-quantize activations (STE) when activation_quant_bits is set
     (ref: basic_layer.py activation quantization hooks). Applies in train
@@ -1043,6 +1070,30 @@ def forward_hidden(
         else:
             xs = lp
         return jax.lax.scan(body, x_in, xs)
+
+    _prefetch = _layer_prefetch(cfg)
+    if _prefetch is not None:
+        # ZeRO-3 parameter prefetch (runtime/overlap.py,
+        # docs/overlap.md): the scan carries a gathered-weights buffer
+        # so layer i+depth's shard all-gather issues under layer i's
+        # compute instead of at its own consumer
+        from ..runtime.overlap import scan_with_prefetch
+
+        _gather_fn, _depth = _prefetch
+
+        def seg(x_in, lo, hi, body):  # noqa: F811 — prefetch scan
+            lp = jax.tree.map(lambda t: t[lo:hi], layers)
+            if pld_theta is not None:
+                rest = (layer_rngs[lo:hi],
+                        jnp.arange(lo, hi, dtype=jnp.float32))
+            elif use_rng:
+                rest = (layer_rngs[lo:hi],)
+            else:
+                rest = ()
+            pack = ((lambda w, r: (w,) + tuple(r)) if rest
+                    else (lambda w, r: w))
+            return scan_with_prefetch(body, x_in, lp, rest, pack,
+                                      _gather_fn, _depth)
 
     if cfg.attention_window_pattern is not None:
         # GPT-Neo-class per-layer windows: the window is STATIC in each

@@ -213,8 +213,9 @@ def training_events(engine, step: int, trainer=None,
         monitor.write_events(training_events(engine, step))
         monitor.write_events(training_events(engine, step, trainer))
 
-    Empty for non-pipelined engines. For a pipelined one, emits the
-    schedule accounting of engine.pipeline_schedule_stats():
+    Empty for non-pipelined engines with no overlap schedule. For a
+    pipelined one, emits the schedule accounting of
+    engine.pipeline_schedule_stats():
     `stages`/`interleave`/`microbatches`/`schedule_steps` and
     `bubble_fraction` — the MEASURED bubble replayed from the exact
     iteration counts the compiled scan runs — next to the two closed
@@ -231,13 +232,39 @@ def training_events(engine, step: int, trainer=None,
     fold into the stage view: `stage<s>/straggler_flags` groups the
     trainer's logical-rank flags by the rank's stage (stage-major
     grid, s = rank // dp) and `straggler_stage` names the worst stage
-    (-1 when none flagged)."""
+    (-1 when none flagged).
+
+    Overlap feed (docs/overlap.md; any sanitized training engine,
+    pipelined or flat): the headline exposure numbers of
+    engine.overlap_stats() land under train/overlap —
+    `exposed_comm_us` (wire time the static schedule could not hide
+    behind compute this step), `hideable_slack_us` (the compute
+    windows available to hide it in), `achieved_overlap_frac`
+    (1 - exposed/total comm; 1.0 means every collective is fully
+    hidden) and `n_hidden_sync` — plus the per-bucket reduce-scatter
+    launch/complete ledger as `bucket<i>/launch_us|complete_us|
+    consumer_us|exposed_us|payload_bytes` (window origin at the issue
+    slot: wire done at complete_us, first real consumer at
+    consumer_us; exposed when the wire outlives the window). Absent
+    before engine.sanitize() or on backends without HLO text."""
+    events: List[Event] = []
     stats = engine.pipeline_schedule_stats() if hasattr(
         engine, "pipeline_schedule_stats") else None
+    ov = engine.overlap_stats() if hasattr(engine, "overlap_stats") else None
+    if ov is not None:
+        base = prefix.rsplit("/", 1)[0] or "train"
+        for key in ("exposed_comm_us", "hideable_slack_us",
+                    "achieved_overlap_frac", "n_hidden_sync"):
+            events.append((f"{base}/overlap/{key}", float(ov[key]), step))
+        for i, b in enumerate(ov["buckets"]):
+            for key in ("launch_us", "complete_us", "consumer_us",
+                        "exposed_us", "payload_bytes"):
+                events.append(
+                    (f"{base}/overlap/bucket{i}/{key}", float(b[key]), step))
     if stats is None:
-        return []
-    events: List[Event] = [(f"{prefix}/{name}", float(value), step)
-                           for name, value in sorted(stats.items())]
+        return events
+    events.extend((f"{prefix}/{name}", float(value), step)
+                  for name, value in sorted(stats.items()))
     delays = dict(getattr(engine, "pipe_stage_delay_s", {}) or {})
     for s, d in sorted(delays.items()):
         events.append((f"{prefix}/stage{int(s)}/boundary_delay_s",

@@ -171,6 +171,17 @@ def constraint(x, spec: P, mesh: Mesh):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
+def drop_leading_dims(spec: P, n: int) -> P:
+    """The spec of one slice of a stacked array: drop the first n
+    (stacking) dims' entries and strip trailing Nones. The prefetch
+    gather (runtime/overlap.py) uses this to derive per-layer store/TP
+    slice specs from the engine's stacked `layers` spec trees."""
+    entries = list(spec)[n:]
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
 def batch_spec(batch_leaf_ndim: int, *, leading_accum_dim: bool = False) -> P:
     """Canonical spec for an input-batch leaf: [(gas,) batch, seq, ...].
 

@@ -26,8 +26,13 @@ _DTYPE_BYTES = {
 _COLLECTIVES = (
     "all-gather-start", "all-gather",
     "all-reduce-start", "all-reduce",
-    "reduce-scatter",
-    "all-to-all",
+    # async sugar prints generic async-start wrappers as `<op>-start`
+    # for these two as well (the overlap restructure's bucketed
+    # reduce-scatters land in exactly this form on TPU) — without the
+    # -start alternatives a sugared instance would count ZERO times:
+    # the start site wouldn't match and the sugar hides the wrapped body
+    "reduce-scatter-start", "reduce-scatter",
+    "all-to-all-start", "all-to-all",
     "collective-permute-start", "collective-permute",
     "collective-broadcast",
 )
@@ -86,6 +91,47 @@ def _shape_bytes(dtype: str, dims: str) -> int:
         if d:
             n *= int(d)
     return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def _top_level_elements(result: str) -> List[str]:
+    """Split a tuple result string into its top-level elements
+    (`((a, b), (c, d), u32[])` -> ['(a, b)', '(c, d)', 'u32[]']).
+    Returns [] for a non-tuple result."""
+    result = result.strip()
+    if not result.startswith("("):
+        return []
+    body = result[1:result.rfind(")")]
+    out, depth, start = [], 0, 0
+    for i, ch in enumerate(body):
+        if ch in "({[":  # layout `{1,0}` / dims `[4,128]` commas nest too
+            depth += 1
+        elif ch in ")}]":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            out.append(body[start:i].strip())
+            start = i + 1
+    tailpiece = body[start:].strip()
+    if tailpiece:
+        out.append(tailpiece)
+    return out
+
+
+def _start_payload_bytes(result: str) -> int:
+    """Payload of an async `-start` op's result tuple: the OUTPUT lives
+    in the second top-level element — `(operand(s), output(s), aux...)`
+    — so the payload is that element's shape sum. This matters for ops
+    where max-of-members picks the wrong side: a reduce-scatter-start's
+    output is SMALLER than its input (max would return input bytes),
+    and a collective-permute-start carries trailing u32[] context
+    scalars. Falls back to max over all members when the tuple doesn't
+    have two elements."""
+    elems = _top_level_elements(result)
+    if len(elems) >= 2:
+        return sum(_shape_bytes(s.group("dtype"), s.group("dims"))
+                   for s in _SHAPE_RE.finditer(elems[1]))
+    sizes = [_shape_bytes(s.group("dtype"), s.group("dims"))
+             for s in _SHAPE_RE.finditer(result)]
+    return max(sizes) if sizes else 0
 
 
 _GATHER_RE = re.compile(
@@ -195,8 +241,11 @@ def parse_hlo_collectives(hlo_text: str) -> List[Dict]:
 
     Async `-start` ops return a tuple carrying the input operand alongside
     the output (e.g. `(bf16[4,128], bf16[16,128]) all-gather-start`); the
-    payload is the OUTPUT — the largest member — so tuples from -start
-    forms take max, plain (possibly multi-result all-to-all) forms sum.
+    payload is the OUTPUT — the second top-level tuple element, which
+    also handles multi-operand `((ins), (outs))` forms (outputs summed)
+    and ops whose output is not the largest member (reduce-scatter-start
+    shrinks; collective-permute-start carries trailing u32[] context
+    scalars). Plain (possibly multi-result all-to-all) forms sum.
 
     Each record additionally carries the operand payload (`operand_bytes`,
     summed over the shapes inside the call parens) and the replica-group
@@ -224,7 +273,7 @@ def parse_hlo_collectives(hlo_text: str) -> List[Dict]:
         ]
         if not sizes:
             continue
-        nbytes = max(sizes) if is_start else sum(sizes)
+        nbytes = _start_payload_bytes(result) if is_start else sum(sizes)
         dtypes = sorted({s.group("dtype") for s in _SHAPE_RE.finditer(result)})
         tail = m.group("tail")
         operands = tail.split(")", 1)[0]
@@ -331,7 +380,8 @@ FLOAT_DTYPES = ("f64", "f32") + LOW_PRECISION_FLOATS
 _DTYPE_OP_RE = re.compile(
     r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*"
     r"(?P<result>\((?:[^()]|\([^()]*\))*\)|" + _ARRAY + r")[^\s]*\s+"
-    r"(?P<op>all-reduce-start|all-reduce|reduce-scatter|all-to-all|"
+    r"(?P<op>all-reduce-start|all-reduce|reduce-scatter-start|"
+    r"reduce-scatter|all-to-all-start|all-to-all|"
     r"all-gather-start|all-gather|reduce-window|reduce|convert|dot)"
     r"\((?P<tail>[^\n]*)",
     re.M,

@@ -40,7 +40,7 @@ from ..platform.mesh import build_mesh, data_parallel_size, describe, use_mesh
 from ..resilience.faults import fault_point
 from ..utils.logging import log_dist, logger
 from ..utils.timers import BATCH_TIMER, STEP_TIMER, SynchronizedWallClockTimer, ThroughputTimer
-from . import zero
+from . import overlap, zero
 from .checkpoint import CheckpointEngine
 from .lr_schedules import build_schedule
 from .precision import (
@@ -705,6 +705,44 @@ class DeepSpeedTPUEngine:
             loss_fn = jax.checkpoint(loss_fn, policy=remat_policy, static_argnums=())
         return loss_fn
 
+    def _overlap_plan(self):
+        """The OverlapPlan this engine traces its loss under, or None
+        when zero_optimization.overlap_comm is false (the serialized
+        twin). Prefetch specs (the `layers` subtrees of the storage/TP
+        spec trees) ride along only where the scan-carried gather
+        applies: a flat (non-pipelined) scanned stack under ZeRO-3,
+        with the weight tree not already gathered up front by qwZ /
+        compression transforms."""
+        zcfg = self.config.zero_optimization
+        if not zcfg.overlap_comm:
+            return None
+        layer_store = layer_tp = None
+        if (not self.pipelined
+                and zcfg.stage >= 3
+                and zcfg.prefetch_depth >= 1
+                and self._qwz_apply is None
+                and self._compression is None
+                and isinstance(self.param_specs, dict)
+                and "layers" in self.param_specs):
+            layer_store = self.param_specs["layers"]
+            layer_tp = self.tp_specs["layers"]
+        return overlap.OverlapPlan(
+            mesh=self.mesh,
+            prefetch_depth=zcfg.prefetch_depth,
+            bucket_mb=zcfg.bucket_mb,
+            layer_store_specs=layer_store,
+            layer_tp_specs=layer_tp,
+        )
+
+    def overlap_stats(self):
+        """Per-step overlap feed for monitor.training_events
+        (docs/overlap.md): exposed_comm_us / achieved_overlap_frac /
+        hideable_slack_us plus the per-bucket reduce-scatter ledger,
+        from the last sanitized step's schedule artifact. None before
+        sanitize() or on backends without HLO text."""
+        return overlap.overlap_stats(
+            getattr(self, "_overlap_schedule", None))
+
     def _make_accumulator(self):
         """(master_f32, batch, base_rng, scale, step) -> (mean grads, loss).
 
@@ -716,12 +754,17 @@ class DeepSpeedTPUEngine:
         mesh = self.mesh
         grad_specs = self.grad_specs
         compute_dtype = self.compute_dtype
-        loss_fn = self._remat_wrapped_loss_fn()
         has_aux = self.has_aux
         pipelined = self.pipelined
         qwz_apply = self._qwz_apply
         compression = self._compression
         pld = cfg.progressive_layer_drop
+        # comm/compute overlap (runtime/overlap.py): the plan rides an
+        # ambient scope around the loss trace — forward_hidden picks up
+        # the prefetch specs, runtime/pipe.py the permute reorder
+        plan = self._overlap_plan()
+        loss_fn = overlap.scoped_loss(self._remat_wrapped_loss_fn(), plan)
+        bucket_mb = plan.bucket_mb if plan is not None else 0.0
 
         def with_pld(b, step):
             """Inject the PLD keep-floor theta(t) = (1-θ)e^{-γt}+θ (ref:
@@ -769,10 +812,18 @@ class DeepSpeedTPUEngine:
                     return l * scale, l
 
                 grads, loss = jax.grad(scaled_loss, has_aux=True)(master)
-                grads = jax.tree.map(
-                    lambda g, s: shd.constraint(g, s, mesh), grads, grad_specs
-                )
-                grads = jax.tree.map(lambda g: g * (1.0 / scale), grads)
+                inv = 1.0 / scale
+                if bucket_mb > 0:
+                    # bucketed launches: each bucket's reduce-scatters
+                    # issue under the previous bucket's unscale compute
+                    grads = overlap.bucketed_apply(
+                        grads, grad_specs, mesh, bucket_mb,
+                        lambda j, g: g * inv)
+                else:
+                    grads = jax.tree.map(
+                        lambda g, s: shd.constraint(g, s, mesh),
+                        grads, grad_specs)
+                    grads = jax.tree.map(lambda g: g * inv, grads)
                 return grads, loss
 
             def micro(carry, xs):
@@ -790,10 +841,19 @@ class DeepSpeedTPUEngine:
                 # ZeRO>=2: constrain per-micro grads to the sharded layout →
                 # XLA reduce-scatters inside the accumulation loop
                 # (ref: stage_1_and_2.py overlap_comm reduction during bwd).
-                grads = jax.tree.map(
-                    lambda g, s: shd.constraint(g, s, mesh), grads, grad_specs,
-                )
-                acc = jax.tree.map(jnp.add, acc, grads)
+                if bucket_mb > 0:
+                    # bucket_mb-sized launch groups, pipelined against
+                    # the accumulate adds (runtime/overlap.py)
+                    acc_leaves = jax.tree.leaves(acc)
+                    acc = overlap.bucketed_apply(
+                        grads, grad_specs, mesh, bucket_mb,
+                        lambda j, g: acc_leaves[j] + g)
+                else:
+                    grads = jax.tree.map(
+                        lambda g, s: shd.constraint(g, s, mesh),
+                        grads, grad_specs,
+                    )
+                    acc = jax.tree.map(jnp.add, acc, grads)
                 return (acc, loss_sum + loss), None
 
             zeros = jax.tree.map(
@@ -1234,9 +1294,15 @@ class DeepSpeedTPUEngine:
         )
         from ..platform.accelerator import get_accelerator
 
-        cost = build_cost_report(compiled, label=label)
+        # overlap_comm=False analyzes the schedule in serialized-
+        # execution mode (no latency-hiding credit) — the overlap-off
+        # twin's S009 projection (docs/overlap.md)
+        cost = build_cost_report(
+            compiled, label=label,
+            hide_sync_slack=self.config.zero_optimization.overlap_comm)
         if cost is None:
             return None, []
+        self._overlap_schedule = getattr(cost, "_schedule", None)
         tree = self.state.master if self._use_master else self.state.params
         live = (int(sum(x.nbytes for x in jax.tree.leaves(tree)))
                 if tree is not None else 0)

@@ -37,6 +37,7 @@ from typing import Any, Callable, Optional
 
 import jax
 from ..platform.mesh import ambient_mesh
+from .overlap import barrier as _overlap_barrier, current_plan
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -206,6 +207,8 @@ def pipeline_apply(
             is_leaf=lambda v: v is None or _is_spec(v),
         )
 
+    overlap_hop = current_plan() is not None
+
     def body(carry, xs_t):
         h_state, k_state = carry
         x_t, k_t = xs_t
@@ -217,12 +220,18 @@ def pipeline_apply(
         # ForwardPass on every stage in parallel
         # (ref: pipe/engine.py _exec_forward_pass:653).
         new_state = vstage(stage_params, h_state, k_state, stage_ids)
-        y = jax.tree.map(lambda s: s[-1], new_state)
         # Send/RecvActivation: rotate the register one stage
         # (ref: pipe/p2p.py — here one collective-permute over ICI).
-        h_state = constrain(jax.tree.map(lambda s: jnp.roll(s, 1, axis=0), new_state))
+        rolled = constrain(jax.tree.map(lambda s: jnp.roll(s, 1, axis=0), new_state))
         k_state = jnp.roll(k_state, 1, axis=0)
-        return (h_state, k_state), y
+        if overlap_hop:
+            # permute overlap: the boundary hop is ISSUED before the
+            # exit-row collection below, so the wire rides under the
+            # next iteration's stage compute instead of serializing at
+            # the scan boundary (docs/overlap.md)
+            rolled, new_state = _overlap_barrier((rolled, new_state))
+        y = jax.tree.map(lambda s: s[-1], new_state)
+        return (rolled, k_state), y
 
     (_, _), ys = jax.lax.scan(body, (state, key_state), (xs_in, mb_keys))
     # Microbatch m surfaces at the last stage on iteration m + P - 1.
@@ -389,6 +398,8 @@ def pipeline_apply_circular(
             is_leaf=lambda n: n is None or _is_spec(n),
         )
 
+    overlap_hop = current_plan() is not None
+
     def body(carry, t_idx):
         h_state, k_state, rounds, out_acc = carry
         ent, ext = entry_idx[t_idx], exit_idx[t_idx]
@@ -419,6 +430,13 @@ def pipeline_apply_circular(
             ),
             new_state, h_state,
         )
+        # Rotate one stage — issued BEFORE the exit collection under an
+        # overlap plan, so the boundary hop rides under the collection
+        # and the next chunk's compute (docs/overlap.md).
+        rolled = constrain(jax.tree.map(
+            lambda s: jnp.roll(s, 1, axis=0), new_state))
+        if overlap_hop:
+            rolled, new_state = _overlap_barrier((rolled, new_state))
         # Exit: the slot at stage P-1 on its LAST round just computed a
         # finished microbatch — collect it post-compute, pre-rotate
         # (predicated no-op write when ext < 0), saving the wraparound
@@ -437,12 +455,10 @@ def pipeline_apply_circular(
             ),
             out_acc, new_state,
         )
-        # Rotate one stage; the slot wrapping P-1 -> 0 advances a round.
-        h_state = constrain(jax.tree.map(
-            lambda s: jnp.roll(s, 1, axis=0), new_state))
+        # The slot wrapping P-1 -> 0 advances a round.
         k_state = jnp.roll(k_state, 1, axis=0)
         rounds = jnp.roll(rounds, 1, axis=0).at[0].add(1)
-        return (h_state, k_state, rounds, out_acc), ()
+        return (rolled, k_state, rounds, out_acc), ()
 
     (h_state, k_state, rounds, out_acc), _ = jax.lax.scan(
         body, (state, key_state, rounds0, out_acc), jnp.arange(T)

@@ -60,6 +60,26 @@ sys.path.insert(0, _REPO)
 DEFAULT_PATH = os.path.join(_REPO, "MEMBUDGET.json")
 
 
+def _attach_overlap_pin(on_san, off_san):
+    """Attach the `_overlap` rider to an overlap-on report: measured
+    exposure, the budget ceiling (25% headroom + 2pt floor over the
+    measured fraction, frozen at capture), and the serialized twin's
+    step-time/exposure — ds_schedule serializes and enforces these."""
+    if on_san.cost is None or off_san.cost is None:
+        return
+    s_on = getattr(on_san.cost, "_schedule", None)
+    s_off = getattr(off_san.cost, "_schedule", None)
+    if s_on is None or s_off is None:
+        return
+    frac = s_on.exposed_comm_fraction
+    on_san.cost._overlap = {
+        "exposed_comm_fraction": round(frac, 6),
+        "budget": round(min(1.0, frac * 1.25 + 0.02), 6),
+        "overlap_off_step_time_us": round(s_off.step_time_s * 1e6, 3),
+        "overlap_off_exposed_us": round(s_off.exposed_s * 1e6, 3),
+    }
+
+
 def build_reports():
     """{name: CostReport} for the canonical programs + the live sharded
     param bytes of the train engine (the S005 denominator)."""
@@ -75,20 +95,33 @@ def build_reports():
     mcfg = T.TransformerConfig(
         vocab_size=128, n_layers=2, n_heads=4, d_model=64, max_seq=32,
         variant="llama", use_flash=False)
-    engine = ds.initialize(
-        {"train_micro_batch_size_per_gpu": 1,
-         "gradient_accumulation_steps": 2,
-         "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
-         "zero_optimization": {"stage": 3, "param_persistence_threshold": 64},
-         "bf16": {"enabled": True},
-         "mesh": {"data": 4, "model": 2},
-         "steps_per_print": 10**9},
-        loss_fn=T.make_loss_fn(mcfg),
-        param_init_fn=lambda k: T.init(mcfg, k),
-        param_logical_specs=T.logical_specs(mcfg))
+
+    def _train_engine(overlap=True):
+        return ds.initialize(
+            {"train_micro_batch_size_per_gpu": 1,
+             "gradient_accumulation_steps": 2,
+             "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+             "zero_optimization": {"stage": 3,
+                                   "param_persistence_threshold": 64,
+                                   "overlap_comm": overlap},
+             "bf16": {"enabled": True},
+             "mesh": {"data": 4, "model": 2},
+             "steps_per_print": 10**9},
+            loss_fn=T.make_loss_fn(mcfg),
+            param_init_fn=lambda k: T.init(mcfg, k),
+            param_logical_specs=T.logical_specs(mcfg))
+
+    engine = _train_engine()
     batch = {"tokens": np.zeros(
         (engine.config.train_batch_size, 33), np.int32)}
     san = engine.sanitize(batch)
+    # the serialized twin: same program, overlap_comm: false — no
+    # prefetch/bucket restructure and every sync collective scored
+    # fully exposed. The pair is SCHEDULE.json's S007/S009 exposure
+    # pin: overlap-on fraction <= budget AND overlap-on step time
+    # strictly under the twin's (docs/overlap.md)
+    off_san = _train_engine(overlap=False).sanitize(batch)
+    _attach_overlap_pin(san, off_san)
     tree = engine.state.master if engine._use_master else engine.state.params
     live = int(sum(x.nbytes for x in jax.tree.leaves(tree)))
 
@@ -120,7 +153,7 @@ def build_reports():
     # is visible — the V=1 twin is compiled alongside and the pair's
     # S009 projections ride SCHEDULE.json as the committed
     # interleave-wins pin)
-    def _pipe_engine(v):
+    def _pipe_engine(v, overlap=True):
         pcfg = T.TransformerConfig(
             vocab_size=128, n_layers=4, n_heads=4, d_model=64,
             max_seq=128, variant="llama", use_flash=False,
@@ -130,7 +163,8 @@ def build_reports():
              "gradient_accumulation_steps": 8,
              "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
              "zero_optimization": {"stage": 3,
-                                   "param_persistence_threshold": 64},
+                                   "param_persistence_threshold": 64,
+                                   "overlap_comm": overlap},
              "bf16": {"enabled": True},
              "mesh": {"pipe": 2, "data": 2, "model": 2},
              "steps_per_print": 10**9},
@@ -144,6 +178,7 @@ def build_reports():
 
     pipe_san = _pipe_engine(2)
     pipe_v1_san = _pipe_engine(1)
+    _attach_overlap_pin(pipe_san, _pipe_engine(2, overlap=False))
     if pipe_san.cost is not None and pipe_v1_san.cost is not None:
         s2 = getattr(pipe_san.cost, "_schedule", None)
         s1 = getattr(pipe_v1_san.cost, "_schedule", None)

@@ -14,13 +14,18 @@ committed tolerance fails here before pytest ever runs. Canonical
 programs — compiled on the virtual 8-device CPU mesh, no step executed
 (same pair as ds_budget):
 
-  train_step        the zero-3 + TP fused training step
+  train_step        the zero-3 + TP fused training step; its entry
+                    commits the overlap exposure pin (docs/overlap.md):
+                    overlap-on exposed-comm fraction <= the committed
+                    budget AND overlap-on step time strictly under the
+                    serialized overlap_comm:false twin's
   train_step_moe    the dropless MoE zero-3 + EP + TP training step
   train_step_pipe3d the interleaved-pipeline 3D training step
                     (zero-3 + {data,pipe,model}, circular V=2 —
                     docs/pipeline.md); its entry additionally commits
                     the interleave-wins pin: the V=2 schedule's S009
-                    projection must stay below its V=1 twin's
+                    projection must stay below its V=1 twin's — plus
+                    the same overlap exposure pin as train_step
   serving_decode_w8 the width-8 paged-KV decode program
   serving_decode_w8_int8
                     the width-8 FUSED Pallas decode program over the
@@ -93,6 +98,14 @@ def _entry(rep, sched):
         # a schedule change that grows the interleaved program's
         # critical path past the plain pipeline fails --check
         e["pipe_projection"] = proj
+    ov = getattr(rep, "_overlap", None)
+    if ov is not None:
+        # the exposure-budget pin (docs/overlap.md): the overlap-on
+        # program's exposed-comm fraction must stay under the committed
+        # budget AND its S009 projection strictly under the serialized
+        # (overlap_comm: false) twin's — losing either means a change
+        # re-serialized a hot-path collective
+        e["overlap"] = ov
     bound = getattr(rep, "_s006_bound", None)
     if bound is not None:
         # the fused int8-KV decode program's committed S006 verdict
@@ -195,6 +208,40 @@ def check(path: str, strict: bool) -> int:
                         f"gather (limit {limit}) — the per-step "
                         "block-table gather is back; decode must index "
                         "paged KV blocks in place")})
+        if "overlap" in entry:
+            base_ov = entry["overlap"]
+            cur_ov = getattr(rep, "_overlap", None)
+            if cur_ov is None:
+                findings.append({
+                    "rule": "S007", "severity": "warning", "program": name,
+                    "message": "overlap twin pair was not rebuilt; "
+                               "re-capture"})
+            else:
+                budget = float(base_ov.get("budget", 1.0))
+                frac = float(cur_ov["exposed_comm_fraction"])
+                if frac > budget:
+                    findings.append({
+                        "rule": "S007", "severity": "error",
+                        "program": name,
+                        "message": (
+                            f"overlap-on exposed-comm fraction "
+                            f"{frac:.3f} breached the committed budget "
+                            f"{budget:.3f} — a hot-path collective lost "
+                            "its slack window (docs/overlap.md)")})
+                off_us = float(base_ov.get(
+                    "overlap_off_step_time_us", 0.0))
+                on_us = sched.step_time_s * 1e6
+                if off_us and on_us >= off_us:
+                    findings.append({
+                        "rule": "S009", "severity": "error",
+                        "program": name,
+                        "message": (
+                            f"overlap-on step-time projection "
+                            f"{on_us:.1f}us no longer beats the "
+                            f"committed serialized twin "
+                            f"({off_us:.1f}us) — the overlap layer "
+                            "stopped paying for itself "
+                            "(docs/overlap.md)")})
         if "pipe_projection" in entry:
             proj = getattr(rep, "_pipe_projection", None)
             if proj is None:

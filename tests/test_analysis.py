@@ -80,8 +80,11 @@ class TestHloParserHardening:
                "all-gather-start(bf16[4,128]{1,0} %x, bf16[8,128]{1,0} %y)")
         recs = parse_hlo_collectives(hlo)
         assert len(recs) == 1
-        # -start forms take the max member (the output payload)
-        assert recs[0]["bytes"] == 32 * 128 * 2
+        # -start result is ((operands), (outputs), aux...): the wire
+        # payload is the OUTPUT group summed, not the max member (see
+        # tests/test_profiling.py::TestHloAccounting for the sugared
+        # reduce-scatter/permute cases this fixes)
+        assert recs[0]["bytes"] == (16 + 32) * 128 * 2
 
     def test_scalar_and_spaced_dims(self):
         from deepspeed_tpu.profiling.hlo import parse_hlo_collectives

@@ -78,6 +78,88 @@ class TestHloAccounting:
         assert recs[0]["op"] == "all-gather"
         assert recs[0]["bytes"] == 16 * 128 * 2  # output only, not input+output
 
+    def test_sugared_reduce_scatter_start_counts_output_once(self):
+        """Async sugar prints reduce-scatter as `reduce-scatter-start`;
+        the payload is the OUTPUT (second tuple element — the SMALLER
+        member: max-of-members would return the input bytes)."""
+        from deepspeed_tpu.profiling.hlo import parse_hlo_collectives
+
+        hlo = ("%rs = (f32[16,128]{1,0}, f32[2,128]{1,0}) "
+               "reduce-scatter-start(f32[16,128]{1,0} %g), "
+               "replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}, "
+               "to_apply=%add")
+        recs = parse_hlo_collectives(hlo)
+        assert len(recs) == 1
+        assert recs[0]["op"] == "reduce-scatter"
+        assert recs[0]["bytes"] == 2 * 128 * 4
+        assert recs[0]["operand_bytes"] == 16 * 128 * 4
+
+    def test_multi_operand_start_sums_output_group(self):
+        """Tuple-of-tuples -start form `((ins), (outs))`: every output
+        member counts (max-of-members would drop the second one)."""
+        from deepspeed_tpu.profiling.hlo import parse_hlo_collectives
+
+        hlo = ("%ag = ((bf16[4,128]{1,0}, bf16[8,64]{1,0}), "
+               "(bf16[16,128]{1,0}, bf16[32,64]{1,0})) "
+               "all-gather-start(bf16[4,128]{1,0} %a, bf16[8,64]{1,0} %b), "
+               "dimensions={0}")
+        recs = parse_hlo_collectives(hlo)
+        assert len(recs) == 1
+        assert recs[0]["bytes"] == (16 * 128 + 32 * 64) * 2
+
+    def test_permute_start_context_scalars_excluded(self):
+        """collective-permute-start carries trailing u32[] context
+        members — only the output element is payload."""
+        from deepspeed_tpu.profiling.hlo import parse_hlo_collectives
+
+        hlo = ("%cp = (bf16[4,128]{1,0}, bf16[4,128]{1,0}, u32[], u32[]) "
+               "collective-permute-start(bf16[4,128]{1,0} %x), "
+               "source_target_pairs={{0,1},{1,0}}")
+        recs = parse_hlo_collectives(hlo)
+        assert len(recs) == 1
+        assert recs[0]["bytes"] == 4 * 128 * 2
+
+    def test_done_ops_never_counted(self):
+        from deepspeed_tpu.profiling.hlo import parse_hlo_collectives
+
+        hlo = ("%agd = bf16[16,128]{1,0} all-gather-done("
+               "(bf16[4,128]{1,0}, bf16[16,128]{1,0}) %ag)")
+        assert parse_hlo_collectives(hlo) == []
+
+    def test_all_to_all_start_sugar_counted(self):
+        from deepspeed_tpu.profiling.hlo import parse_hlo_collectives
+
+        hlo = ("%a2a = (f32[8,32]{1,0}, f32[8,32]{1,0}) "
+               "all-to-all-start(f32[8,32]{1,0} %x), "
+               "replica_groups={{0,1,2,3}}, dimensions={0}")
+        recs = parse_hlo_collectives(hlo)
+        assert len(recs) == 1
+        assert recs[0]["op"] == "all-to-all"
+        assert recs[0]["bytes"] == 8 * 32 * 4
+
+    def test_async_calls_body_counts_exactly_once(self):
+        """A -start site with `calls=` printed alongside its wrapped
+        body: the inner collective is skipped, the start site counts."""
+        from deepspeed_tpu.profiling.hlo import parse_hlo_collectives
+
+        hlo = (
+            "%wrapped_rs (p: f32[16,128]) -> f32[2,128] {\n"
+            "  %p = f32[16,128]{1,0} parameter(0)\n"
+            "  ROOT %rs.1 = f32[2,128]{1,0} reduce-scatter("
+            "f32[16,128]{1,0} %p), replica_groups={{0,1,2,3,4,5,6,7}}, "
+            "dimensions={0}, to_apply=%add\n"
+            "}\n"
+            "ENTRY %main {\n"
+            "  %g = f32[16,128]{1,0} parameter(0)\n"
+            "  %rs-start = ((f32[16,128]{1,0}), (f32[2,128]{1,0})) "
+            "reduce-scatter-start(f32[16,128]{1,0} %g), "
+            "replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}, "
+            "calls=%wrapped_rs\n"
+            "}\n")
+        recs = parse_hlo_collectives(hlo)
+        assert len(recs) == 1
+        assert recs[0]["bytes"] == 2 * 128 * 4
+
     def test_flops_from_cost_analysis(self):
         a = jnp.zeros((256, 256), jnp.float32)
         compiled = jax.jit(lambda a: a @ a).lower(a).compile()

@@ -91,12 +91,14 @@ ENTRY %main (p: f32[4096,4096]) -> f32[4096,4096] {
 """
 
 
-def _seeded_analysis(text, bytes_accessed=1e9, hbm=1e9):
+def _seeded_analysis(text, bytes_accessed=1e9, hbm=1e9,
+                     hide_sync_slack=True):
     """Analysis with a 1-second compute leg (unit weights scale off
     bytes_accessed/hbm) over a synthetic scheduled module."""
     return analyze_schedule(
         text, flops=0.0, bytes_accessed=bytes_accessed, peak_flops=1e12,
-        hbm_bandwidth=hbm, n_devices=8, label="seeded")
+        hbm_bandwidth=hbm, n_devices=8, label="seeded",
+        hide_sync_slack=hide_sync_slack)
 
 
 # ----------------------------------------------------------------------
@@ -256,8 +258,11 @@ class TestCollectiveParsingHardening:
 # ----------------------------------------------------------------------
 
 class TestAnalyzeSchedule:
-    def test_sync_collective_fully_exposed_with_slack(self):
-        a = _seeded_analysis(_SERIALIZED_HLO)
+    def test_sync_collective_serialized_mode_fully_exposed(self):
+        """hide_sync_slack=False models serialized execution (the
+        engine's overlap_comm: false twin): the wire time is fully
+        exposed even though a hideable window exists."""
+        a = _seeded_analysis(_SERIALIZED_HLO, hide_sync_slack=False)
         assert a.n_sync == 1 and a.n_async == 0
         c = a.collectives[0]
         assert c.payload_bytes == 8192 * 1024 * 4
@@ -268,6 +273,18 @@ class TestAnalyzeSchedule:
         # 2/3 of the program's 1s compute leg
         assert c.slack_s == pytest.approx(2 / 3, rel=1e-3)
         assert a.step_time_s == pytest.approx(1.0 + c.t_comm_s)
+
+    def test_sync_collective_slack_credited_by_default(self):
+        """The default models XLA's latency-hiding scheduler: a sync
+        collective with a real consumer window is credited
+        min(slack, wire) of achieved overlap."""
+        a = _seeded_analysis(_SERIALIZED_HLO)
+        c = a.collectives[0]
+        assert c.slack_s == pytest.approx(2 / 3, rel=1e-3)
+        assert c.overlap_s == pytest.approx(c.t_comm_s)
+        assert c.exposed_s == 0.0
+        assert a.n_hidden_sync == 1
+        assert a.step_time_s == pytest.approx(1.0)
 
     def test_async_window_overlap_reduces_exposure(self):
         a = _seeded_analysis(_ASYNC_HLO)
@@ -315,7 +332,7 @@ class TestAnalyzeSchedule:
 
 class TestExposedCommCheck:
     def test_serialized_collective_fires_exactly_once(self):
-        a = _seeded_analysis(_SERIALIZED_HLO)
+        a = _seeded_analysis(_SERIALIZED_HLO, hide_sync_slack=False)
         out = check_exposed_comm(a)
         assert len(out.findings) == 1
         f = out.findings[0]
@@ -333,12 +350,13 @@ class TestExposedCommCheck:
         assert check_exposed_comm(a).ok
 
     def test_below_floor_is_silent(self):
-        a = _seeded_analysis(_SERIALIZED_HLO)
+        a = _seeded_analysis(_SERIALIZED_HLO, hide_sync_slack=False)
         out = check_exposed_comm(a, min_exposed_us=1e6)
         assert out.ok
 
     def test_baseline_regression_fires(self):
-        a = _seeded_analysis(_SERIALIZED_HLO)  # ~293us exposed
+        a = _seeded_analysis(_SERIALIZED_HLO,
+                             hide_sync_slack=False)  # ~293us exposed
         out = check_exposed_comm(a, baseline={"exposed_us": 10.0})
         msgs = [f.message for f in out.findings]
         assert any("regressed" in m for m in msgs)
@@ -456,7 +474,7 @@ class TestStepTimeCheck:
         """The projection is serial-roofline + EXPOSED comm — a fully
         hidden collective costs nothing, unlike the leg sum."""
         hidden = _seeded_analysis(_ASYNC_HLO)
-        serial = _seeded_analysis(_SERIALIZED_HLO)
+        serial = _seeded_analysis(_SERIALIZED_HLO, hide_sync_slack=False)
         assert hidden.t_comm_s > 0
         assert hidden.step_time_s == pytest.approx(hidden.t_compute_s)
         assert serial.step_time_s > serial.t_compute_s

@@ -1,6 +1,6 @@
 """Static analysis for compiled TPU programs and the codebase itself.
 
-Six prongs (see docs/static_analysis.md):
+Seven prongs (see docs/static_analysis.md):
 
   sanitizer — ground-truth checks on compiled/lowered artifacts:
               donation aliasing (S001), PartitionSpec survival (S002),
@@ -23,7 +23,7 @@ Six prongs (see docs/static_analysis.md):
               quantized-collective sanity (N004). Dtype ledgers
               persist to NUMERICS.json
               (`python scripts/ds_numerics.py --capture / --check`).
-  lint      — `ds-lint`, an AST pass with project rules R001-R007
+  lint      — `ds-lint`, an AST pass with project rules R001-R008
               (`python scripts/ds_lint.py --strict`).
   concurrency — interprocedural lockset race detection (C001),
               lock-order deadlock cycles (C002), and callback-thread
@@ -31,6 +31,14 @@ Six prongs (see docs/static_analysis.md):
               lock ledger persists to CONCURRENCY.json
               (`python scripts/ds_race.py --capture / --check`). R003
               is a per-file shim over C001.
+  determinism — RNG-discipline and bitwise-reproducibility analysis:
+              layout-dependent PRNG draws (D001), reassociation hazards
+              on bitwise-pinned programs (D002), host-side ordering
+              nondeterminism (D003), serving draw-key discipline
+              (D004); the rng-op/reduce-class ledger persists to
+              DETERMINISM.json
+              (`python scripts/ds_determinism.py --capture / --check`).
+              R008 is the per-file lint shim over D001.
 """
 
 from .report import Finding, LintReport, SanitizerReport, merge_reports
@@ -78,6 +86,17 @@ from .concurrency import (
     analyze_paths,
     analyze_sources,
 )
+from .determinism import (
+    BITWISE_PINS,
+    BitwisePin,
+    D_RULES,
+    check_draw_keys,
+    check_host_ordering,
+    check_reassociation,
+    check_rng_discipline,
+    pin_for,
+    program_determinism,
+)
 
 __all__ = [
     "Finding",
@@ -120,4 +139,13 @@ __all__ = [
     "ConcurrencyReport",
     "analyze_paths",
     "analyze_sources",
+    "BITWISE_PINS",
+    "BitwisePin",
+    "D_RULES",
+    "check_draw_keys",
+    "check_host_ordering",
+    "check_reassociation",
+    "check_rng_discipline",
+    "pin_for",
+    "program_determinism",
 ]

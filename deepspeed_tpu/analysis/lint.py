@@ -46,6 +46,18 @@ Rules
         cost model's S005 only catches post-compile. Carry the loop
         into `lax.scan`/`lax.fori_loop` (one collective in the
         compiled body) or annotate a deliberately unrolled ring
+  R008  rng draws without a replication pin under a sharded mesh: a
+        `jax.random.uniform/normal/bernoulli/...` draw inside a
+        jit-root body, in a module that manipulates shardings
+        (with_sharding_constraint / shard_map / Mesh), neither wrapped
+        in a `*replicated_draw`-style helper nor pinned through
+        `with_sharding_constraint` — jax's threefry is NOT
+        partitionable, so the SPMD partitioner computes DIFFERENT bits
+        per mesh layout (the PR-14 EP=1 != EP=N router-noise bug; the
+        static companion to the determinism analyzer's D001). Also:
+        unseeded `random.Random()` / `time.time()` in the
+        `scripts/ds_*.py` capture paths — process entropy in a
+        committed ledger
 
 Pragma: `# ds-lint: ok` suppresses every rule on that line (or the line
 below a standalone pragma comment); `# ds-lint: ok R002 <reason>`
@@ -75,6 +87,9 @@ RULES = {
             "body",
     "R007": "collective call inside a Python-level for/while loop in "
             "a jitted body (unrolls to N collectives)",
+    "R008": "rng draw without a replication pin under a sharded mesh "
+            "(layout-dependent threefry bits), or wall-clock/unseeded "
+            "entropy in a ds_* capture script",
 }
 
 _PRAGMA_RE = re.compile(
@@ -120,7 +135,11 @@ _HOT_FILES = ("runtime/engine.py", "inference/engine.py",
               # forward scan and gradient path (prefetch gathers,
               # bucketed scatters, barrier pins) — a host sync here
               # would serialize the very collectives it exists to hide
-              "runtime/overlap.py")
+              "runtime/overlap.py",
+              # the determinism analyzer is imported by engine.sanitize
+              # and the ds_determinism gate — a host sync here would
+              # tax every sanitize/gate run
+              "analysis/determinism.py")
 _HOT_FN_PREFIXES = (
     "train_batch", "eval_batch", "_dispatch", "decode", "_decode",
     "generate", "put", "step", "_sample", "prefill", "_prefill",
@@ -503,6 +522,109 @@ def _check_r007(ctx: _Ctx, root: ast.AST, callbacks: Set[ast.AST]) -> None:
 
 
 # ----------------------------------------------------------------------
+# R008: unpinned rng draws under a sharded mesh + capture-path entropy
+# ----------------------------------------------------------------------
+
+# the jax.random draw surface (key-DERIVATION — split/fold_in — is
+# layout-safe: it computes the same bits on every layout; only DRAWS
+# consume the non-partitionable threefry counter)
+_R008_DRAW_FNS = ("uniform", "normal", "truncated_normal", "bernoulli",
+                  "categorical", "gumbel", "randint", "choice",
+                  "exponential", "laplace", "poisson", "gamma", "beta",
+                  "bits", "random_bits")
+# a module that never touches shardings cannot lay the draw out across
+# a mesh axis — R008 half 1 only looks at modules referencing these
+_R008_MESH_MARKERS = ("with_sharding_constraint", "shard_map",
+                      "use_mesh", "Mesh", "NamedSharding")
+
+
+def _r008_pinned_nodes(tree: ast.Module) -> Set[int]:
+    """ids of AST nodes that sit under a replication pin: inside an
+    argument of a `with_sharding_constraint(...)` call, or inside a
+    lambda/function passed to a `*replicated_draw`-style helper."""
+    pinned: Set[int] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        short = _dotted(node.func).split(".")[-1]
+        if short == "with_sharding_constraint" and node.args:
+            pinned.update(id(n) for n in ast.walk(node.args[0]))
+        elif short.endswith("replicated_draw"):
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                pinned.update(id(n) for n in ast.walk(a))
+    return pinned
+
+
+def _is_capture_script(relpath: str) -> bool:
+    rel = relpath.replace(os.sep, "/")
+    return os.path.basename(rel).startswith("ds_") and \
+        ("scripts" in rel.split("/")[:-1] or "/" not in rel)
+
+
+def _check_r008(ctx: _Ctx, tree: ast.Module, roots: Sequence[ast.AST],
+                callbacks: Set[ast.AST]) -> None:
+    # half 2: wall-clock / unseeded process entropy in a ds_* capture
+    # script — the committed ledger inherits it
+    if _is_capture_script(ctx.relpath):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _dotted(node.func)
+            if callee == "time.time":
+                ctx.emit(
+                    "R008", node,
+                    "time.time() in a capture script — a wall-clock "
+                    "value reaching the committed artifact makes every "
+                    "capture a diff",
+                    "keep timestamps out of the artifact (stderr "
+                    "logging only), or annotate the non-artifact use "
+                    "with `# ds-lint: ok R008 <why>`",
+                    severity="warning",
+                )
+            elif callee == "random.Random" and not node.args:
+                ctx.emit(
+                    "R008", node,
+                    "unseeded random.Random() in a capture script — "
+                    "the ledger inherits process entropy",
+                    "pass an explicit seed",
+                    severity="warning",
+                )
+    # half 1: draws in jit-root bodies of mesh-touching modules must
+    # carry a replication pin (threefry bits are layout-dependent)
+    if not any(isinstance(n, (ast.Attribute, ast.Name)) and
+               (n.attr if isinstance(n, ast.Attribute) else n.id)
+               in _R008_MESH_MARKERS for n in ast.walk(tree)):
+        return
+    pinned = _r008_pinned_nodes(tree)
+    skip: Set[ast.AST] = set()
+    for cb in callbacks:
+        skip.update(ast.walk(cb))
+    for root in roots:
+        for node in ast.walk(root):
+            if node in skip or id(node) in pinned or \
+                    not isinstance(node, ast.Call):
+                continue
+            callee = _dotted(node.func)
+            parts = callee.rsplit(".", 1)
+            if len(parts) != 2 or parts[1] not in _R008_DRAW_FNS or \
+                    not parts[0].endswith("random"):
+                continue
+            ctx.emit(
+                "R008", node,
+                f"{callee}() inside a jitted body in a mesh-touching "
+                "module without a replication pin — jax's threefry is "
+                "not partitionable, so the SPMD partitioner computes "
+                "DIFFERENT bits for the same key depending on the mesh "
+                "layout (the PR-14 EP=1 != EP=N router-noise bug)",
+                "wrap the draw in the _replicated_draw idiom "
+                "(jax.lax.with_sharding_constraint(draw, P())), or "
+                "annotate a deliberately per-layout draw with "
+                "`# ds-lint: ok R008 <why>`",
+                severity="warning",
+            )
+
+
+# ----------------------------------------------------------------------
 # R002: hot-path host syncs
 # ----------------------------------------------------------------------
 
@@ -602,8 +724,11 @@ def _split_suppressed(
             m = _PRAGMA_RE.search(lines[ln - 1])
             if not m:
                 continue
-            named = re.findall(r"R\d{3}", m.group("rules"))
-            if not named or f.rule in named:
+            named = re.findall(r"[A-Z]\d{3}", m.group("rules"))
+            # R003 is the per-file shim over the concurrency analyzer's
+            # C001 — one pragma spelling covers both emitters
+            if not named or f.rule in named or \
+                    (f.rule == "R003" and "C001" in named):
                 ok = True
                 break
         (suppressed if ok else active).append(f)
@@ -630,6 +755,7 @@ def lint_source(source: str, relpath: str) -> Tuple[List[Finding],
     _check_r002(ctx, tree)
     _check_r003(ctx, tree)
     _check_r004(ctx, tree)
+    _check_r008(ctx, tree, roots, callbacks)
     ctx.findings.sort(key=lambda f: (f.line, f.rule))
     return _split_suppressed(ctx.findings, lines)
 

@@ -1549,13 +1549,18 @@ class InferenceEngine:
         checked against the engine's serving dtype — accumulation
         downcasts (N001: an additive reduce below fp32 that jax's
         upcast-by-default semantics would never emit means an explicit
-        override snuck into the model). Compile-time only; defaults to
+        override snuck into the model) — plus the determinism
+        analyzer's D001 on the pre-optimization HLO (a mesh-sharded
+        threefry draw in a decode bucket would make served tokens a
+        function of the TP layout). Compile-time only; defaults to
         the warmed bucket widths (or the smallest bucket before
         warmup). Returns a merged analysis.SanitizerReport."""
         import warnings as _warnings
 
+        from ..analysis.determinism import check_rng_discipline
         from ..analysis.numerics import check_program_numerics
         from ..analysis.report import merge_reports
+        from ..profiling.hlo import preopt_hlo_text
         from ..runtime.precision import PrecisionPolicy, hlo_dtype_name
 
         serving = hlo_dtype_name(self._dtype)
@@ -1581,6 +1586,10 @@ class InferenceEngine:
             reports.append(check_program_numerics(
                 compiled, policy, lowered=lowered,
                 label=f"serving_decode[w{w}]"))
+            pre = preopt_hlo_text(lowered)
+            if pre:
+                reports.append(check_rng_discipline(
+                    pre, label=f"serving_decode[w{w}]"))
         return merge_reports("serving_decode", *reports)
 
     # -- speculative (multi-token-per-stream) decoding -------------------
@@ -1736,6 +1745,9 @@ class InferenceEngine:
             row[order[~keep]] = -np.inf
         probs = np.exp(row - row.max())
         probs /= probs.sum()
+        # v1-parity host sampler: callers that want replayable draws
+        # pass `rng`; bare calls are explicitly best-effort
+        # ds-lint: ok D004 best-effort path, rng param is the replayable route
         gen = rng if rng is not None else np.random.default_rng()
         return int(gen.choice(row.size, p=probs))
 
@@ -1785,6 +1797,10 @@ class InferenceEngine:
         (seed, stream=slot, position), independent of scheduling."""
         from .scheduler import ServingScheduler, ServingSchedulerConfig
 
+        # seed=None asks for a FRESH session seed; the drawn value then
+        # becomes the session's (seed, stream, position) root, so
+        # replay-with-the-returned-seed is exact
+        # ds-lint: ok D004 fresh-seed request; replay threads the drawn seed
         seed_val = (int(np.random.default_rng().integers(2**31))
                     if seed is None else int(seed))
         sched = ServingScheduler(

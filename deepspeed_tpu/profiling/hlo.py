@@ -598,6 +598,23 @@ def parse_hlo_computations(hlo_text: str,
     comps: Dict[str, List[Dict]] = {}
     entry: Optional[str] = None
     cur: Optional[List[Dict]] = None
+
+    def _operand_names(region: str) -> List[str]:
+        # compiled text prefixes operands with % ; the pre-opt dialect
+        # (`lowered.compiler_ir('hlo').as_hlo_text()`) prints bare names
+        names = re.findall(r"%([\w.\-]+)", region)
+        if names or "%" in region:
+            return names
+        inner = region.strip()
+        if inner.startswith("("):
+            inner = inner[1:-1] if inner.endswith(")") else inner[1:]
+        out: List[str] = []
+        for part in inner.split(","):
+            toks = part.split()
+            if toks and "[" not in toks[-1] and "]" not in toks[-1]:
+                out.append(toks[-1])
+        return out
+
     for line in hlo_text.splitlines():
         stripped = line.strip()
         if cur is None:
@@ -631,7 +648,7 @@ def parse_hlo_computations(hlo_text: str,
             "op": m.group("op"),
             "result": m.group("result"),
             "nbytes": nbytes,
-            "operands": re.findall(r"%([\w.\-]+)", region),
+            "operands": _operand_names(region),
             "attrs": attrs,
             "called": re.findall(
                 r"(?:calls|to_apply|body|condition)=%?([\w.\-]+)", attrs),
@@ -652,3 +669,245 @@ def collective_volumes(compiled) -> Dict[str, Dict[str, float]]:
         agg[rec["op"]]["count"] += 1
         agg[rec["op"]]["bytes"] += rec["bytes"]
     return dict(agg)
+
+
+# --- rng extraction (analysis/determinism.py consumer) -----------------
+#
+# The determinism analyzer's D001 needs every PRNG op in a program plus
+# the sharding story around it: a threefry draw whose RESULT is laid out
+# across a mesh axis computes DIFFERENT BITS per layout (threefry is not
+# partitionable — the PR-14 EP=1 != EP=N router-noise bug), so the only
+# layout-independent forms are a replicated pin on the draw or no mesh
+# sharding at all. PRNG appears in four textual forms depending on
+# backend/jax version: `rng-bit-generator` ops, legacy `rng` ops,
+# custom-calls with a threefry target (GPU/TPU lowerings), and — the
+# pre-opt CPU form this tree compiles — `call(...)` into named rng
+# computations (`_uniform.103`, `_threefry_fold_in.256`). Shardings ride
+# either the instruction itself or a `Sharding` custom-call consumer;
+# shard_map bodies show up as computations called through
+# `SPMDFullToShardShape` operands with `sharding={manual}`.
+
+# rng computation names jax stamps on the lowered helpers, leading
+# underscore stripped and trailing `.N` suffix removed. split/fold_in/
+# seed DERIVE keys (layout-safe by themselves); the rest DRAW bits.
+_RNG_KEY_DERIVE_BASES = (
+    "split", "fold_in", "seed", "threefry_split", "threefry_fold_in",
+    "threefry_seed", "random_wrap", "random_unwrap",
+)
+_RNG_DRAW_BASES = (
+    "uniform", "normal", "normal_real", "truncated_normal",
+    "random_bits", "threefry_random_bits", "random_seed", "gamma",
+    "beta", "poisson", "categorical", "bernoulli", "gumbel", "randint",
+    "choice", "exponential", "laplace", "rbg",
+)
+_CUSTOM_CALL_TARGET_RE = re.compile(r'custom_call_target="(?P<t>[^"]*)"')
+_GTE_INDEX_RE = re.compile(r"index=(?P<idx>\d+)")
+# ops a seed value flows through unchanged (provenance walk)
+_RNG_PASSTHROUGH_OPS = (
+    "reshape", "convert", "bitcast", "bitcast-convert", "copy",
+    "transpose", "broadcast", "slice", "concatenate",
+)
+
+
+def _rng_comp_base(comp_name: str) -> Optional[str]:
+    """'threefry_fold_in' for `_threefry_fold_in.256`, None when the
+    computation is not one of jax's lowered rng helpers."""
+    base = re.sub(r"\.\d+$", "", comp_name).lstrip("_")
+    if base in _RNG_KEY_DERIVE_BASES or base in _RNG_DRAW_BASES:
+        return base
+    return None
+
+
+def classify_sharding(sharding: Optional[str]) -> str:
+    """'replicated' | 'manual' | 'maximal' | 'tiled' | 'none' for one
+    raw `sharding={...}` annotation body.
+
+    `last_tile_dim_replicate` tiles whose non-replicated dims are all 1
+    (e.g. `devices=[1,1,4]<=[4] last_tile_dim_replicate`) are
+    effectively replicated and classify as such — the partitioner
+    spells "replicated over this mesh" both ways."""
+    if sharding is None:
+        return "none"
+    if "manual" in sharding:
+        return "manual"
+    if "maximal" in sharding:
+        return "maximal"
+    m = re.search(r"devices=\[(?P<dims>[\d,]+)\]", sharding)
+    if m is not None:
+        dims = [int(d) for d in m.group("dims").split(",")]
+        if "last_tile_dim_replicate" in sharding:
+            dims = dims[:-1]
+        return "replicated" if all(d == 1 for d in dims) else "tiled"
+    if "replicated" in sharding:
+        return "replicated"
+    return "tiled"
+
+
+def _manual_computations(comps: Dict[str, List[Dict]]) -> set:
+    """Names of computations that execute inside a shard_map manual
+    context: called with an operand whose def carries
+    `sharding={manual}` / SPMDFullToShardShape (plus jax's
+    `shmap_body*` naming), closed transitively over the call graph."""
+    manual = {name for name in comps if name.startswith("shmap_body")}
+    for name, instrs in comps.items():
+        defs = {i["name"]: i for i in instrs}
+        for ins in instrs:
+            if not ins["called"]:
+                continue
+            for op in ins["operands"]:
+                d = defs.get(op)
+                if d is not None and (
+                        "sharding={manual}" in d["attrs"]
+                        or "SPMDFullToShardShape" in d["attrs"]):
+                    manual.update(ins["called"])
+                    break
+    # a call inside a manual computation is manual too
+    changed = True
+    while changed:
+        changed = False
+        for name in list(manual):
+            for ins in comps.get(name, ()):
+                for callee in ins["called"]:
+                    if callee not in manual:
+                        manual.add(callee)
+                        changed = True
+    return manual
+
+
+def _resolve_seed(start: str, defs: Dict[str, Dict],
+                  depth: int = 32) -> Tuple[str, Optional[str]]:
+    """(root def name, sharding annotation) reached by walking one
+    operand back through tuple packaging (`tuple` /
+    `get-tuple-element` with matched indices), value-preserving unary
+    ops, and `Sharding` custom-calls — the seed-provenance input D001
+    classifies. Stops at parameters, annotated defs, or anything that
+    computes."""
+    name, sharding = start, None
+    seen = set()
+    while depth > 0 and name in defs and name not in seen:
+        seen.add(name)
+        depth -= 1
+        rec = defs[name]
+        sh = _SHARDING_ATTR_RE.search(rec["attrs"])
+        if sh is not None and sharding is None:
+            sharding = sh.group("sharding")
+        op = rec["op"]
+        if op == "get-tuple-element" and rec["operands"]:
+            src = defs.get(rec["operands"][0])
+            gm = _GTE_INDEX_RE.search(rec["attrs"])
+            if (src is not None and src["op"] == "tuple"
+                    and gm is not None
+                    and int(gm.group("idx")) < len(src["operands"])):
+                name = src["operands"][int(gm.group("idx"))]
+                continue
+            name = rec["operands"][0]
+            continue
+        if op == "custom-call" and "Sharding" in rec["attrs"] \
+                and rec["operands"]:
+            name = rec["operands"][0]
+            continue
+        if op in _RNG_PASSTHROUGH_OPS and rec["operands"]:
+            name = rec["operands"][0]
+            continue
+        break
+    return name, sharding
+
+
+def parse_hlo_rng_ops(hlo_text: str) -> List[Dict]:
+    """Every PRNG instruction in `hlo_text` (pre-opt or compiled form)
+    with its sharding/provenance story.
+
+    Each record: {name, computation, form ('rng-bit-generator' | 'rng'
+    | 'custom-call' | 'call'), algo (rng helper base name or custom-
+    call target), kind ('draw' | 'key-derive'), dtype, sharding (own
+    annotation, else the first `Sharding` custom-call consumer's —
+    None when unannotated), sharding_class (classify_sharding of
+    that), manual (True inside a shard_map manual context), seed
+    (root def name of the first operand, tuple packaging resolved),
+    seed_sharding, seed_sharding_class}."""
+    comps, _ = parse_hlo_computations(hlo_text)
+    manual = _manual_computations(comps)
+    out: List[Dict] = []
+    for comp_name, instrs in comps.items():
+        defs = {i["name"]: i for i in instrs}
+        # result name -> sharding constraint applied by a consumer
+        pins: Dict[str, str] = {}
+        for ins in instrs:
+            if ins["op"] == "custom-call" and "Sharding" in ins["attrs"] \
+                    and "SPMD" not in ins["attrs"] and ins["operands"]:
+                sh = _SHARDING_ATTR_RE.search(ins["attrs"])
+                if sh is not None:
+                    pins.setdefault(ins["operands"][0],
+                                    sh.group("sharding"))
+        for ins in instrs:
+            algo = None
+            form = None
+            if ins["op"] in ("rng-bit-generator", "rng"):
+                form = ins["op"]
+                am = re.search(r"algorithm=(\w+)", ins["attrs"])
+                algo = am.group(1) if am else ins["op"]
+                kind = "draw"
+            elif ins["op"] == "custom-call":
+                tm = _CUSTOM_CALL_TARGET_RE.search(ins["attrs"])
+                if tm is None or "threefry" not in tm.group("t").lower():
+                    continue
+                form, algo, kind = "custom-call", tm.group("t"), "draw"
+            elif ins["called"]:
+                bases = [(_rng_comp_base(c), c) for c in ins["called"]]
+                hit = next((b for b, _ in bases if b is not None), None)
+                if hit is None:
+                    continue
+                form, algo = "call", hit
+                kind = ("key-derive" if hit in _RNG_KEY_DERIVE_BASES
+                        else "draw")
+            else:
+                continue
+            own = _SHARDING_ATTR_RE.search(ins["attrs"])
+            sharding = own.group("sharding") if own else \
+                pins.get(ins["name"])
+            sm = _SHAPE_RE.search(ins["result"])
+            seed, seed_sh = (_resolve_seed(ins["operands"][0], defs)
+                             if ins["operands"] else (None, None))
+            out.append({
+                "name": ins["name"],
+                "computation": comp_name,
+                "form": form,
+                "algo": algo,
+                "kind": kind,
+                "dtype": sm.group("dtype") if sm else None,
+                "sharding": sharding,
+                "sharding_class": classify_sharding(sharding),
+                "manual": comp_name in manual,
+                "seed": seed,
+                "seed_sharding": seed_sh,
+                "seed_sharding_class": classify_sharding(seed_sh),
+            })
+    return out
+
+
+def parse_hlo_reduce_collectives(hlo_text: str) -> List[Dict]:
+    """Every all-reduce / reduce-scatter in `hlo_text` with its
+    combiner kind, payload dtype, and FULL replica-group member lists
+    — the reassociation-hazard input (D002): a floating-point `add`
+    whose groups span a mesh axis the bitwise-pin registry declares
+    layout-varying sums its partials in a layout-dependent order."""
+    kinds = _region_kinds(hlo_text)
+    out = []
+    for m in _DTYPE_OP_RE.finditer(hlo_text):
+        op = m.group("op").replace("-start", "")
+        if op not in ("all-reduce", "reduce-scatter"):
+            continue
+        shapes = _shape_list(m.group("result"))
+        primary = next((dt for dt, _ in shapes if dt not in
+                        ("token", "opaque")), None)
+        tail = m.group("tail")
+        r = _TO_APPLY_RE.search(tail)
+        out.append({
+            "op": op,
+            "name": m.group("name"),
+            "dtype": primary,
+            "groups": parse_replica_groups(tail),
+            "group_size": _group_size(tail),
+            "reduce_kind": kinds.get(r.group("region")) if r else None,
+        })
+    return out

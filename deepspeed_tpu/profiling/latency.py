@@ -68,7 +68,9 @@ def _latest_trace_json(trace_dir: str) -> str:
     paths = sorted(
         glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
                   recursive=True),
-        key=os.path.getmtime,
+        # (mtime, path): equal timestamps tie-break on the path, not on
+        # the filesystem's enumeration order
+        key=lambda p: (os.path.getmtime(p), p),
     )
     if not paths:
         raise FileNotFoundError(f"no *.trace.json.gz under {trace_dir}")

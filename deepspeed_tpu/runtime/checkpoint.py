@@ -205,10 +205,10 @@ class CheckpointEngine:
         fault_point("checkpoint.commit", tag=tag)  # the crash window
         if jax.process_index() == 0:
             with open(os.path.join(tag_dir, "meta.json"), "w") as f:
-                json.dump(meta, f)
+                json.dump(meta, f, sort_keys=True)
             manifest = build_manifest(tag_dir)
             with open(os.path.join(tag_dir, _MANIFEST), "w") as f:
-                json.dump(manifest, f)
+                json.dump(manifest, f, sort_keys=True)
             with open(os.path.join(tag_dir, _COMMITTED), "w") as f:
                 f.write(_manifest_digest(manifest))
             try:
@@ -279,11 +279,14 @@ class CheckpointEngine:
             f"checkpoint {resolved} (from 'latest') failed verification "
             f"({why}); falling back to the newest verified tag",
             ranks=[0])
+        # sorted() + (mtime, name) tie-break: same-second saves (or a
+        # copied tree with flattened mtimes) must resolve to the SAME
+        # fallback tag on every host and every run
         candidates = [
-            t for t in os.listdir(load_dir)
+            t for t in sorted(os.listdir(load_dir))
             if t != resolved and os.path.isdir(os.path.join(load_dir, t))]
         candidates.sort(
-            key=lambda t: os.path.getmtime(os.path.join(load_dir, t)),
+            key=lambda t: (os.path.getmtime(os.path.join(load_dir, t)), t),
             reverse=True)
         for cand in candidates:
             ok, cand_why = verify_tag(load_dir, cand)
@@ -422,11 +425,15 @@ class TieredCheckpointEngine:
         except OSError:
             pass
         try:
+            # deterministic sweep order: (mtime, name) so equal
+            # timestamps cannot leave the retention victim to the
+            # filesystem's enumeration order
             tags = [
-                t for t in os.listdir(save_dir)
+                t for t in sorted(os.listdir(save_dir))
                 if os.path.isdir(os.path.join(save_dir, t))
             ]
-            tags.sort(key=lambda t: os.path.getmtime(os.path.join(save_dir, t)))
+            tags.sort(key=lambda t: (
+                os.path.getmtime(os.path.join(save_dir, t)), t))
         except OSError:
             return  # racing with another process's sweep
         excess = max(0, len(tags) - self.retention)

@@ -1410,6 +1410,30 @@ class DeepSpeedTPUEngine:
             compiled_text=compiled.as_text(), label=label))
         return reports
 
+    def _determinism_checks(self, lowered, compiled, label):
+        """D001 on the pre-optimization HLO (rng ops and their sharding
+        annotations survive there; the optimized text inlines threefry
+        into anonymous shifts/xors) and D002 on the compiled text
+        against the program's bitwise pin under THIS engine's mesh.
+        Unregistered labels get the rerun-only fallback pin
+        (varying_axes=()), so D002 stays quiet for ad-hoc programs —
+        the canonical pins live in analysis.determinism.BITWISE_PINS."""
+        from ..analysis.determinism import (check_reassociation,
+                                            check_rng_discipline, pin_for)
+        from ..profiling.hlo import preopt_hlo_text
+
+        reports = []
+        pre = preopt_hlo_text(lowered)
+        if pre:
+            reports.append(check_rng_discipline(pre, label=label))
+        mesh_axes = tuple(
+            (str(k), int(v)) for k, v in self.mesh.shape.items()
+        ) if self.mesh is not None else ()
+        reports.append(check_reassociation(
+            compiled.as_text(), pin_for(label, mesh_axes=mesh_axes),
+            label=label))
+        return reports
+
     def sanitize(self, batch, hbm_budget_bytes=None, target_devices=None,
                  target_topology=None):
         """Statically verify this engine's compiled step against an
@@ -1426,7 +1450,10 @@ class DeepSpeedTPUEngine:
         vs the declared precision policy (N001), fp32
         master/optimizer-state integrity (N002), loss-scale coverage
         (N003), and on the 1-bit/0-1-Adam/qgZ compressed programs the
-        quantized-collective sanity check (N004). Compile-time only —
+        quantized-collective sanity check (N004), (g) the determinism
+        analyzer — layout-dependent PRNG draws (D001) and, for
+        programs with a registered bitwise pin, reassociation hazards
+        on fp additive reduces (D002). Compile-time only —
         no step executes, no state mutates. Returns
         analysis.SanitizerReport with `.cost` attached; `report.ok`
         gates CI.
@@ -1492,6 +1519,8 @@ class DeepSpeedTPUEngine:
                 reports.extend(cost_checks)
                 reports.append(self._numerics_checks(
                     compiled_g, lowered_g, "grad_step", donated=False))
+                reports.extend(self._determinism_checks(
+                    lowered_g, compiled_g, "grad_step"))
             rep = merge_reports("offload_step", *reports)
             rep.cost = cost
             return rep
@@ -1532,7 +1561,8 @@ class DeepSpeedTPUEngine:
             opt=self.state.opt)
         rep = merge_reports(
             "train_step", don, shard, self._recompile_tracker.report(),
-            *cost_checks, num, *self._compressed_step_numerics(batch))
+            *cost_checks, num, *self._compressed_step_numerics(batch),
+            *self._determinism_checks(lowered, compiled, "train_step"))
         rep.cost = cost
         return rep
 

@@ -91,7 +91,7 @@ def main():
         "experiments": tuner.results,
     }
     with open("AUTOTUNE_r03.json", "w") as f:
-        json.dump(artifact, f, indent=1)
+        json.dump(artifact, f, indent=1, sort_keys=True)
     print(json.dumps({k: v for k, v in artifact.items()
                       if k != "experiments"}))
     return 0

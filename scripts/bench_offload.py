@@ -200,7 +200,7 @@ def main(int8=False, small=False, nvme=False, spec=False):
     if os.path.exists(path):
         existing = json.load(open(path))
     existing = [e for e in existing if e.get("mode") != out["mode"]]
-    json.dump(existing + [out], open(path, "w"), indent=1)
+    json.dump(existing + [out], open(path, "w"), indent=1, sort_keys=True)
 
 
 if __name__ == "__main__":

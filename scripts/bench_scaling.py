@@ -101,7 +101,7 @@ def main():
         doc = json.load(open(path))
     doc["layer_mfu"] = out
     doc["peak_tflops"] = peak / 1e12
-    json.dump(doc, open(path, "w"), indent=1)
+    json.dump(doc, open(path, "w"), indent=1, sort_keys=True)
     print(json.dumps({"scaling": out}))
 
 

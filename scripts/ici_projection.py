@@ -96,7 +96,7 @@ def main():
     if os.path.exists(path):
         doc = json.load(open(path))
     doc["ici_projection"] = out
-    json.dump(doc, open(path, "w"), indent=1)
+    json.dump(doc, open(path, "w"), indent=1, sort_keys=True)
     print(json.dumps(out))
 
 

@@ -69,7 +69,7 @@ def main(batch=8, n_steps=24, quant=False):
     readback(logits)
     jax.profiler.stop_trace()
 
-    paths = glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True)
+    paths = sorted(glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True))
     print("xplane:", paths)
     if not paths:
         return

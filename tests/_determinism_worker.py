@@ -1,0 +1,157 @@
+"""Two-process determinism audit worker (tests/test_determinism.py).
+
+Runs one pass over every host-side substrate whose ordering the
+D-series analyzer protects — the seeded interleaving scheduler, the
+FaultPlan schedule, the virtual-clock autoscaler policy loop, and the
+checkpoint commit artifacts — and prints one `DIGEST <name> <hex>`
+line per substrate. The test launches this worker twice with DIFFERENT
+PYTHONHASHSEED values and asserts byte-identical DIGEST lines: any
+hash-seed leak (set iteration order, dict insertion order reaching a
+committed artifact) shows up as a digest diff.
+
+Inputs are deliberately routed through sets and hash-ordered dicts so
+the assertion has teeth.
+"""
+
+import hashlib
+import json
+import os
+import sys
+import threading
+
+
+def _digest(obj) -> str:
+    return hashlib.blake2b(
+        json.dumps(obj, sort_keys=True).encode(),
+        digest_size=16).hexdigest()
+
+
+def interleave_digest() -> str:
+    from deepspeed_tpu.resilience.interleave import run_interleaved
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.log = []
+
+    box = Box()
+    # task names arrive via a set: spawn order must come from sorted()
+    names = {"writer-c", "writer-a", "writer-b"}
+
+    def work(name):
+        def fn():
+            with box._lock:
+                box.log.append(name)
+        return fn
+
+    sched = run_interleaved(
+        seed=11,
+        tasks=[(n, work(n)) for n in sorted(names)],
+        instrument=[(box, ["_lock"])])
+    return sched.trace_digest()
+
+
+def fault_plan_digest() -> str:
+    from deepspeed_tpu.resilience.faults import FaultPlan
+
+    plan = FaultPlan(
+        [{"point": "checkpoint.save", "kind": "delay", "value": 0.0,
+          "where": {"tag": "t1"}, "at": 2, "times": 2},
+         {"point": "offload.io", "kind": "skip", "at": 1, "times": -1}],
+        seed=5, budget={"max_recovery_s": 30.0})
+    for i in range(6):
+        plan._hit("checkpoint.save", {"tag": f"t{i % 2}"})
+        plan._hit("offload.io", {"tag": "x"})
+    return _digest({"plan": plan.to_dict(), "fired": plan.fired})
+
+
+def autoscaler_digest() -> str:
+    from deepspeed_tpu.inference.autoscaler import Autoscaler
+
+    class Fleet:
+        def __init__(self):
+            self.n = 1
+            self.queue = 0.0
+            self.trace = []
+
+        def live_replicas(self):
+            return self.n
+
+        def signals(self):
+            # built by iterating a set on purpose: the policy loop must
+            # not depend on signal enumeration order
+            sigs = {}
+            for k in {"shed_requests", "queue_depth",
+                      "max_pressure_level", "deadline_rejections",
+                      "premium_sheds", "premium_rejections"}:
+                sigs[k] = self.queue if k == "queue_depth" else 0.0
+            return sigs
+
+        def scale_up(self, now):
+            self.n += 1
+            self.trace.append(("up", now))
+
+        def scale_down(self, now):
+            if self.n <= 1:
+                return False
+            self.n -= 1
+            self.trace.append(("down", now))
+            return True
+
+    fleet = Fleet()
+    asc = Autoscaler(
+        fleet,
+        dict(enabled=True, min_replicas=1, max_replicas=4,
+             evaluation_interval_s=1.0, scale_up_pressure=2,
+             scale_up_queue_per_replica=4.0,
+             scale_down_queue_per_replica=1.0,
+             up_hysteresis=2, down_hysteresis=3,
+             scale_up_cooldown_s=2.0, scale_down_cooldown_s=4.0),
+        clock=lambda: 0.0)
+    decisions = []
+    for t in range(40):
+        fleet.queue = 40.0 if t < 20 else 0.0
+        decisions.append(asc.tick(now=float(t)))
+    return _digest({"decisions": decisions, "trace": fleet.trace,
+                    "replicas": fleet.n})
+
+
+def checkpoint_digest(workdir: str) -> str:
+    from deepspeed_tpu.runtime.checkpoint import CheckpointEngine
+
+    save_dir = os.path.join(workdir, "ckpt")
+    tag_dir = os.path.join(save_dir, "tag1", "state")
+    os.makedirs(tag_dir, exist_ok=True)
+    for name in ("shard0.bin", "shard1.bin"):
+        with open(os.path.join(tag_dir, name), "wb") as f:
+            f.write(name.encode() * 64)
+    # meta built by iterating a set: insertion order follows the hash
+    # seed, so only json.dump(sort_keys=True) keeps meta.json stable
+    meta = {k: i for i, k in
+            enumerate(sorted({"step", "lr", "mesh", "world", "tag",
+                              "loss_scale", "consumed_tokens"}))}
+    meta.update({k: len(k) for k in {"zz", "aa", "mm", "qq"}})
+    CheckpointEngine()._commit(save_dir, "tag1", meta)
+    parts = {}
+    for name in ("meta.json", "manifest.json"):
+        with open(os.path.join(save_dir, "tag1", name), "rb") as f:
+            parts[name] = hashlib.blake2b(
+                f.read(), digest_size=16).hexdigest()
+    return _digest(parts)
+
+
+def main() -> int:
+    workdir = sys.argv[1]
+    out = [
+        ("interleave", interleave_digest()),
+        ("fault_plan", fault_plan_digest()),
+        ("autoscaler", autoscaler_digest()),
+        ("checkpoint", checkpoint_digest(workdir)),
+    ]
+    for name, hexd in out:
+        print(f"DIGEST {name} {hexd}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

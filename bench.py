@@ -282,6 +282,12 @@ def _serving_sim():
     new_sigs = sum(
         eng.recompile_tracker.n_signatures(n) - baseline_sigs.get(n, 0)
         for n in eng.recompile_tracker._sigs)
+    # lane-end quiesce audit (lifecycle L002 runtime half): every
+    # request finished, so the pool must be whole again — leaked
+    # blocks, tracked sequences, spill bytes, or backlog here mean a
+    # release path was skipped somewhere in the lane
+    from deepspeed_tpu.analysis.lifecycle import quiesce_residuals
+    residuals = quiesce_residuals(sched)
 
     # -- lane B: static back-to-back generate() batches ------------------
     eng_b = build_engine()
@@ -355,6 +361,8 @@ def _serving_sim():
                 str(w): round(fp.get("step_time_us", 0.0), 2)
                 for w, fp in sorted(eng.warmup_footprints.items())},
             "budget_findings": len(sched.budget_report.findings),
+            # empty dict == fully quiesced (gates the exit code)
+            "quiesce_residuals": residuals,
         },
         "static": {
             "goodput_rps": round(goodput_static, 2),
@@ -366,7 +374,7 @@ def _serving_sim():
         "platform": jax.default_backend(),
     }
     print(json.dumps(out))
-    return 0 if goodput_sched > goodput_static else 1
+    return 0 if goodput_sched > goodput_static and not residuals else 1
 
 
 # deterministic per-step cost model for the fleet simulator: one
@@ -699,6 +707,7 @@ def _chaos_lane(build_engine, n_replicas, router_cfg, trace, plan=None,
     automatically, and half-open probes restore them — the lane itself
     NEVER calls fail_replica. Returns the _fleet_lane-shaped record
     plus the failover/recovery audit."""
+    from deepspeed_tpu.analysis.lifecycle import fleet_quiesce_residuals
     from deepspeed_tpu.inference import ServingRouter
     from deepspeed_tpu.resilience import armed
 
@@ -826,6 +835,12 @@ def _chaos_lane(build_engine, n_replicas, router_cfg, trace, plan=None,
         "shed_requests": int(fleet["fleet/shed_requests"]),
         "live_replicas": int(fleet["fleet/live_replicas"]),
         "recovery_p95_ms": round(fleet["fleet/recovery_p95_ms"], 2),
+        # lane-end quiesce audit (lifecycle L002 runtime half): every
+        # live replica must be whole — no leaked blocks, tracked
+        # sequences, stranded spill bytes, or backlog after the last
+        # request drains (dead, never-restored replicas are excluded:
+        # their device state is unreachable until restore_replica)
+        "quiesce_residuals": fleet_quiesce_residuals(router),
     }
 
 
@@ -913,6 +928,11 @@ def _chaos_sim(n_replicas: int, plan_arg: str):
         "shed_within_budget": chaos["shed_requests"] <= budget["max_shed"],
         "straggler_restored": chaos["replica_restores"] >= 1,
         "handoff_fallback_exercised": chaos["handoff_fallbacks"] >= 1,
+        # lifecycle quiesce: both lanes end with whole pools — any
+        # residual means a failover/handoff path leaked a resource
+        "pools_quiesced_zero_leak": (
+            not clean["quiesce_residuals"]
+            and not chaos["quiesce_residuals"]),
     }
     out = {
         "metric": "serving_chaos_goodput_ratio",
@@ -941,6 +961,7 @@ def _chaos_sim(n_replicas: int, plan_arg: str):
             "live_replicas": chaos["live_replicas"],
             "max_recovery_s": round(max_recovery, 4),
             "failovers": chaos["failovers"],
+            "quiesce_residuals": chaos["quiesce_residuals"],
         },
         "platform": jax.default_backend(),
     }
@@ -2033,6 +2054,7 @@ def _overload_sim(plan_arg: str, capture=None):
     import jax
     import jax.numpy as jnp
 
+    from deepspeed_tpu.analysis.lifecycle import quiesce_residuals
     from deepspeed_tpu.inference import RED, init_inference
     from deepspeed_tpu.models import transformer as T
     from deepspeed_tpu.resilience import FaultPlan
@@ -2184,6 +2206,13 @@ def _overload_sim(plan_arg: str, capture=None):
         "deterministic_rerun": (
             json.dumps([armed_recs, armed_led], sort_keys=True)
             == json.dumps([rerun_recs, rerun_led], sort_keys=True)),
+        # lifecycle quiesce: after every pass drains, the pool is
+        # whole, no sequences are tracked, and the spill tier holds
+        # zero bytes — any residual is a leaked release path
+        "pools_quiesced_zero_leak": (
+            not quiesce_residuals(clean_s)
+            and not quiesce_residuals(armed_s)
+            and not quiesce_residuals(rerun_s)),
     }
     detected = {k: v for k, v in armed_led.items() if k != "fired"}
     detected["clean_spills"] = clean_led["spills"]

@@ -90,6 +90,9 @@ RULES = {
     "R008": "rng draw without a replication pin under a sharded mesh "
             "(layout-dependent threefry bits), or wall-clock/unseeded "
             "entropy in a ds_* capture script",
+    "R009": "broad except absorbing typed resilience errors without "
+            "counting, logging, or re-raising (hot files outside the "
+            "lifecycle roots; per-file shim of lifecycle L004)",
 }
 
 _PRAGMA_RE = re.compile(
@@ -139,7 +142,10 @@ _HOT_FILES = ("runtime/engine.py", "inference/engine.py",
               # the determinism analyzer is imported by engine.sanitize
               # and the ds_determinism gate — a host sync here would
               # tax every sanitize/gate run
-              "analysis/determinism.py")
+              "analysis/determinism.py",
+              # the lifecycle analyzer is imported by lint (R009 shim)
+              # and the ds_lifecycle gate — same tax argument
+              "analysis/lifecycle.py")
 _HOT_FN_PREFIXES = (
     "train_batch", "eval_batch", "_dispatch", "decode", "_decode",
     "generate", "put", "step", "_sample", "prefill", "_prefill",
@@ -709,6 +715,25 @@ def _check_r004(ctx: _Ctx, tree: ast.Module) -> None:
 
 
 # ----------------------------------------------------------------------
+# R009: swallowed typed failures on hot paths (lifecycle L004 shim)
+# ----------------------------------------------------------------------
+
+def _check_r009(ctx: _Ctx, tree: ast.Module) -> None:
+    """Warn-level per-file shim of the lifecycle analyzer's L004 pass,
+    scoped to the hot files NOT already audited at error level by the
+    ds_lifecycle gate's roots (those would double-report)."""
+    from .lifecycle import LIFECYCLE_ROOTS, l004_tree_findings
+    rel = ctx.relpath.replace(os.sep, "/")
+    if not any(rel.endswith(h) for h in _HOT_FILES):
+        return
+    if any(rel.endswith(r) for r in LIFECYCLE_ROOTS):
+        return
+    ctx.findings.extend(
+        l004_tree_findings(tree, ctx.relpath, rule="R009",
+                           severity="warning"))
+
+
+# ----------------------------------------------------------------------
 # driver
 # ----------------------------------------------------------------------
 
@@ -726,9 +751,11 @@ def _split_suppressed(
                 continue
             named = re.findall(r"[A-Z]\d{3}", m.group("rules"))
             # R003 is the per-file shim over the concurrency analyzer's
-            # C001 — one pragma spelling covers both emitters
+            # C001, R009 over the lifecycle analyzer's L004 — one
+            # pragma spelling covers both emitters of each pair
             if not named or f.rule in named or \
-                    (f.rule == "R003" and "C001" in named):
+                    (f.rule == "R003" and "C001" in named) or \
+                    (f.rule == "R009" and "L004" in named):
                 ok = True
                 break
         (suppressed if ok else active).append(f)
@@ -756,6 +783,7 @@ def lint_source(source: str, relpath: str) -> Tuple[List[Finding],
     _check_r003(ctx, tree)
     _check_r004(ctx, tree)
     _check_r008(ctx, tree, roots, callbacks)
+    _check_r009(ctx, tree)
     ctx.findings.sort(key=lambda f: (f.line, f.rule))
     return _split_suppressed(ctx.findings, lines)
 

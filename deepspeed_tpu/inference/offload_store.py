@@ -132,6 +132,22 @@ class HostKvSpillStore:
                 self.used_bytes -= self._bytes.pop(key)
                 self.counters["discards"] += 1
 
+    def drain(self) -> int:
+        """Discard EVERY resident entry (counted per entry) and return
+        how many were dropped. The replica-retirement path
+        (router._maybe_release / restore_replica): once a replica
+        leaves routing, no request will ever resume from its host
+        tier, so anything still resident is a leak — draining here is
+        what makes the lane-end quiesce audit (zero spill bytes
+        fleet-wide) provable."""
+        with self._lock:
+            dropped = len(self._entries)
+            self.counters["discards"] += dropped
+            self._entries.clear()
+            self._bytes.clear()
+            self.used_bytes = 0
+        return dropped
+
     def stats(self) -> Dict[str, float]:
         with self._lock:
             s = {f"spill_{k}": float(v) for k, v in self.counters.items()}

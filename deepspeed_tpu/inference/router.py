@@ -572,6 +572,11 @@ class ServingRouter:
                    if counts[self._session_key(req)] == heaviest]
         i, victim = victims[-1]
         self.schedulers[i].waiting.remove(victim)
+        # a preempted-then-shed victim may still own a spilled payload
+        # in replica i's host tier — it will never resume, so the
+        # bytes must go back now (L001; _finish does the same for
+        # requests retired through the normal path)
+        self.schedulers[i].release_spill(victim)
         victim.state = FINISHED
         victim.finish_reason = "shed"
         victim.finish_t = time.perf_counter()
@@ -763,6 +768,10 @@ class ServingRouter:
         moved = 0
         for req in orphans:
             req.uid = None  # the KV died with the replica
+            # its spilled payload did NOT die — the host tier outlives
+            # the device. The orphan recomputes elsewhere, so release
+            # the payload or it strands in the dead replica's store
+            s.release_spill(req)
             gid = req.stream
             pool = (self.prefill_idx if self.mode == "disaggregated"
                     else self.decode_idx)
@@ -903,6 +912,9 @@ class ServingRouter:
                 break
             req = hs.waiting.pop()
             req.uid = None
+            # the newcomer recomputes: any payload the donor spilled
+            # for this request is unreachable from there (L001)
+            hs.release_spill(req)
             self.schedulers[rid].requeue(req)
             self._where[req.stream] = rid
             moved += 1
@@ -1012,6 +1024,9 @@ class ServingRouter:
         for req in list(sched.waiting):
             sched.waiting.remove(req)
             req.uid = None
+            # the re-routed request recomputes on its new replica; the
+            # draining replica's spilled copy must not ride to release
+            sched.release_spill(req)
             pool = (self.prefill_idx if self.mode == "disaggregated"
                     else self.decode_idx)
             r = self._route(req.base, self._session_of.get(req.stream),
@@ -1130,6 +1145,10 @@ class ServingRouter:
         self.observe_time(now)
         for uid in list(s.engine.state.tracked_uids):
             s.engine.flush(uid)
+        if s.spill_store is not None:
+            # nothing will ever resume from a released replica's host
+            # tier: drain it so the fleet quiesce audit stays zero
+            s.spill_store.drain()
         self.draining.discard(i)
         self.released.add(i)
         if i in self.decode_idx:
@@ -1226,6 +1245,10 @@ class ServingRouter:
         s.active.clear()
         s.waiting.clear()
         s.handoff_ready.clear()
+        if s.spill_store is not None:
+            # every spilled owner was requeued elsewhere at failover —
+            # whatever survived in the host tier is stale bytes
+            s.spill_store.drain()
         self.dead.discard(i)
         if self.health.state(i) != CLOSED:
             self.health.reset(i)  # manual restore of a held breaker

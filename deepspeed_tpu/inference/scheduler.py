@@ -189,6 +189,7 @@ class ServingScheduler:
             "wave_prefills": 0, "handoffs": 0, "adopted": 0,
             "spills": 0, "spill_resumes": 0, "spill_fallbacks": 0,
             "spill_rejects": 0, "spill_integrity_failures": 0,
+            "spill_releases": 0, "chain_fallbacks": 0,
             "deadline_rejections": 0, "starvation_protected": 0,
         }
         self.spec_stats: Dict[str, float] = {
@@ -381,6 +382,21 @@ class ServingScheduler:
         req.rid = self._next_rid
         self._next_rid += 1
         self.waiting.append(req)
+
+    def release_spill(self, req: Request) -> None:
+        """Drop req's host-tier spill payload from THIS scheduler's
+        store. The ownership-transfer contract (analysis/lifecycle.py
+        L001): a spill payload lives in the SOURCE scheduler's host
+        tier, and requeue() on a DESTINATION scheduler cannot reach
+        it — so every router path that moves a WAITING request off a
+        replica (rebalance, drain, failover, shed) must release the
+        payload here first or the bytes strand until process exit."""
+        if req.spill_key is None:
+            return
+        if self.spill_store is not None:
+            self.spill_store.discard(req.spill_key)
+            self.counters["spill_releases"] += 1
+        req.spill_key = None
 
     def adopt(self, req: Request, payload: Dict[str, Any]) -> None:
         """Admit a request whose KV arrives by block transfer
@@ -1224,7 +1240,13 @@ class ServingScheduler:
             try:
                 eng.state.extend(req.uid, 1)
             except RuntimeError:
-                return None  # pressure: resolve via the normal path
+                # pressure (KVCacheExhaustedError) or a row whose KV
+                # died under it mid-chain: resolve via the normal
+                # path, which can preempt/spill/requeue; counted so a
+                # hot chain-break loop is visible in metrics instead
+                # of silently absorbed (L004)
+                self.counters["chain_fallbacks"] += 1
+                return None
         ctx = np.zeros((sp,), np.int32)
         tables = np.full((sp, eng.config.blocks_per_seq),
                          eng.pad_block, np.int32)

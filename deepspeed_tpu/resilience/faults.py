@@ -25,120 +25,14 @@ Design constraints:
   recovery code can assert it healed an *injected* fault, and so a
   stray injection outside a chaos lane is attributable in one grep.
 
-Fault points registered across the tree (ctx keys in parens):
-
-  scheduler.step      (replica)   ServingScheduler.step entry — raise =
-                                  replica death mid-decode; delay =
-                                  straggler (accrues to
-                                  ``scheduler.fault_delay_s``; virtual-
-                                  clock drivers charge it, real drivers
-                                  sleep it)
-  engine.step         (rank,      training-step dispatch
-                       step)      (runtime/engine.py _dispatch_step
-                                  entry, BEFORE any state mutates) —
-                                  raise error='preempted' = this rank's
-                                  host is gone mid-run (the elastic
-                                  trainer reconstructs from peer-
-                                  redundant shards); delay = training
-                                  straggler (accrues to
-                                  ``engine.fault_delay_s``)
-  comm.collective     (op,        host-side control-plane collective
-                       group)     (comm/comm.py barrier /
-                                  broadcast_host, inside the
-                                  timeout+retry guard) — raise error=
-                                  'io' = transient failure (bounded
-                                  retry heals it); delay >= the guard
-                                  timeout = deterministic
-                                  CollectiveTimeoutError without a
-                                  real hang
-  pipe.permute        (stage,     stage-boundary pipeline comm guard
-                       step)      (comm/comm.py pipe_permute_tick,
-                                  fired once per stage before every
-                                  pipelined step dispatch — the host-
-                                  side representative of the compiled
-                                  collective-permute ring) — raise
-                                  error='io' = transient boundary-link
-                                  failure (bounded retry heals);
-                                  delay < the comm deadline = a slow
-                                  stage link charged to that stage's
-                                  skew counter (engine.
-                                  pipe_stage_delay_s); delay >= the
-                                  deadline = a wedged stage peer
-                                  (deterministic
-                                  CollectiveTimeoutError)
-  dataloader.fetch    (epoch,     batch fetch (runtime/dataloader.py,
-                       index)     BEFORE the loader position advances
-                                  so a retry re-fetches the same
-                                  batch) — raise error='io' =
-                                  transient storage failure
-  elastic.launch      (generation,  supervisor generation launch
-                       world)     (elasticity/agent.py
-                                  _launch_generation) — raise = the
-                                  relaunch itself fails (burned
-                                  generation)
-  elastic.generation  (generation,  in-process generation bump
-                       world)     (elasticity/trainer.py engine
-                                  rebuild on shrink/regrow)
-  engine.export_kv    (uid)       KV handoff export (raise/delay)
-  engine.import_kv    (uid)       KV handoff import (raise/delay)
-  router.probe        (replica)   health-monitor half-open probe
-  checkpoint.save     (tag)       orbax write (transient I/O error —
-                                  save retry heals it)
-  checkpoint.commit   (tag)       the commit window: state durable,
-                                  marker not yet written (crash here =
-                                  an uncommitted tag on disk)
-  checkpoint.corrupt  (tag, dir)  post-commit bitrot (kind='corrupt'
-                                  flips bytes in one state file)
-  offload.io          (what)      NvmeLayerStore aio op (transient
-                                  I/O — bounded retry heals it)
-  spill.io            (op, key)   HostKvSpillStore put/get (the
-                                  preempt-to-host KV tier,
-                                  inference/offload_store.py) —
-                                  raise error='io' on op='put' loses
-                                  the spill (victim recomputes),
-                                  on op='get' loses the resume
-                                  payload (same fallback); 'skip' is
-                                  not interpreted (the store's ops
-                                  are not suppressible — use 'raise')
-  heartbeat.beat      (rank)      kind='skip' suppresses the write (a
-                                  wedged-but-alive controller)
-  engine.grads        (rank,      post-step gradient readout + the
-                       step)      just-committed update (runtime/
-                                  engine.py _dispatch_step exit) —
-                                  kind='corrupt' flips an exponent bit
-                                  of the step's grad-norm/loss metrics
-                                  AND of one updated state leaf
-                                  (resilience/integrity.py): the SDC-
-                                  in-the-gradient model the training
-                                  guardian must catch BEFORE commit
-  mirror.payload      (step,      one peer-redundancy mirror entry at
-                       holder,    snapshot time (resilience/
-                       owner)     redundancy.py) — kind='corrupt'
-                                  flips a bit in that holder's copy of
-                                  the owner's shard slice; the digest
-                                  envelope catches it at reconstruct
-                                  and falls over to the next holder
-  handoff.payload     (uid)       KV handoff payload at import
-                                  (inference/engine.py import_kv) —
-                                  kind='corrupt' flips a bit in the
-                                  K/V page stacks in transit; digest
-                                  verification discards the payload
-                                  and the router recomputes
-  replica.spinup      (replica,   replica spin-up (inference/router.py
-                       phase)     add_replica; phase 'build' fires
-                                  before scheduler construction,
-                                  'join' after warmup + warm boot,
-                                  just before registration) — raise =
-                                  the replica died mid-scale-up: the
-                                  attempt is BURNED (counter, no id
-                                  consumed) and the autoscaler
-                                  (inference/autoscaler.py) retries
-                                  with exponential backoff
-  replica.drain       (replica)   graceful drain entry
-                                  (inference/router.py drain_replica,
-                                  BEFORE any state mutates) — raise =
-                                  the drain rejected at entry; the
-                                  replica keeps serving untouched
+The registry of fault points compiled into the tree lives in the
+module constant ``FAULT_POINTS`` below — one entry per point with its
+ctx keys, source site, and failure meaning. That constant is the
+SINGLE authority: ``registered_points()`` exposes the names, the
+lifecycle analyzer (analysis/lifecycle.py, L003) audits committed
+chaos plans against it, and docs/fault_tolerance.md renders its
+registry table from ``registry_markdown_table()`` (a docs-drift test
+pins the rendered table to the file).
 
 kind='corrupt' payloads: `corrupt_file` flips raw bytes of a file on
 disk (checkpoint bitrot); the three in-memory points above flip bits
@@ -158,6 +52,7 @@ from typing import Any, Dict, List, Optional, Union
 __all__ = [
     "FaultPlan", "FaultSpec", "FaultAction", "fault_point", "arm",
     "disarm", "armed", "active_plan", "corrupt_file",
+    "FAULT_POINTS", "registered_points", "registry_markdown_table",
     "InjectedFault", "ReplicaDeadError", "HandoffError",
     "InjectedIOError", "CheckpointCrashError", "RankPreemptedError",
 ]
@@ -199,6 +94,198 @@ _ERRORS = {
 }
 
 _KINDS = ("raise", "delay", "skip", "corrupt")
+
+#: The fault-point registry: every point name fault_point() is called
+#: with anywhere in the tree, mapped to the ctx keys its call site
+#: passes, the source site, and the failure meaning. Kept a PURE dict
+#: literal so static passes (analysis/lifecycle.py L003) can read it
+#: with ast.literal_eval without importing this module; registering a
+#: new point here without a committed chaos lane that fires it — or
+#: calling fault_point() with a name missing here — is an L003 red.
+FAULT_POINTS = {
+    "scheduler.step": {
+        "ctx": ("replica",),
+        "site": "inference/scheduler.py `step()`",
+        "meaning": ("raise = replica death mid-decode (before "
+                    "dispatch, so requeue is safe); delay = straggler "
+                    "(accrues to `scheduler.fault_delay_s`)"),
+    },
+    "engine.step": {
+        "ctx": ("rank", "step"),
+        "site": "runtime/engine.py `_dispatch_step` entry",
+        "meaning": ("raise `preempted` (spec `value` = the lost "
+                    "logical rank) = host preempted mid-run, BEFORE "
+                    "any state mutates — the elastic trainer "
+                    "reconstructs from peer shards; delay = training "
+                    "straggler (accrues to `engine.fault_delay_s`, "
+                    "flags in the monitor feed)"),
+    },
+    "comm.collective": {
+        "ctx": ("op", "group"),
+        "site": "comm/comm.py guarded barrier / broadcast_host",
+        "meaning": ("raise `io` = transient control-plane failure "
+                    "(bounded retry heals); delay >= the "
+                    "`DS_COMM_TIMEOUT_S` deadline = deterministic "
+                    "`CollectiveTimeoutError` verdict without a real "
+                    "hang"),
+    },
+    "pipe.permute": {
+        "ctx": ("stage", "step"),
+        "site": ("comm/comm.py `pipe_permute_tick`, once per stage "
+                 "before every pipelined dispatch"),
+        "meaning": ("the host-side representative of the step's "
+                    "stage-boundary collective-permute ring "
+                    "(docs/pipeline.md): raise `io` = transient "
+                    "boundary-link failure (bounded retry heals); "
+                    "delay < the deadline = a slow stage link charged "
+                    "to that stage's skew counter "
+                    "(`engine.pipe_stage_delay_s`, surfaced by "
+                    "`monitor.training_events`); delay >= the "
+                    "deadline = a wedged stage peer (deterministic "
+                    "`CollectiveTimeoutError`)"),
+    },
+    "dataloader.fetch": {
+        "ctx": ("epoch", "index"),
+        "site": "runtime/dataloader.py, before the position advances",
+        "meaning": ("raise `io` = transient batch-fetch failure (a "
+                    "retry re-fetches the SAME batch — loader state "
+                    "stays clean)"),
+    },
+    "elastic.launch": {
+        "ctx": ("generation", "world"),
+        "site": "elasticity/agent.py `_launch_generation`",
+        "meaning": ("raise `io` = the relaunch itself fails; the "
+                    "supervisor counts the burned generation and "
+                    "keeps shrinking"),
+    },
+    "elastic.generation": {
+        "ctx": ("generation", "world"),
+        "site": "elasticity/trainer.py engine rebuild",
+        "meaning": "raise = an in-process generation bump fails",
+    },
+    "engine.export_kv": {
+        "ctx": ("uid",),
+        "site": "inference/engine.py",
+        "meaning": ("raise = handoff export failure; delay = hung "
+                    "transfer (sleeps, trips `handoff_timeout_s`)"),
+    },
+    "engine.import_kv": {
+        "ctx": ("uid",),
+        "site": "inference/engine.py",
+        "meaning": ("raise = handoff import failure (adopt cleans up "
+                    "+ falls back)"),
+    },
+    "router.probe": {
+        "ctx": ("replica",),
+        "site": "inference/router.py `_probe_replica`",
+        "meaning": ("raise = half-open probe fails (replica still "
+                    "bad)"),
+    },
+    "checkpoint.save": {
+        "ctx": ("tag",),
+        "site": "runtime/checkpoint.py orbax write",
+        "meaning": ("raise `io` = transient storage error (save retry "
+                    "heals)"),
+    },
+    "checkpoint.commit": {
+        "ctx": ("tag",),
+        "site": "runtime/checkpoint.py commit window",
+        "meaning": ("raise `ckpt_crash` = crash with state durable "
+                    "but unmarked"),
+    },
+    "checkpoint.corrupt": {
+        "ctx": ("tag", "dir"),
+        "site": "runtime/checkpoint.py post-commit",
+        "meaning": "`corrupt` = bitrot in the largest state file",
+    },
+    "offload.io": {
+        "ctx": ("what",),
+        "site": "inference/offload_store.py `_io_retry`",
+        "meaning": ("raise `io` = transient NVMe error (bounded retry "
+                    "heals; persistent surfaces)"),
+    },
+    "spill.io": {
+        "ctx": ("op", "key"),
+        "site": "inference/offload_store.py `HostKvSpillStore.put/get`",
+        "meaning": ("raise `io` on `op='put'` = the spill export is "
+                    "lost (the victim falls back to "
+                    "flush-and-recompute); on `op='get'` = the resume "
+                    "readback dies (same fallback — the entry is "
+                    "dropped first so the byte budget never wedges)"),
+    },
+    "heartbeat.beat": {
+        "ctx": ("rank",),
+        "site": "elasticity/agent.py",
+        "meaning": ("`skip` = alive-but-wedged controller (staleness "
+                    "detection fires)"),
+    },
+    "engine.grads": {
+        "ctx": ("rank", "step"),
+        "site": ("runtime/engine.py `_dispatch_step` exit (post-step, "
+                 "pre-commit)"),
+        "meaning": ("`corrupt` = a silent bit flip in the gradient "
+                    "path: exponent bits flip in the step's "
+                    "loss/grad-norm readout AND one just-updated "
+                    "state leaf; the guardian's anomaly window must "
+                    "veto before commit"),
+    },
+    "mirror.payload": {
+        "ctx": ("step", "holder", "owner"),
+        "site": ("resilience/redundancy.py `snapshot`, once per "
+                 "mirror entry"),
+        "meaning": ("`corrupt` = a DRAM flip in that holder's copy of "
+                    "the owner's shard slice; the digest envelope "
+                    "catches it at `reconstruct` and falls over to "
+                    "the next holder"),
+    },
+    "handoff.payload": {
+        "ctx": ("uid",),
+        "site": "inference/engine.py `import_kv`, pre-verification",
+        "meaning": ("`corrupt` = an in-transit flip in the K/V page "
+                    "stacks; digest verification raises "
+                    "`HandoffIntegrityError` and the router "
+                    "recomputes token-identically (spill resumes ride "
+                    "the same import path, so this point also models "
+                    "a flip while a spilled payload sat in host "
+                    "DRAM)"),
+    },
+    "replica.spinup": {
+        "ctx": ("replica", "phase"),
+        "site": ("inference/router.py `add_replica` (phase 'build' "
+                 "before scheduler construction, 'join' after warmup "
+                 "+ warm boot)"),
+        "meaning": ("raise = the replica died mid-scale-up: the "
+                    "attempt is BURNED (counter, no id consumed) and "
+                    "the autoscaler retries with exponential "
+                    "backoff"),
+    },
+    "replica.drain": {
+        "ctx": ("replica",),
+        "site": ("inference/router.py `drain_replica`, BEFORE any "
+                 "state mutates"),
+        "meaning": ("raise = the drain rejected at entry; the replica "
+                    "keeps serving untouched"),
+    },
+}
+
+
+def registered_points() -> tuple:
+    """Sorted names of every registered fault point — the coverage
+    universe the L003 audit (analysis/lifecycle.py) checks committed
+    chaos lanes against."""
+    return tuple(sorted(FAULT_POINTS))
+
+
+def registry_markdown_table() -> str:
+    """The docs/fault_tolerance.md fault-point registry table,
+    rendered from FAULT_POINTS so the docs cannot drift from the code
+    (tests/test_lifecycle.py pins the doc to this output)."""
+    lines = ["| point | ctx | site | meaning |", "|---|---|---|---|"]
+    for name, info in FAULT_POINTS.items():
+        ctx = ", ".join(f"`{k}`" for k in info["ctx"])
+        lines.append(
+            f"| `{name}` | {ctx} | {info['site']} | {info['meaning']} |")
+    return "\n".join(lines)
 
 
 @dataclasses.dataclass

@@ -1,0 +1,275 @@
+#!/usr/bin/env python
+"""ds-lifecycle CLI — resource-lifecycle gate (LIFECYCLE.json).
+
+Usage:
+    python scripts/ds_lifecycle.py                  # check vs the ledger
+    python scripts/ds_lifecycle.py --capture        # rerun + write ledger
+    python scripts/ds_lifecycle.py --check --strict # CI spelling
+    python scripts/ds_lifecycle.py --rules L003     # subset (fast)
+
+The fifteenth tier-1 pre-test gate (.claude/skills/verify/SKILL.md).
+Four checks (analysis/lifecycle.py), all AST-static over the lifecycle
+roots plus the committed chaos surface — no step executes:
+
+  L001  exception-path resource leak: acquisitions (allocate bindings,
+        import_kv reservations, spill-store puts, open handles) with
+        no release, ownership transfer, or try-protection on a raising
+        path through the acquire/raise vocabulary.
+  L002  pool-accounting invariants: undeclared counter-key mutations
+        against a class's `self.counters = {...}` authority literal,
+        and accounting attributes written outside their owner. The
+        runtime half (quiesce_residuals) gates the bench serving-sim /
+        chaos / overload lane exits on fully-drained pools.
+  L003  fault-coverage audit: the FAULT_POINTS registry
+        (resilience/faults.py) cross-referenced against every
+        committed chaos lane (repo-root plan JSONs, bench defaults,
+        scripts, armed tests) and every fault_point() call site; plus
+        hot-path mutators whose call-graph component contains no
+        fault point at all.
+  L004  swallowed typed failures: broad handlers absorbing the
+        resilience error vocabulary without counting, logging, or
+        re-raising (ds-lint R009 is the warn-level shim of this rule
+        for hot files outside the lifecycle roots).
+
+L findings have NO baseline — any active finding is red in every mode;
+only the ownership ledger (per-root acquire/release tallies, counter
+authorities, the coverage matrix, pragma suppression inventory) is
+pinned in LIFECYCLE.json. A SELFTEST section seeds one deliberate
+violation per check (an unprotected allocate on a raising path, an
+undeclared counter key, an uncovered registry point, a swallowing
+broad except) and requires each to fire EXACTLY once — the gate
+proves its own teeth every run.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+# the virtual 8-device CPU mesh must exist BEFORE jax initializes
+# (the analyzer itself never imports jax, but the analysis package's
+# siblings may; stay consistent with every other gate)
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+DEFAULT_PATH = os.path.join(_REPO, "LIFECYCLE.json")
+
+ALL_RULES = ("L001", "L002", "L003", "L004")
+
+
+# ----------------------------------------------------------------------
+# selftest — one seeded violation per check; each must fire EXACTLY once
+# ----------------------------------------------------------------------
+
+_L001_FIXTURE = '''
+class Sched:
+    def grab(self, uid):
+        blk = self.allocator.allocate()
+        self.state.extend(uid, 1)
+        self.table[uid] = blk
+'''
+
+_L001_PROTECTED = '''
+class Sched:
+    def grab(self, uid):
+        blk = self.allocator.allocate()
+        try:
+            self.state.extend(uid, 1)
+        finally:
+            self.allocator.free(blk)
+        self.table[uid] = blk
+'''
+
+_L002_FIXTURE = '''
+class Sched:
+    def __init__(self):
+        self.counters = {"hits": 0}
+
+    def poke(self):
+        self.counters["oops"] += 1
+'''
+
+_L004_FIXTURE = '''
+class Sched:
+    def pull(self, uid):
+        try:
+            self.engine.import_kv(uid, None)
+        except Exception:
+            return None
+'''
+
+_L004_COUNTED = '''
+class Sched:
+    def pull(self, uid):
+        try:
+            self.engine.import_kv(uid, None)
+        except Exception:
+            self.counters["import_failures"] += 1
+            return None
+'''
+
+
+def _selftest():
+    from deepspeed_tpu.analysis.lifecycle import (
+        l001_findings, l002_findings, l003_findings, l004_findings)
+
+    counts = {}
+    f, _ = l001_findings([("selftest_l001.py", _L001_FIXTURE)])
+    counts["L001"] = len(f)
+    # ... and the try/finally twin stays silent (the protected idiom)
+    f, _ = l001_findings([("selftest_l001_ok.py", _L001_PROTECTED)])
+    counts["L001_protected"] = len(f)
+    f, _ = l002_findings([("selftest_l002.py", _L002_FIXTURE)])
+    counts["L002"] = len(f)
+    # a registered point with a call site but ZERO committed lanes
+    f, _ = l003_findings({"self.test": {}}, {},
+                         {"self.test": [("selftest.py", 1)]})
+    counts["L003"] = len(f)
+    counts["L004"] = len(
+        l004_findings([("selftest_l004.py", _L004_FIXTURE)]))
+    # ... and the counted twin stays silent (observe-then-absorb is ok)
+    counts["L004_counted"] = len(
+        l004_findings([("selftest_l004_ok.py", _L004_COUNTED)]))
+    return counts
+
+
+# ----------------------------------------------------------------------
+# driver
+# ----------------------------------------------------------------------
+
+def _run(rules):
+    from deepspeed_tpu.analysis.lifecycle import analyze_tree
+
+    rep = analyze_tree(_REPO)
+    findings = [f for f in rep.findings if f.rule in rules]
+    measured = {
+        "version": 1,
+        "ledger": rep.ledger,
+        "coverage": rep.coverage,
+        "selftest": {},
+    }
+    uncovered = [p for p, lanes in rep.coverage.items() if not lanes]
+    print(f"[ds-lifecycle] {rep.summary()}; "
+          f"{len(uncovered)} uncovered point(s)", file=sys.stderr)
+
+    selftest = _selftest()
+    measured["selftest"] = selftest
+    expected = {"L001": 1, "L001_protected": 0, "L002": 1, "L003": 1,
+                "L004": 1, "L004_counted": 0}
+    teeth_ok = selftest == expected
+    if not teeth_ok:
+        print(f"[ds-lifecycle] SELFTEST FAILED: expected {expected}, "
+              f"got {selftest} — a check lost its teeth",
+              file=sys.stderr)
+    return findings, measured, teeth_ok
+
+
+def _strip_suppressions(ledger):
+    out = json.loads(json.dumps(ledger))
+    (out.get("ledger") or {}).pop("suppressions", None)
+    return out
+
+
+def _diff(committed, measured):
+    for key in ("ledger", "coverage"):
+        c, m = committed.get(key), measured.get(key)
+        if c != m:
+            print(f"[ds-lifecycle] {key} drift:", file=sys.stderr)
+            print(f"    committed: {json.dumps(c, sort_keys=True)}",
+                  file=sys.stderr)
+            print(f"    measured:  {json.dumps(m, sort_keys=True)}",
+                  file=sys.stderr)
+    print("[ds-lifecycle] ledger drift: rerun with --capture after "
+          "review (L findings never have a baseline; only the "
+          "ownership ledger, coverage matrix, and suppression "
+          "inventory do)", file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--capture", action="store_true",
+                    help="run all checks and write the ledger into "
+                         f"{DEFAULT_PATH}")
+    ap.add_argument("--check", action="store_true",
+                    help="explicit check mode (the default)")
+    ap.add_argument("--strict", action="store_true",
+                    help="also fail on suppression drift vs the "
+                         "committed ledger (findings always fail)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated L-rule subset (default: all; "
+                         "subset mode skips the ledger diff)")
+    ap.add_argument("--baseline", default=DEFAULT_PATH,
+                    help=f"ledger path (default {DEFAULT_PATH})")
+    ap.add_argument("--json", action="store_true",
+                    help="print the measured ledger to stdout")
+    args = ap.parse_args(argv)
+
+    rules = list(ALL_RULES)
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in ALL_RULES]
+        if unknown:
+            ap.error(f"unknown rule(s) {unknown}; "
+                     f"choose from {list(ALL_RULES)}")
+
+    findings, measured, teeth_ok = _run(rules)
+    rc = 0
+    if not teeth_ok:
+        rc = 1
+
+    # lifecycle findings have no baseline: any active finding is red
+    if findings:
+        for f in findings:
+            print(f"[ds-lifecycle] {f.rule} {f.path}:{f.line} "
+                  f"{f.message}", file=sys.stderr)
+            if f.fix_hint:
+                print(f"    hint: {f.fix_hint}", file=sys.stderr)
+        rc = 1
+
+    if args.capture:
+        if rc == 0:
+            if args.rules:
+                print("[ds-lifecycle] refusing to capture a partial "
+                      "ledger (--rules); run a full --capture",
+                      file=sys.stderr)
+                rc = 1
+            else:
+                with open(args.baseline, "w") as fh:
+                    json.dump(measured, fh, indent=1, sort_keys=True)
+                    fh.write("\n")
+                print(f"[ds-lifecycle] wrote {args.baseline}",
+                      file=sys.stderr)
+    elif not args.rules:
+        if not os.path.exists(args.baseline):
+            print(f"[ds-lifecycle] no committed ledger at "
+                  f"{args.baseline} — run --capture first",
+                  file=sys.stderr)
+            rc = 1
+        else:
+            with open(args.baseline) as fh:
+                committed = json.load(fh)
+            if committed != measured:
+                if not args.strict and \
+                        _strip_suppressions(committed) == \
+                        _strip_suppressions(measured):
+                    print("[ds-lifecycle] suppression drift "
+                          "(non-strict: warning only)", file=sys.stderr)
+                else:
+                    _diff(committed, measured)
+                    rc = 1
+
+    if args.json:
+        print(json.dumps(measured, indent=1, sort_keys=True))
+    print(json.dumps({"ok": rc == 0, "gate": "ds_lifecycle",
+                      "strict": bool(args.strict)}), file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -240,6 +240,34 @@ class TestLaunchFaultPoint:
 
 
 # ---------------------------------------------------------------------------
+# elastic.generation fault point: a generation launch failure is LOUD
+# ---------------------------------------------------------------------------
+
+class TestGenerationFaultPoint:
+    def test_failed_generation_launch_propagates(self):
+        """elastic.generation fires inside ElasticTrainer._launch
+        BEFORE make_engine runs, so an injected launch failure must
+        surface to the caller untouched — never be absorbed into a
+        half-built trainer (the lifecycle L003 coverage lane for this
+        point)."""
+        from deepspeed_tpu.elasticity import ElasticTrainer
+
+        calls = []
+        plan = FaultPlan([{"point": "elastic.generation",
+                           "kind": "raise", "error": "io",
+                           "where": {"generation": 0}, "times": 1}])
+        with armed(plan) as p:
+            with pytest.raises(InjectedIOError):
+                ElasticTrainer(
+                    lambda w: calls.append(w), 2, _make_loader(),
+                    elastic_block=dict(ELASTIC))
+        assert p.fired == ["elastic.generation#1:raise:io"]
+        # the fault raised at the generation boundary: no engine was
+        # ever built for the doomed generation
+        assert calls == []
+
+
+# ---------------------------------------------------------------------------
 # the compact in-process journey: kill -> peer reshard -> regrow
 # ---------------------------------------------------------------------------
 
